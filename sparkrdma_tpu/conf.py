@@ -1,0 +1,248 @@
+"""Typed, range-validated configuration for the TPU shuffle framework.
+
+Analog of the reference's RdmaShuffleConf (RdmaShuffleConf.scala:34-126):
+namespaced keys under ``spark.shuffle.tpu.*`` with clamped int and
+byte-size parsers falling back to defaults.  Every knob from the
+reference's `spark.shuffle.rdma.*` namespace has an equivalent here
+(SURVEY.md §2 row "Shuffle conf"); knobs that only make sense for
+ibverbs (recv WR sizing, ODP) map onto their ICI/arena analogs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Mapping, Optional
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgt]?)b?\s*$", re.IGNORECASE)
+_SIZE_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_byte_size(value: object) -> int:
+    """Parse '8m', '256k', '10g', 4096 → bytes."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    m = _SIZE_RE.match(str(value))
+    if not m:
+        raise ValueError(f"cannot parse byte size: {value!r}")
+    return int(float(m.group(1)) * _SIZE_MULT[m.group(2).lower()])
+
+
+def parse_time_ms(value: object) -> int:
+    """Parse '20s', '50ms', '2s', 120 (seconds) → milliseconds."""
+    if isinstance(value, (int, float)):
+        return int(value) * 1000
+    s = str(value).strip().lower()
+    if s.endswith("ms"):
+        return int(float(s[:-2]))
+    if s.endswith("s"):
+        return int(float(s[:-1]) * 1000)
+    return int(float(s)) * 1000
+
+
+class TpuShuffleConf:
+    """Config accessor over a plain dict of ``spark.shuffle.tpu.*`` keys.
+
+    Each accessor clamps to a [min, max] range and falls back to a default
+    on missing/garbage values, like the reference's getRdmaConfIntInRange /
+    getConfBytesInRange (RdmaShuffleConf.scala:36-47).
+    """
+
+    PREFIX = "spark.shuffle.tpu."
+
+    def __init__(self, conf: Optional[Mapping[str, object]] = None):
+        self._conf: Dict[str, object] = dict(conf or {})
+
+    # -- raw access ---------------------------------------------------------
+    def get(self, short_key: str, default=None):
+        return self._conf.get(self.PREFIX + short_key, default)
+
+    def set(self, short_key: str, value: object) -> "TpuShuffleConf":
+        self._conf[self.PREFIX + short_key] = value
+        return self
+
+    def _int_in_range(self, key: str, default: int, lo: int, hi: int) -> int:
+        raw = self.get(key)
+        if raw is None:
+            return default
+        try:
+            v = int(raw)
+        except (TypeError, ValueError):
+            return default
+        return max(lo, min(hi, v))
+
+    def _bytes_in_range(self, key: str, default: int, lo: int, hi: int) -> int:
+        raw = self.get(key)
+        if raw is None:
+            return default
+        try:
+            v = parse_byte_size(raw)
+        except ValueError:
+            return default
+        return max(lo, min(hi, v))
+
+    def _bool(self, key: str, default: bool) -> bool:
+        raw = self.get(key)
+        if raw is None:
+            return default
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).strip().lower() in ("1", "true", "yes", "on")
+
+    def _time_ms(self, key: str, default_ms: int) -> int:
+        raw = self.get(key)
+        if raw is None:
+            return default_ms
+        try:
+            return parse_time_ms(raw)
+        except ValueError:
+            return default_ms
+
+    # -- transport / control-plane queues (reference: recv/sendQueueDepth) --
+    @property
+    def recv_queue_depth(self) -> int:
+        return self._int_in_range("recvQueueDepth", 1024, 256, 65535)
+
+    @property
+    def send_queue_depth(self) -> int:
+        return self._int_in_range("sendQueueDepth", 4096, 256, 65535)
+
+    @property
+    def recv_wr_size(self) -> int:
+        """Max size of one control-plane message segment (reference: 4 KiB
+        registered recv buffers, RdmaShuffleConf recvWrSize)."""
+        return self._bytes_in_range("recvWrSize", 4096, 2048, 1 << 20)
+
+    @property
+    def sw_flow_control(self) -> bool:
+        return self._bool("swFlowControl", True)
+
+    # -- memory / arenas (reference: maxBufferAllocationSize, ODP) ----------
+    @property
+    def max_buffer_allocation_size(self) -> int:
+        return self._bytes_in_range("maxBufferAllocationSize", 10 << 30, 0, 1 << 44)
+
+    @property
+    def max_agg_prealloc(self) -> int:
+        return self._bytes_in_range("maxAggPrealloc", 0, 0, 1 << 40)
+
+    @property
+    def max_agg_block(self) -> int:
+        """Cap on one aggregated fetch tile (reference: maxAggBlock 2m)."""
+        return self._bytes_in_range("maxAggBlock", 2 << 20, 128 << 10, 1 << 30)
+
+    # -- data plane block sizing -------------------------------------------
+    @property
+    def shuffle_write_block_size(self) -> int:
+        """Arena segment granularity on the write side (reference: 8m
+        mmap chunks, shuffleWriteBlockSize)."""
+        return self._bytes_in_range("shuffleWriteBlockSize", 8 << 20, 64 << 10, 1 << 30)
+
+    @property
+    def shuffle_read_block_size(self) -> int:
+        """Target size of one grouped fetch (reference: 256k)."""
+        return self._bytes_in_range("shuffleReadBlockSize", 256 << 10, 16 << 10, 1 << 30)
+
+    @property
+    def max_bytes_in_flight(self) -> int:
+        """Reader-side in-flight window (reference: 1m)."""
+        return self._bytes_in_range("maxBytesInFlight", 1 << 20, 128 << 10, 1 << 40)
+
+    # -- exchange engine (TPU-specific; no reference analog) ----------------
+    @property
+    def exchange_tile_bytes(self) -> int:
+        """Payload bytes per chip per all_to_all tile round.  The SPMD
+        analog of shuffle_read_block_size: every chip contributes exactly
+        one padded tile of this size per round."""
+        return self._bytes_in_range("exchangeTileBytes", 4 << 20, 64 << 10, 1 << 30)
+
+    @property
+    def exchange_max_rounds_in_flight(self) -> int:
+        """Bounded outstanding exchange rounds (maxBytesInFlight analog
+        for the collective data plane)."""
+        return self._int_in_range("exchangeMaxRoundsInFlight", 2, 1, 64)
+
+    @property
+    def exchange_dtype(self) -> str:
+        return str(self.get("exchangeDtype", "uint8"))
+
+    # -- observability ------------------------------------------------------
+    @property
+    def collect_shuffle_reader_stats(self) -> bool:
+        return self._bool("collectShuffleReaderStats", False)
+
+    @property
+    def fetch_time_bucket_size_ms(self) -> int:
+        return self._int_in_range("fetchTimeBucketSizeInMs", 300, 1, 60000)
+
+    @property
+    def fetch_time_num_buckets(self) -> int:
+        return self._int_in_range("fetchTimeNumBuckets", 5, 2, 100)
+
+    # -- control plane endpoints / timeouts ---------------------------------
+    @property
+    def driver_host(self) -> str:
+        return str(self.get("driverHost", "127.0.0.1"))
+
+    @property
+    def driver_port(self) -> int:
+        return self._int_in_range("driverPort", 0, 0, 65535)
+
+    def set_driver_port(self, port: int) -> None:
+        """Driver's bound port written back so executors inherit it
+        (reference: RdmaShuffleConf.scala:56)."""
+        self.set("driverPort", port)
+
+    @property
+    def executor_port(self) -> int:
+        return self._int_in_range("executorPort", 0, 0, 65535)
+
+    @property
+    def port_max_retries(self) -> int:
+        return self._int_in_range("portMaxRetries", 16, 1, 1000)
+
+    @property
+    def partition_location_fetch_timeout_ms(self) -> int:
+        return self._time_ms("partitionLocationFetchTimeout", 120_000)
+
+    @property
+    def connect_timeout_ms(self) -> int:
+        """Reference: rdmaCmEventTimeout (20s)."""
+        return self._time_ms("connectTimeout", 20_000)
+
+    @property
+    def teardown_listen_timeout_ms(self) -> int:
+        return self._time_ms("teardownListenTimeout", 50)
+
+    @property
+    def max_connection_attempts(self) -> int:
+        return self._int_in_range("maxConnectionAttempts", 5, 1, 100)
+
+    # -- device placement (reference: cpuList comp-vector pinning) ----------
+    @property
+    def device_list(self) -> str:
+        """Comma/range list restricting which local devices serve the
+        exchange, e.g. '0-3,6' (reference: cpuList, RdmaShuffleConf)."""
+        return str(self.get("deviceList", ""))
+
+    def parse_device_list(self, n_devices: int) -> list:
+        """Expand device_list against n_devices, dropping out-of-range
+        entries; empty/invalid → all devices (reference semantics of
+        initCpuArrayList, RdmaNode.java:216-273)."""
+        spec = self.device_list.strip()
+        if not spec:
+            return list(range(n_devices))
+        out = []
+        try:
+            for part in spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "-" in part:
+                    a, b = part.split("-", 1)
+                    out.extend(range(int(a), int(b) + 1))
+                else:
+                    out.append(int(part))
+        except ValueError:
+            return list(range(n_devices))
+        out = [d for d in out if 0 <= d < n_devices]
+        return out or list(range(n_devices))

@@ -1,0 +1,23 @@
+"""Control-plane RPC messages and codecs."""
+
+from sparkrdma_tpu.rpc.messages import (
+    MSG_TYPES,
+    AnnounceShuffleManagersMsg,
+    FetchMapStatusMsg,
+    FetchMapStatusResponseMsg,
+    HelloMsg,
+    PublishMapTaskOutputMsg,
+    RpcMsg,
+    decode_msg,
+)
+
+__all__ = [
+    "RpcMsg",
+    "HelloMsg",
+    "AnnounceShuffleManagersMsg",
+    "PublishMapTaskOutputMsg",
+    "FetchMapStatusMsg",
+    "FetchMapStatusResponseMsg",
+    "decode_msg",
+    "MSG_TYPES",
+]

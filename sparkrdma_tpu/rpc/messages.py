@@ -1,0 +1,402 @@
+"""Compact binary control-plane RPC messages.
+
+The reference frames every control message as ``4B length + 4B type``
+followed by a type-specific payload, and *segments* large payloads into
+recv-WR-sized registered buffers so they ride fixed-size RDMA SENDs
+(reference: RdmaRpcMsg.scala:31-87, toRdmaByteBufferManagedBuffers).
+
+Same scheme here: :meth:`RpcMsg.encode_segments` yields one or more
+independently-decodable frames, each at most ``max_segment_size`` bytes.
+Segmentable messages (announce / publish / fetch-status / response) split
+their element lists across frames; each frame is a complete message of the
+same type covering a sub-range, so the receiver just applies them in any
+order (the publish path lands each sub-range via
+``MapTaskOutput.put_range``).
+
+The five message types mirror the reference's set
+(RdmaRpcMsg.scala:31-35):
+
+====  =====================================  ===========================
+type  class                                  direction
+====  =====================================  ===========================
+ 1    HelloMsg                               executor → driver
+ 2    AnnounceShuffleManagersMsg             driver → all executors
+ 3    PublishMapTaskOutputMsg                executor → driver
+ 4    FetchMapStatusMsg                      executor → driver
+ 5    FetchMapStatusResponseMsg              driver → executor
+====  =====================================  ===========================
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple, Type
+
+from sparkrdma_tpu.utils.types import (
+    LOCATION_ENTRY_SIZE,
+    BlockLocation,
+    ShuffleManagerId,
+)
+
+_HEADER = struct.Struct("<ii")  # (frame_length, msg_type)
+HEADER_SIZE = _HEADER.size
+
+
+class RpcMsg:
+    """Base class: framing + segmentation."""
+
+    MSG_TYPE: int = 0
+
+    # -- subclass hooks -----------------------------------------------------
+    def _payload(self) -> bytes:
+        raise NotImplementedError
+
+    def _split(self, max_payload: int) -> Sequence["RpcMsg"]:
+        """Split into messages whose payloads each fit max_payload.
+        Default: no splitting supported."""
+        return (self,)
+
+    # -- framing ------------------------------------------------------------
+    def _frame(self, payload: bytes) -> bytes:
+        return _HEADER.pack(HEADER_SIZE + len(payload), self.MSG_TYPE) + payload
+
+    def encode(self) -> bytes:
+        return self._frame(self._payload())
+
+    def encode_segments(self, max_segment_size: int) -> List[bytes]:
+        """Encode into frames each ≤ max_segment_size bytes."""
+        max_payload = max_segment_size - HEADER_SIZE
+        if max_payload <= 0:
+            raise ValueError(f"segment size too small: {max_segment_size}")
+        payload = self._payload()
+        if len(payload) <= max_payload:
+            return [self._frame(payload)]
+        parts = self._split(max_payload)
+        if len(parts) == 1:
+            raise ValueError(
+                f"{type(self).__name__} payload {len(payload)}B exceeds segment "
+                f"size {max_segment_size}B and cannot be split further"
+            )
+        out: List[bytes] = []
+        for p in parts:
+            pp = p._payload()
+            if len(pp) > max_payload:
+                # an atomic element (e.g. one id with a very long hostname,
+                # or a fixed header) alone exceeds the segment size
+                raise ValueError(
+                    f"{type(self).__name__} segment payload {len(pp)}B still "
+                    f"exceeds segment size {max_segment_size}B"
+                )
+            out.append(p._frame(pp))
+        return out
+
+
+def decode_msg(data: bytes) -> RpcMsg:
+    """Decode one frame (dispatch by type header,
+    reference: RdmaRpcMsg.scala:67-87)."""
+    if len(data) < HEADER_SIZE:
+        raise ValueError(f"frame too short: {len(data)}B")
+    length, msg_type = _HEADER.unpack_from(data, 0)
+    if length != len(data):
+        raise ValueError(f"frame length {length} != buffer length {len(data)}")
+    cls = MSG_TYPES.get(msg_type)
+    if cls is None:
+        raise ValueError(f"unknown RPC message type {msg_type}")
+    return cls._decode_payload(memoryview(data)[HEADER_SIZE:])
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HelloMsg(RpcMsg):
+    """Executor advertises itself to the driver on startup
+    (reference: RdmaShuffleManagerHelloRpcMsg, RdmaRpcMsg.scala:90-119)."""
+
+    shuffle_manager_id: ShuffleManagerId
+    channel_port: int  # port the driver should connect back to
+
+    MSG_TYPE = 1
+
+    def _payload(self) -> bytes:
+        buf = bytearray()
+        self.shuffle_manager_id.write(buf)
+        buf += struct.pack("<i", self.channel_port)
+        return bytes(buf)
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "HelloMsg":
+        smid, off = ShuffleManagerId.read(view, 0)
+        (port,) = struct.unpack_from("<i", view, off)
+        return HelloMsg(smid, port)
+
+
+@dataclass(frozen=True)
+class AnnounceShuffleManagersMsg(RpcMsg):
+    """Driver broadcasts the current membership so executors pre-connect
+    the full mesh (reference: RdmaAnnounceRdmaShuffleManagersRpcMsg,
+    RdmaRpcMsg.scala:121-180)."""
+
+    shuffle_manager_ids: Tuple[ShuffleManagerId, ...]
+
+    MSG_TYPE = 2
+
+    def __init__(self, shuffle_manager_ids: Sequence[ShuffleManagerId]):
+        object.__setattr__(self, "shuffle_manager_ids", tuple(shuffle_manager_ids))
+
+    def _payload(self) -> bytes:
+        buf = bytearray(struct.pack("<i", len(self.shuffle_manager_ids)))
+        for smid in self.shuffle_manager_ids:
+            smid.write(buf)
+        return bytes(buf)
+
+    def _split(self, max_payload: int) -> Sequence["AnnounceShuffleManagersMsg"]:
+        parts: List[AnnounceShuffleManagersMsg] = []
+        cur: List[ShuffleManagerId] = []
+        cur_len = 4
+        for smid in self.shuffle_manager_ids:
+            n = smid.serialized_length()
+            if cur and cur_len + n > max_payload:
+                parts.append(AnnounceShuffleManagersMsg(cur))
+                cur, cur_len = [], 4
+            cur.append(smid)
+            cur_len += n
+        if cur:
+            parts.append(AnnounceShuffleManagersMsg(cur))
+        return parts
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "AnnounceShuffleManagersMsg":
+        (n,) = struct.unpack_from("<i", view, 0)
+        off = 4
+        smids = []
+        for _ in range(n):
+            smid, off = ShuffleManagerId.read(view, off)
+            smids.append(smid)
+        return AnnounceShuffleManagersMsg(smids)
+
+
+@dataclass(frozen=True)
+class PublishMapTaskOutputMsg(RpcMsg):
+    """Executor publishes a map task's location table to the driver,
+    possibly as several sub-range segments
+    (reference: RdmaPublishMapTaskOutputRpcMsg, RdmaRpcMsg.scala:182-276).
+
+    ``entries`` holds the raw 16-byte location entries for partitions
+    [first_reduce_id, last_reduce_id] inclusive.
+    """
+
+    shuffle_manager_id: ShuffleManagerId
+    shuffle_id: int
+    map_id: int
+    total_num_partitions: int
+    first_reduce_id: int
+    last_reduce_id: int
+    entries: bytes
+
+    MSG_TYPE = 3
+
+    def __post_init__(self):
+        expect = (self.last_reduce_id - self.first_reduce_id + 1) * LOCATION_ENTRY_SIZE
+        if len(self.entries) != expect:
+            raise ValueError(
+                f"entries {len(self.entries)}B != expected {expect}B for range "
+                f"[{self.first_reduce_id},{self.last_reduce_id}]"
+            )
+
+    def _payload(self) -> bytes:
+        buf = bytearray()
+        self.shuffle_manager_id.write(buf)
+        buf += struct.pack(
+            "<iiiii",
+            self.shuffle_id,
+            self.map_id,
+            self.total_num_partitions,
+            self.first_reduce_id,
+            self.last_reduce_id,
+        )
+        buf += self.entries
+        return bytes(buf)
+
+    def _split(self, max_payload: int) -> Sequence["PublishMapTaskOutputMsg"]:
+        fixed = self.shuffle_manager_id.serialized_length() + 20
+        per_seg = max(1, (max_payload - fixed) // LOCATION_ENTRY_SIZE)
+        parts: List[PublishMapTaskOutputMsg] = []
+        first = self.first_reduce_id
+        while first <= self.last_reduce_id:
+            last = min(first + per_seg - 1, self.last_reduce_id)
+            lo = (first - self.first_reduce_id) * LOCATION_ENTRY_SIZE
+            hi = (last - self.first_reduce_id + 1) * LOCATION_ENTRY_SIZE
+            parts.append(
+                PublishMapTaskOutputMsg(
+                    self.shuffle_manager_id,
+                    self.shuffle_id,
+                    self.map_id,
+                    self.total_num_partitions,
+                    first,
+                    last,
+                    self.entries[lo:hi],
+                )
+            )
+            first = last + 1
+        return parts
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "PublishMapTaskOutputMsg":
+        smid, off = ShuffleManagerId.read(view, 0)
+        shuffle_id, map_id, total, first, last = struct.unpack_from("<iiiii", view, off)
+        off += 20
+        return PublishMapTaskOutputMsg(
+            smid, shuffle_id, map_id, total, first, last, bytes(view[off:])
+        )
+
+
+@dataclass(frozen=True)
+class FetchMapStatusMsg(RpcMsg):
+    """Executor asks the driver for the locations of a set of
+    (map_id, reduce_id) blocks served by one remote host; the response is
+    routed through ``callback_id``
+    (reference: RdmaFetchMapStatusRpcMsg, RdmaRpcMsg.scala:279-367).
+
+    Wide requests split across segments: each segment is an independent
+    request carrying ``total`` (the whole logical request's block count)
+    and ``index`` (offset of this segment's first block), and the driver's
+    per-segment responses reuse those so the requester reassembles one
+    answer of ``total`` locations.
+    """
+
+    requester: ShuffleManagerId
+    host: ShuffleManagerId  # whose map outputs we want
+    shuffle_id: int
+    callback_id: int
+    block_ids: Tuple[Tuple[int, int], ...]  # (map_id, reduce_id) pairs
+    total: int = -1  # blocks in the whole logical request; -1 → len(block_ids)
+    index: int = 0   # offset of block_ids[0] within the logical request
+
+    MSG_TYPE = 4
+
+    def __init__(self, requester, host, shuffle_id, callback_id, block_ids,
+                 total=-1, index=0):
+        object.__setattr__(self, "requester", requester)
+        object.__setattr__(self, "host", host)
+        object.__setattr__(self, "shuffle_id", shuffle_id)
+        object.__setattr__(self, "callback_id", callback_id)
+        object.__setattr__(self, "block_ids", tuple(tuple(b) for b in block_ids))
+        object.__setattr__(self, "total", len(self.block_ids) if total < 0 else total)
+        object.__setattr__(self, "index", index)
+
+    def _payload(self) -> bytes:
+        buf = bytearray()
+        self.requester.write(buf)
+        self.host.write(buf)
+        buf += struct.pack(
+            "<iiiii",
+            self.shuffle_id, self.callback_id, self.total, self.index,
+            len(self.block_ids),
+        )
+        for map_id, reduce_id in self.block_ids:
+            buf += struct.pack("<ii", map_id, reduce_id)
+        return bytes(buf)
+
+    def _split(self, max_payload: int) -> Sequence["FetchMapStatusMsg"]:
+        fixed = (
+            self.requester.serialized_length()
+            + self.host.serialized_length()
+            + 20
+        )
+        per_seg = max(1, (max_payload - fixed) // 8)
+        parts: List[FetchMapStatusMsg] = []
+        for start in range(0, len(self.block_ids), per_seg):
+            parts.append(
+                FetchMapStatusMsg(
+                    self.requester, self.host, self.shuffle_id, self.callback_id,
+                    self.block_ids[start : start + per_seg],
+                    total=self.total, index=self.index + start,
+                )
+            )
+        return parts
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "FetchMapStatusMsg":
+        requester, off = ShuffleManagerId.read(view, 0)
+        host, off = ShuffleManagerId.read(view, off)
+        shuffle_id, callback_id, total, index, n = struct.unpack_from(
+            "<iiiii", view, off
+        )
+        off += 20
+        blocks = []
+        for _ in range(n):
+            blocks.append(struct.unpack_from("<ii", view, off))
+            off += 8
+        return FetchMapStatusMsg(
+            requester, host, shuffle_id, callback_id, blocks,
+            total=total, index=index,
+        )
+
+
+@dataclass(frozen=True)
+class FetchMapStatusResponseMsg(RpcMsg):
+    """Driver's answer: one BlockLocation per requested block, in request
+    order, split across segments when large.  ``index`` is the offset of
+    this segment's first location within the full answer, ``total`` the
+    full answer's length (reference: RdmaFetchMapStatusResponseRpcMsg,
+    RdmaRpcMsg.scala:369-446)."""
+
+    callback_id: int
+    total: int
+    index: int
+    locations: Tuple[BlockLocation, ...]
+
+    MSG_TYPE = 5
+
+    def __init__(self, callback_id, total, index, locations):
+        object.__setattr__(self, "callback_id", callback_id)
+        object.__setattr__(self, "total", total)
+        object.__setattr__(self, "index", index)
+        object.__setattr__(self, "locations", tuple(locations))
+
+    def _payload(self) -> bytes:
+        buf = bytearray(
+            struct.pack("<iiii", self.callback_id, self.total, self.index,
+                        len(self.locations))
+        )
+        for loc in self.locations:
+            loc.write(buf)
+        return bytes(buf)
+
+    def _split(self, max_payload: int) -> Sequence["FetchMapStatusResponseMsg"]:
+        per_seg = max(1, (max_payload - 16) // LOCATION_ENTRY_SIZE)
+        parts: List[FetchMapStatusResponseMsg] = []
+        for start in range(0, len(self.locations), per_seg):
+            parts.append(
+                FetchMapStatusResponseMsg(
+                    self.callback_id,
+                    self.total,
+                    self.index + start,
+                    self.locations[start : start + per_seg],
+                )
+            )
+        return parts
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "FetchMapStatusResponseMsg":
+        callback_id, total, index, n = struct.unpack_from("<iiii", view, 0)
+        off = 16
+        locs = []
+        for _ in range(n):
+            locs.append(BlockLocation.read(view, off))
+            off += LOCATION_ENTRY_SIZE
+        return FetchMapStatusResponseMsg(callback_id, total, index, locs)
+
+
+MSG_TYPES: Dict[int, Type[RpcMsg]] = {
+    cls.MSG_TYPE: cls
+    for cls in (
+        HelloMsg,
+        AnnounceShuffleManagersMsg,
+        PublishMapTaskOutputMsg,
+        FetchMapStatusMsg,
+        FetchMapStatusResponseMsg,
+    )
+}

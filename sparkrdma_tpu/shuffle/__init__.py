@@ -1,0 +1,1 @@
+"""Shuffle data plane: manager, writer, reader, resolver, map-output index."""

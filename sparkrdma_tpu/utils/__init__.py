@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: ids, wire primitives, stats, clocks."""

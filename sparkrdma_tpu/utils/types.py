@@ -1,0 +1,156 @@
+"""Core identifier and location types.
+
+TPU-native analogs of the reference's id/location vocabulary
+(reference: RdmaUtils.scala:26-138):
+
+- ``BlockLocation`` — where one (map, reduce) block lives.  The reference
+  encodes ``(address: i64, length: i32, mKey: i32)`` where ``address`` is a
+  raw mmap'd virtual address and ``mKey`` the ibverbs memory-region key.
+  Here ``address`` is a byte offset inside the owner's HBM arena segment
+  and ``mkey`` is the arena segment id (epoch-tagged so stale locations
+  are detectable) — same 16-byte wire entry, same role.
+- ``BlockManagerId`` — (executor_id, host, port) triple identifying a
+  block-serving endpoint, with a compact UTF-8 wire format.
+- ``ShuffleManagerId`` — (host, port, BlockManagerId) identifying one
+  shuffle-manager instance, with an interning cache so the driver's maps
+  hold one object per peer (reference: RdmaUtils.scala:121-138).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+# One location entry on the wire: little-endian (address: i64, length: i32,
+# mkey: i32) == 16 bytes, matching the reference's ENTRY_SIZE
+# (RdmaMapTaskOutput.scala:27).
+_LOCATION_STRUCT = struct.Struct("<qii")
+LOCATION_ENTRY_SIZE = _LOCATION_STRUCT.size  # 16
+
+
+@dataclass(frozen=True, slots=True)
+class BlockLocation:
+    """Address of one shuffle block inside a registered memory domain.
+
+    address: byte offset within the owning arena segment (device HBM).
+    length:  block length in bytes.
+    mkey:    arena segment key — identifies which registered segment of the
+             owning executor holds the block (0 == EMPTY/no data).
+    """
+
+    address: int
+    length: int
+    mkey: int
+
+    def write(self, buf: bytearray) -> None:
+        buf += _LOCATION_STRUCT.pack(self.address, self.length, self.mkey)
+
+    @staticmethod
+    def read(view: memoryview, offset: int = 0) -> "BlockLocation":
+        a, l, k = _LOCATION_STRUCT.unpack_from(view, offset)
+        return BlockLocation(a, l, k)
+
+    def pack(self) -> bytes:
+        return _LOCATION_STRUCT.pack(self.address, self.length, self.mkey)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.length == 0
+
+
+# Sentinel for "partition produced no bytes" — mkey 0 is reserved.
+BlockLocation.EMPTY = BlockLocation(0, 0, 0)
+
+
+def _write_utf8(buf: bytearray, s: str) -> None:
+    raw = s.encode("utf-8")
+    if len(raw) > 0xFFFF:
+        raise ValueError(f"string too long for wire format: {len(raw)}")
+    buf += struct.pack("<H", len(raw))
+    buf += raw
+
+
+def _read_utf8(view: memoryview, offset: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<H", view, offset)
+    s = bytes(view[offset + 2 : offset + 2 + n]).decode("utf-8")
+    return s, offset + 2 + n
+
+
+@dataclass(frozen=True, slots=True)
+class BlockManagerId:
+    """Identifies a block-serving endpoint (executor_id, host, port).
+
+    Compact wire format mirroring the reference's
+    SerializableBlockManagerId (RdmaUtils.scala:28-67): length-prefixed
+    UTF-8 strings plus an i32 port.
+    """
+
+    executor_id: str
+    host: str
+    port: int
+
+    def write(self, buf: bytearray) -> None:
+        _write_utf8(buf, self.executor_id)
+        _write_utf8(buf, self.host)
+        buf += struct.pack("<i", self.port)
+
+    @staticmethod
+    def read(view: memoryview, offset: int = 0) -> Tuple["BlockManagerId", int]:
+        executor_id, offset = _read_utf8(view, offset)
+        host, offset = _read_utf8(view, offset)
+        (port,) = struct.unpack_from("<i", view, offset)
+        return BlockManagerId(executor_id, host, port), offset + 4
+
+    def serialized_length(self) -> int:
+        return (
+            2 + len(self.executor_id.encode("utf-8"))
+            + 2 + len(self.host.encode("utf-8"))
+            + 4
+        )
+
+
+@dataclass(frozen=True, slots=True)
+class ShuffleManagerId:
+    """One shuffle-manager instance: (host, port) of its transport endpoint
+    plus the Spark-style BlockManagerId it serves.
+
+    Interned via :func:`get_cached_shuffle_manager_id` so driver-side maps
+    compare by identity (reference: RdmaUtils.scala:121-138).
+    """
+
+    host: str
+    port: int
+    block_manager_id: BlockManagerId
+
+    def write(self, buf: bytearray) -> None:
+        _write_utf8(buf, self.host)
+        buf += struct.pack("<i", self.port)
+        self.block_manager_id.write(buf)
+
+    @staticmethod
+    def read(view: memoryview, offset: int = 0) -> Tuple["ShuffleManagerId", int]:
+        host, offset = _read_utf8(view, offset)
+        (port,) = struct.unpack_from("<i", view, offset)
+        bmid, offset = BlockManagerId.read(view, offset + 4)
+        return get_cached_shuffle_manager_id(ShuffleManagerId(host, port, bmid)), offset
+
+    def serialized_length(self) -> int:
+        return (
+            2 + len(self.host.encode("utf-8"))
+            + 4
+            + self.block_manager_id.serialized_length()
+        )
+
+
+_smid_cache: Dict[ShuffleManagerId, ShuffleManagerId] = {}
+_smid_lock = threading.Lock()
+
+
+def get_cached_shuffle_manager_id(smid: ShuffleManagerId) -> ShuffleManagerId:
+    cached = _smid_cache.get(smid)
+    if cached is not None:
+        return cached
+    with _smid_lock:
+        return _smid_cache.setdefault(smid, smid)

@@ -1,0 +1,25 @@
+"""Test harness: run everything on an 8-way virtual CPU device mesh.
+
+Must set the env vars BEFORE jax is imported anywhere (SURVEY.md §4:
+device-count spoofing via --xla_force_host_platform_device_count).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected ≥8 spoofed CPU devices, got {len(devs)}"
+    return devs

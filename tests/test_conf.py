@@ -1,0 +1,64 @@
+"""Config parsing, clamping, defaults (SURVEY.md §2, RdmaShuffleConf)."""
+
+from sparkrdma_tpu.conf import TpuShuffleConf, parse_byte_size, parse_time_ms
+
+
+def test_byte_size_parsing():
+    assert parse_byte_size("8m") == 8 << 20
+    assert parse_byte_size("256k") == 256 << 10
+    assert parse_byte_size("10g") == 10 << 30
+    assert parse_byte_size("4096") == 4096
+    assert parse_byte_size(4096) == 4096
+    assert parse_byte_size("1.5k") == 1536
+
+
+def test_time_parsing():
+    assert parse_time_ms("20s") == 20000
+    assert parse_time_ms("50ms") == 50
+    assert parse_time_ms(2) == 2000
+
+
+def test_defaults():
+    c = TpuShuffleConf()
+    assert c.recv_queue_depth == 1024
+    assert c.send_queue_depth == 4096
+    assert c.recv_wr_size == 4096
+    assert c.sw_flow_control is True
+    assert c.max_buffer_allocation_size == 10 << 30
+    assert c.shuffle_write_block_size == 8 << 20
+    assert c.shuffle_read_block_size == 256 << 10
+    assert c.max_bytes_in_flight == 1 << 20
+    assert c.max_agg_block == 2 << 20
+    assert c.max_agg_prealloc == 0
+    assert c.collect_shuffle_reader_stats is False
+    assert c.partition_location_fetch_timeout_ms == 120_000
+    assert c.max_connection_attempts == 5
+
+
+def test_clamping_and_fallback():
+    c = TpuShuffleConf({
+        "spark.shuffle.tpu.recvQueueDepth": "64",        # below min 256 → clamp
+        "spark.shuffle.tpu.sendQueueDepth": "garbage",   # unparsable → default
+        "spark.shuffle.tpu.shuffleReadBlockSize": "1k",  # below min 16k → clamp
+    })
+    assert c.recv_queue_depth == 256
+    assert c.send_queue_depth == 4096
+    assert c.shuffle_read_block_size == 16 << 10
+
+
+def test_set_and_get():
+    c = TpuShuffleConf()
+    c.set("maxBytesInFlight", "4m")
+    assert c.max_bytes_in_flight == 4 << 20
+    c.set_driver_port(12345)
+    assert c.driver_port == 12345
+
+
+def test_device_list_parsing():
+    c = TpuShuffleConf({"spark.shuffle.tpu.deviceList": "0-2,5"})
+    assert c.parse_device_list(8) == [0, 1, 2, 5]
+    # out-of-range entries dropped; empty result → all
+    assert c.parse_device_list(2) == [0, 1]
+    assert TpuShuffleConf().parse_device_list(4) == [0, 1, 2, 3]
+    bad = TpuShuffleConf({"spark.shuffle.tpu.deviceList": "x-y"})
+    assert bad.parse_device_list(3) == [0, 1, 2]

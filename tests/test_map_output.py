@@ -1,0 +1,73 @@
+"""MapTaskOutput: partial fills, futures, range serialization
+(SURVEY.md §2, RdmaMapTaskOutput)."""
+
+import pytest
+
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.utils.types import LOCATION_ENTRY_SIZE, BlockLocation
+
+
+def test_put_and_get():
+    mto = MapTaskOutput(4)
+    loc = BlockLocation(1000, 64, 3)
+    mto.put(2, loc)
+    assert mto.get_location(2) == loc
+    assert mto.get_location(0) == BlockLocation.EMPTY
+
+
+def test_fill_future_resolves_only_when_complete():
+    mto = MapTaskOutput(3)
+    assert not mto.is_complete
+    mto.put(0, BlockLocation(0, 1, 1))
+    mto.put(1, BlockLocation(1, 1, 1))
+    assert not mto.fill_future.done()
+    mto.put(2, BlockLocation(2, 1, 1))
+    assert mto.fill_future.done()
+    assert mto.fill_future.result(timeout=0) is mto
+
+
+def test_put_range_roundtrip():
+    src = MapTaskOutput(8)
+    for p in range(8):
+        src.put(p, BlockLocation(p * 100, p + 1, 9))
+    dst = MapTaskOutput(8)
+    # install in two sub-range chunks, out of order
+    dst.put_range(4, 7, src.get_range_bytes(4, 7))
+    assert not dst.is_complete
+    dst.put_range(0, 3, src.get_range_bytes(0, 3))
+    assert dst.is_complete
+    for p in range(8):
+        assert dst.get_location(p) == src.get_location(p)
+
+
+def test_get_locations_and_total_bytes():
+    mto = MapTaskOutput(5)
+    for p in range(5):
+        mto.put(p, BlockLocation(p, 10 * (p + 1), 1))
+    locs = mto.get_locations(1, 3)
+    assert [l.length for l in locs] == [20, 30, 40]
+    assert mto.total_bytes() == 10 + 20 + 30 + 40 + 50
+
+
+def test_range_checks():
+    mto = MapTaskOutput(4)
+    with pytest.raises(IndexError):
+        mto.put(4, BlockLocation.EMPTY)
+    with pytest.raises(IndexError):
+        mto.get_location(-1)
+    with pytest.raises(ValueError):
+        mto.put_range(0, 1, b"\x00" * (3 * LOCATION_ENTRY_SIZE))
+    with pytest.raises(ValueError):
+        MapTaskOutput(0)
+
+
+def test_duplicate_fills_do_not_fake_completion():
+    # reviewer finding: re-delivered publish segments must not double-count
+    mto = MapTaskOutput(3)
+    mto.put(0, BlockLocation(0, 1, 1))
+    mto.put(0, BlockLocation(0, 2, 1))  # retry / re-delivery
+    mto.put_range(0, 1, mto.get_range_bytes(0, 1))  # overlapping range
+    assert not mto.is_complete
+    mto.put(1, BlockLocation(1, 1, 1))
+    mto.put(2, BlockLocation(2, 1, 1))
+    assert mto.is_complete

@@ -1,0 +1,132 @@
+"""RPC wire formats: round-trips, dispatch, segmentation
+(SURVEY.md §2, RdmaRpcMsg)."""
+
+import pytest
+
+from sparkrdma_tpu.rpc import (
+    AnnounceShuffleManagersMsg,
+    FetchMapStatusMsg,
+    FetchMapStatusResponseMsg,
+    HelloMsg,
+    PublishMapTaskOutputMsg,
+    decode_msg,
+)
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.utils.types import BlockLocation, BlockManagerId, ShuffleManagerId
+
+
+def smid(i: int) -> ShuffleManagerId:
+    return ShuffleManagerId(f"host{i}", 9000 + i, BlockManagerId(str(i), f"host{i}", 7000 + i))
+
+
+def test_hello_roundtrip():
+    msg = HelloMsg(smid(1), channel_port=4242)
+    out = decode_msg(msg.encode())
+    assert isinstance(out, HelloMsg)
+    assert out.shuffle_manager_id == msg.shuffle_manager_id
+    assert out.channel_port == 4242
+
+
+def test_announce_roundtrip_and_segmentation():
+    msg = AnnounceShuffleManagersMsg([smid(i) for i in range(100)])
+    # single-frame round trip
+    out = decode_msg(msg.encode())
+    assert out.shuffle_manager_ids == msg.shuffle_manager_ids
+    # segmentation into small frames: union of decoded segments == original
+    frames = msg.encode_segments(max_segment_size=256)
+    assert len(frames) > 1
+    assert all(len(f) <= 256 for f in frames)
+    collected = []
+    for f in frames:
+        collected.extend(decode_msg(f).shuffle_manager_ids)
+    assert tuple(collected) == msg.shuffle_manager_ids
+
+
+def test_publish_roundtrip_and_segmented_install():
+    src = MapTaskOutput(64)
+    for p in range(64):
+        src.put(p, BlockLocation(p * 4096, 4096, 17))
+    msg = PublishMapTaskOutputMsg(
+        smid(2), shuffle_id=5, map_id=9, total_num_partitions=64,
+        first_reduce_id=0, last_reduce_id=63, entries=src.get_range_bytes(0, 63),
+    )
+    frames = msg.encode_segments(max_segment_size=300)
+    assert len(frames) > 1
+    # driver side: install each segment independently via put_range
+    dst = MapTaskOutput(64)
+    for f in frames:
+        seg = decode_msg(f)
+        assert isinstance(seg, PublishMapTaskOutputMsg)
+        assert seg.shuffle_id == 5 and seg.map_id == 9
+        dst.put_range(seg.first_reduce_id, seg.last_reduce_id, seg.entries)
+    assert dst.is_complete
+    for p in range(64):
+        assert dst.get_location(p) == src.get_location(p)
+
+
+def test_fetch_map_status_roundtrip():
+    blocks = [(m, r) for m in range(3) for r in range(4)]
+    msg = FetchMapStatusMsg(smid(3), smid(4), shuffle_id=1, callback_id=77,
+                            block_ids=blocks)
+    out = decode_msg(msg.encode())
+    assert out.requester == msg.requester
+    assert out.host == msg.host
+    assert out.callback_id == 77
+    assert out.block_ids == tuple(tuple(b) for b in blocks)
+
+
+def test_fetch_response_roundtrip_and_segmentation():
+    locs = [BlockLocation(i * 100, i + 1, 3) for i in range(50)]
+    msg = FetchMapStatusResponseMsg(callback_id=8, total=50, index=0, locations=locs)
+    frames = msg.encode_segments(max_segment_size=200)
+    assert len(frames) > 1
+    # reassemble by index
+    got = [None] * 50
+    for f in frames:
+        seg = decode_msg(f)
+        assert seg.callback_id == 8 and seg.total == 50
+        for j, loc in enumerate(seg.locations):
+            got[seg.index + j] = loc
+    assert got == locs
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode_msg(b"\x01")
+    with pytest.raises(ValueError):
+        decode_msg(b"\x10\x00\x00\x00\x63\x00\x00\x00" + b"\x00" * 8)  # type 99
+    # length mismatch
+    good = HelloMsg(smid(5), 1).encode()
+    with pytest.raises(ValueError):
+        decode_msg(good + b"trailing")
+
+
+def test_unsegmentable_oversize_raises():
+    msg = HelloMsg(smid(6), 1)
+    with pytest.raises(ValueError):
+        msg.encode_segments(max_segment_size=10)
+
+
+def test_fetch_map_status_segmentation():
+    # reviewer finding: wide fetches (1000+ blocks) must split across frames
+    blocks = [(m, 7) for m in range(1000)]
+    msg = FetchMapStatusMsg(smid(7), smid(8), shuffle_id=2, callback_id=5,
+                            block_ids=blocks)
+    frames = msg.encode_segments(max_segment_size=512)
+    assert len(frames) > 1
+    got = [None] * 1000
+    for f in frames:
+        seg = decode_msg(f)
+        assert seg.total == 1000 and seg.callback_id == 5
+        for j, b in enumerate(seg.block_ids):
+            got[seg.index + j] = b
+    assert got == [tuple(b) for b in blocks]
+
+
+def test_oversized_atomic_element_raises_not_recurses():
+    # reviewer finding: a single id larger than the segment must raise
+    # ValueError, not recurse forever
+    big = ShuffleManagerId("h" * 300, 1, BlockManagerId("e", "h" * 300, 2))
+    msg = AnnounceShuffleManagersMsg([big, smid(1)])
+    with pytest.raises(ValueError, match="exceeds segment size"):
+        msg.encode_segments(max_segment_size=256)

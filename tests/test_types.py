@@ -1,0 +1,59 @@
+"""Wire-format round-trips for ids and locations (SURVEY.md §2, RdmaUtils)."""
+
+import pytest
+
+from sparkrdma_tpu.utils.types import (
+    LOCATION_ENTRY_SIZE,
+    BlockLocation,
+    BlockManagerId,
+    ShuffleManagerId,
+    get_cached_shuffle_manager_id,
+)
+
+
+def test_block_location_roundtrip():
+    loc = BlockLocation(address=0x1234_5678_9ABC, length=65536, mkey=42)
+    raw = loc.pack()
+    assert len(raw) == LOCATION_ENTRY_SIZE == 16
+    assert BlockLocation.read(memoryview(raw)) == loc
+
+
+def test_block_location_empty():
+    assert BlockLocation.EMPTY.is_empty
+    assert BlockLocation.EMPTY.length == 0
+    assert not BlockLocation(0, 10, 1).is_empty
+
+
+def test_block_location_negative_address_roundtrip():
+    # i64 address must survive the sign bit (raw 64-bit offsets).
+    loc = BlockLocation(address=-1, length=1, mkey=7)
+    assert BlockLocation.read(memoryview(loc.pack())) == loc
+
+
+def test_block_manager_id_roundtrip():
+    bmid = BlockManagerId("exec-7", "host-α.example", 7337)
+    buf = bytearray()
+    bmid.write(buf)
+    assert len(buf) == bmid.serialized_length()
+    out, consumed = BlockManagerId.read(memoryview(bytes(buf)))
+    assert out == bmid
+    assert consumed == len(buf)
+
+
+def test_shuffle_manager_id_roundtrip_and_interning():
+    bmid = BlockManagerId("1", "10.0.0.1", 4000)
+    smid = ShuffleManagerId("10.0.0.1", 9999, bmid)
+    buf = bytearray()
+    smid.write(buf)
+    assert len(buf) == smid.serialized_length()
+    out1, _ = ShuffleManagerId.read(memoryview(bytes(buf)))
+    out2, _ = ShuffleManagerId.read(memoryview(bytes(buf)))
+    assert out1 == smid
+    assert out1 is out2  # interning cache returns one object per peer
+
+
+def test_interning_cache_identity():
+    bmid = BlockManagerId("2", "h", 1)
+    a = get_cached_shuffle_manager_id(ShuffleManagerId("h", 1, bmid))
+    b = get_cached_shuffle_manager_id(ShuffleManagerId("h", 1, bmid))
+    assert a is b
