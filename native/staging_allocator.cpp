@@ -1,0 +1,205 @@
+// Host staging allocator: size-class pooled, page-aligned buffers.
+//
+// Native analog of the reference's RdmaBufferManager
+// (RdmaBufferManager.java:35-209): power-of-two size-class stacks of
+// reusable buffers (min class 16 KiB), a global allocation budget, and
+// idle-pool trimming — when idle bytes exceed 90% of the budget the pool
+// frees least-recently-used stacks down to 65% (the cleanLRUStacks
+// policy, RdmaBufferManager.java:150-188).
+//
+// These buffers stage serialized shuffle partitions on their way to HBM
+// (the role registered MRs play for the NIC in the reference): they are
+// page-aligned so dlpack/numpy views and DMA engines see friendly
+// addresses.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMinBlockSize = 16 * 1024;       // min size class
+constexpr uint64_t kAlignment = 4096;               // page alignment
+constexpr double kTrimTriggerFrac = 0.90;           // idle > 90% -> trim
+constexpr double kTrimTargetFrac = 0.65;            // free down to 65%
+
+uint64_t round_up_class(uint64_t n) {
+  uint64_t c = kMinBlockSize;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+struct SizeClassStack {
+  std::vector<void*> free_list;
+  uint64_t block_size = 0;
+  uint64_t total_blocks = 0;     // blocks ever created and still owned
+  uint64_t total_allocs = 0;     // user allocs served (stats)
+  uint64_t last_use_tick = 0;    // LRU stamp
+};
+
+struct Pool {
+  std::mutex mu;
+  std::map<uint64_t, SizeClassStack> stacks;     // by block size
+  std::unordered_map<void*, uint64_t> block_class;  // ptr -> block size
+  uint64_t max_bytes = 0;        // allocation budget (0 = unlimited)
+  uint64_t owned_bytes = 0;      // all blocks owned (free + in use)
+  uint64_t in_use_bytes = 0;     // handed out to callers
+  uint64_t tick = 0;             // monotonic op counter for LRU
+  std::atomic<uint64_t> failed_allocs{0};
+};
+
+void* raw_alloc(uint64_t size) {
+  void* p = nullptr;
+  if (posix_memalign(&p, kAlignment, size) != 0) return nullptr;
+  return p;
+}
+
+// Frees whole idle stacks, least-recently-used first, until idle bytes
+// fall to `target_idle`.
+void trim_locked(Pool* pool, uint64_t target_idle) {
+  // collect (last_use_tick, block_size) for stacks with idle blocks
+  std::vector<std::pair<uint64_t, uint64_t>> order;
+  for (auto& [size, st] : pool->stacks)
+    if (!st.free_list.empty()) order.emplace_back(st.last_use_tick, size);
+  std::sort(order.begin(), order.end());
+  uint64_t idle = pool->owned_bytes - pool->in_use_bytes;
+  for (auto& [tick, size] : order) {
+    if (idle <= target_idle) break;
+    auto& st = pool->stacks[size];
+    for (void* p : st.free_list) {
+      pool->block_class.erase(p);
+      free(p);
+      pool->owned_bytes -= size;
+      st.total_blocks--;
+      idle -= size;
+    }
+    st.free_list.clear();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* staging_pool_create(uint64_t max_bytes) {
+  auto* pool = new (std::nothrow) Pool();
+  if (pool) pool->max_bytes = max_bytes;
+  return pool;
+}
+
+void staging_pool_destroy(void* handle) {
+  auto* pool = static_cast<Pool*>(handle);
+  if (!pool) return;
+  {
+    std::lock_guard<std::mutex> lock(pool->mu);
+    for (auto& [ptr, size] : pool->block_class) free(ptr);
+    pool->block_class.clear();
+    pool->stacks.clear();
+  }
+  delete pool;
+}
+
+// Returns an aligned buffer of at least `size` bytes (rounded up to a
+// power-of-two class, min 16 KiB), or null if the budget is exhausted.
+void* staging_alloc(void* handle, uint64_t size) {
+  auto* pool = static_cast<Pool*>(handle);
+  if (!pool || size == 0) return nullptr;
+  uint64_t cls = round_up_class(size);
+  std::lock_guard<std::mutex> lock(pool->mu);
+  pool->tick++;
+  auto& st = pool->stacks[cls];
+  st.block_size = cls;
+  st.last_use_tick = pool->tick;
+  st.total_allocs++;
+  if (!st.free_list.empty()) {
+    void* p = st.free_list.back();
+    st.free_list.pop_back();
+    pool->in_use_bytes += cls;
+    return p;
+  }
+  if (pool->max_bytes && pool->owned_bytes + cls > pool->max_bytes) {
+    // over budget: try trimming idle blocks first
+    trim_locked(pool, 0);
+    if (pool->owned_bytes + cls > pool->max_bytes) {
+      pool->failed_allocs++;
+      return nullptr;
+    }
+  }
+  void* p = raw_alloc(cls);
+  if (!p) {
+    pool->failed_allocs++;
+    return nullptr;
+  }
+  pool->block_class[p] = cls;
+  pool->owned_bytes += cls;
+  pool->in_use_bytes += cls;
+  st.total_blocks++;
+  return p;
+}
+
+// Returns a buffer to its size-class stack; trims LRU stacks if idle
+// bytes exceed the trigger fraction of the budget.
+int staging_free(void* handle, void* ptr) {
+  auto* pool = static_cast<Pool*>(handle);
+  if (!pool || !ptr) return -1;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  auto it = pool->block_class.find(ptr);
+  if (it == pool->block_class.end()) return -1;  // double free / foreign ptr
+  uint64_t cls = it->second;
+  pool->tick++;
+  auto& st = pool->stacks[cls];
+  st.free_list.push_back(ptr);
+  st.last_use_tick = pool->tick;
+  pool->in_use_bytes -= cls;
+  if (pool->max_bytes) {
+    uint64_t idle = pool->owned_bytes - pool->in_use_bytes;
+    if (idle > static_cast<uint64_t>(kTrimTriggerFrac * pool->max_bytes)) {
+      trim_locked(pool,
+                  static_cast<uint64_t>(kTrimTargetFrac * pool->max_bytes));
+    }
+  }
+  return 0;
+}
+
+uint64_t staging_block_size(void* handle, void* ptr) {
+  auto* pool = static_cast<Pool*>(handle);
+  if (!pool || !ptr) return 0;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  auto it = pool->block_class.find(ptr);
+  return it == pool->block_class.end() ? 0 : it->second;
+}
+
+// stats[0]=owned, [1]=in_use, [2]=idle, [3]=num_classes, [4]=failed_allocs,
+// [5]=total_allocs
+void staging_pool_stats(void* handle, uint64_t* stats) {
+  auto* pool = static_cast<Pool*>(handle);
+  if (!pool || !stats) return;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  uint64_t total_allocs = 0;
+  for (auto& [size, st] : pool->stacks) total_allocs += st.total_allocs;
+  stats[0] = pool->owned_bytes;
+  stats[1] = pool->in_use_bytes;
+  stats[2] = pool->owned_bytes - pool->in_use_bytes;
+  stats[3] = pool->stacks.size();
+  stats[4] = pool->failed_allocs.load();
+  stats[5] = total_allocs;
+}
+
+// Force-trim idle blocks down to `target_idle_bytes`.
+void staging_pool_trim(void* handle, uint64_t target_idle_bytes) {
+  auto* pool = static_cast<Pool*>(handle);
+  if (!pool) return;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  trim_locked(pool, target_idle_bytes);
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+"""Memory layer: native host staging pool + device (HBM) arena registry."""
+
+from sparkrdma_tpu.memory.staging import StagingBuffer, StagingPool
+from sparkrdma_tpu.memory.arena import ArenaManager, DeviceSegment
+
+__all__ = ["StagingPool", "StagingBuffer", "ArenaManager", "DeviceSegment"]

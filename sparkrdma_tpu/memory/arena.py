@@ -1,0 +1,131 @@
+"""HBM arena registry: registered device segments serving block reads.
+
+The device-side half of the memory layer.  Where the reference mmaps a
+shuffle data file in ≥write-block-size chunks and registers each chunk as
+an ibverbs MR (RdmaMappedFile.java:95-171), here a map task's serialized
+output is staged into one or more ``DeviceSegment``s — uint8 JAX arrays
+resident in HBM — each tagged with an ``mkey``.  A ``BlockLocation``
+then addresses (mkey, byte offset, length) exactly like the reference's
+(mkey, address, length) triple.
+
+``ArenaManager`` is the per-process registry: it assigns mkeys, accounts
+bytes against ``max_buffer_allocation_size``, serves one-sided reads
+(``BlockStore``), and releases segments when a shuffle is unregistered
+(dispose path, RdmaMappedFile.java:189-199).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from sparkrdma_tpu.transport.channel import BlockStore, TransportError
+from sparkrdma_tpu.utils.types import BlockLocation
+
+
+class DeviceSegment:
+    """One registered HBM segment (a uint8 device array)."""
+
+    def __init__(self, mkey: int, array, shuffle_id: Optional[int] = None):
+        self.mkey = mkey
+        self.array = array  # jax.Array uint8[nbytes] (or np.ndarray on host)
+        self.nbytes = int(array.shape[0])
+        self.shuffle_id = shuffle_id
+        self.created_at = time.monotonic()
+
+    def read(self, offset: int, length: int) -> bytes:
+        end = offset + length
+        if offset < 0 or end > self.nbytes:
+            raise TransportError(
+                f"read [{offset},{end}) outside segment mkey={self.mkey} "
+                f"of {self.nbytes}B"
+            )
+        return bytes(np.asarray(self.array[offset:end]))
+
+
+class ArenaManager(BlockStore):
+    """Per-process registry of device segments, keyed by mkey."""
+
+    def __init__(self, max_bytes: int = 0):
+        self.max_bytes = max_bytes
+        self._segments: Dict[int, DeviceSegment] = {}
+        self._lock = threading.Lock()
+        self._next_mkey = 1  # 0 is reserved for BlockLocation.EMPTY
+        self._total_bytes = 0
+        # stats
+        self._registered_ever = 0
+        self._released_ever = 0
+
+    def register(self, array, shuffle_id: Optional[int] = None) -> DeviceSegment:
+        """Register a 1-D uint8 array as a readable segment."""
+        if array.ndim != 1 or str(array.dtype) != "uint8":
+            raise ValueError(
+                f"segments must be 1-D uint8, got {array.shape} {array.dtype}"
+            )
+        nbytes = int(array.shape[0])
+        with self._lock:
+            if self.max_bytes and self._total_bytes + nbytes > self.max_bytes:
+                raise MemoryError(
+                    f"arena budget exhausted: {self._total_bytes + nbytes}B > "
+                    f"{self.max_bytes}B"
+                )
+            mkey = self._next_mkey
+            self._next_mkey += 1
+            seg = DeviceSegment(mkey, array, shuffle_id)
+            self._segments[mkey] = seg
+            self._total_bytes += nbytes
+            self._registered_ever += 1
+        return seg
+
+    def get(self, mkey: int) -> Optional[DeviceSegment]:
+        with self._lock:
+            return self._segments.get(mkey)
+
+    def release(self, mkey: int) -> None:
+        with self._lock:
+            seg = self._segments.pop(mkey, None)
+            if seg is not None:
+                self._total_bytes -= seg.nbytes
+                self._released_ever += 1
+
+    def release_shuffle(self, shuffle_id: int) -> int:
+        """Release all segments belonging to one shuffle (unregister path,
+        reference: RdmaShuffleManager.unregisterShuffle → dispose)."""
+        with self._lock:
+            doomed = [k for k, s in self._segments.items()
+                      if s.shuffle_id == shuffle_id]
+            for k in doomed:
+                seg = self._segments.pop(k)
+                self._total_bytes -= seg.nbytes
+                self._released_ever += 1
+        return len(doomed)
+
+    # -- BlockStore ---------------------------------------------------------
+    def read_block(self, location: BlockLocation) -> bytes:
+        seg = self.get(location.mkey)
+        if seg is None:
+            raise TransportError(f"no segment registered for mkey={location.mkey}")
+        return seg.read(location.address, location.length)
+
+    # -- stats --------------------------------------------------------------
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "segments": len(self._segments),
+                "total_bytes": self._total_bytes,
+                "registered_ever": self._registered_ever,
+                "released_ever": self._released_ever,
+            }
+
+    def stop(self) -> None:
+        with self._lock:
+            self._segments.clear()
+            self._total_bytes = 0

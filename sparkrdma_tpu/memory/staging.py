@@ -1,0 +1,232 @@
+"""Host staging pool: ctypes binding over the native allocator.
+
+The write path serializes shuffle partitions into these page-aligned,
+size-class-pooled host buffers before staging them into HBM arenas —
+the role the reference's registered off-heap buffers play for the NIC
+(RdmaBufferManager.java:35-209, RdmaBuffer.java:32-107).  Backed by
+``native/staging_allocator.cpp`` (built to ``_staging.so``); a
+pure-Python pool with the same policy serves as fallback when the
+native library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_staging.so")
+
+MIN_BLOCK_SIZE = 16 * 1024
+
+STAT_FIELDS = ("owned", "in_use", "idle", "num_classes", "failed_allocs",
+               "total_allocs")
+
+
+def _load_native():
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.staging_pool_create.restype = ctypes.c_void_p
+    lib.staging_pool_create.argtypes = [ctypes.c_uint64]
+    lib.staging_pool_destroy.argtypes = [ctypes.c_void_p]
+    lib.staging_alloc.restype = ctypes.c_void_p
+    lib.staging_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.staging_free.restype = ctypes.c_int
+    lib.staging_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.staging_block_size.restype = ctypes.c_uint64
+    lib.staging_block_size.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.staging_pool_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)
+    ]
+    lib.staging_pool_trim.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+class StagingBuffer:
+    """One pooled, page-aligned host buffer exposed as a numpy view."""
+
+    def __init__(self, pool: "StagingPool", address: int, capacity: int,
+                 view: np.ndarray):
+        self._pool = pool
+        self.address = address
+        self.capacity = capacity
+        self.view = view  # uint8[capacity], zero-copy over the native block
+        self._freed = False
+
+    def free(self) -> None:
+        if not self._freed:
+            self._freed = True
+            self._pool._free(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.free()
+
+
+class StagingPool:
+    """Size-class pooled host buffers with a byte budget and LRU trim.
+
+    Native-backed when ``_staging.so`` is present (``is_native``), else a
+    Python pool with identical policy: power-of-two classes (min 16 KiB),
+    trim idle blocks when idle bytes exceed 90% of the budget, down to
+    65% (reference policy, RdmaBufferManager.java:150-188).
+    """
+
+    def __init__(self, max_bytes: int = 0, force_python: bool = False):
+        self.max_bytes = max_bytes
+        self.is_native = _NATIVE is not None and not force_python
+        self._lock = threading.Lock()
+        self._closed = False
+        if self.is_native:
+            self._handle = _NATIVE.staging_pool_create(
+                ctypes.c_uint64(max_bytes)
+            )
+            if not self._handle:
+                raise MemoryError("staging_pool_create failed")
+        else:
+            # python fallback pool
+            self._free_lists: Dict[int, list] = {}
+            self._owned = 0
+            self._in_use = 0
+            self._tick = 0
+            self._last_use: Dict[int, int] = {}
+            self._failed = 0
+            self._total_allocs = 0
+
+    # -- public API ---------------------------------------------------------
+    def alloc(self, size: int) -> StagingBuffer:
+        if size <= 0:
+            raise ValueError(f"alloc size must be > 0: {size}")
+        if self._closed:
+            raise MemoryError("pool closed")
+        if self.is_native:
+            ptr = _NATIVE.staging_alloc(self._handle, ctypes.c_uint64(size))
+            if not ptr:
+                raise MemoryError(
+                    f"staging pool budget exhausted allocating {size}B "
+                    f"(budget {self.max_bytes}B)"
+                )
+            cap = _NATIVE.staging_block_size(self._handle, ctypes.c_void_p(ptr))
+            raw = (ctypes.c_uint8 * cap).from_address(ptr)
+            view = np.frombuffer(raw, dtype=np.uint8)
+            return StagingBuffer(self, ptr, cap, view)
+        return self._py_alloc(size)
+
+    def stats(self) -> Dict[str, int]:
+        if self.is_native:
+            arr = (ctypes.c_uint64 * 6)()
+            _NATIVE.staging_pool_stats(self._handle, arr)
+            return dict(zip(STAT_FIELDS, (int(x) for x in arr)))
+        with self._lock:
+            idle = self._owned - self._in_use
+            return {
+                "owned": self._owned, "in_use": self._in_use, "idle": idle,
+                "num_classes": len(self._free_lists),
+                "failed_allocs": self._failed,
+                "total_allocs": self._total_allocs,
+            }
+
+    def trim(self, target_idle_bytes: int = 0) -> None:
+        if self.is_native:
+            _NATIVE.staging_pool_trim(
+                self._handle, ctypes.c_uint64(target_idle_bytes)
+            )
+        else:
+            with self._lock:
+                self._py_trim(target_idle_bytes)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.is_native:
+            _NATIVE.staging_pool_destroy(self._handle)
+            self._handle = None
+        else:
+            self._free_lists.clear()
+
+    # -- internals ----------------------------------------------------------
+    def _free(self, buf: StagingBuffer) -> None:
+        if self._closed:
+            return
+        if self.is_native:
+            rc = _NATIVE.staging_free(self._handle, ctypes.c_void_p(buf.address))
+            if rc != 0:
+                logger.warning("staging_free: unknown/double-freed buffer")
+        else:
+            self._py_free(buf)
+
+    @staticmethod
+    def _round_class(size: int) -> int:
+        c = MIN_BLOCK_SIZE
+        while c < size:
+            c <<= 1
+        return c
+
+    def _py_alloc(self, size: int) -> StagingBuffer:
+        cls = self._round_class(size)
+        with self._lock:
+            self._tick += 1
+            self._total_allocs += 1
+            self._last_use[cls] = self._tick
+            lst = self._free_lists.setdefault(cls, [])
+            if lst:
+                view = lst.pop()
+            else:
+                if self.max_bytes and self._owned + cls > self.max_bytes:
+                    self._py_trim(0)
+                    if self._owned + cls > self.max_bytes:
+                        self._failed += 1
+                        raise MemoryError(
+                            f"staging pool budget exhausted allocating {size}B"
+                        )
+                view = np.zeros(cls, dtype=np.uint8)
+                self._owned += cls
+            self._in_use += cls
+        return StagingBuffer(self, view.ctypes.data, cls, view)
+
+    def _py_free(self, buf: StagingBuffer) -> None:
+        cls = buf.capacity
+        with self._lock:
+            self._tick += 1
+            self._last_use[cls] = self._tick
+            self._free_lists.setdefault(cls, []).append(buf.view)
+            self._in_use -= cls
+            if self.max_bytes:
+                idle = self._owned - self._in_use
+                if idle > 0.9 * self.max_bytes:
+                    self._py_trim(int(0.65 * self.max_bytes))
+
+    def _py_trim(self, target_idle: int) -> None:
+        # assumes lock held
+        idle = self._owned - self._in_use
+        order = sorted(
+            (s for s in self._free_lists if self._free_lists[s]),
+            key=lambda s: self._last_use.get(s, 0),
+        )
+        for cls in order:
+            if idle <= target_idle:
+                break
+            n = len(self._free_lists[cls])
+            self._free_lists[cls] = []
+            self._owned -= n * cls
+            idle -= n * cls
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

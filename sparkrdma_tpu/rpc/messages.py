@@ -52,6 +52,11 @@ class RpcMsg:
     def _payload(self) -> bytes:
         raise NotImplementedError
 
+    def _payload_size(self) -> int:
+        """Cheap payload-size estimate used to decide splitting without
+        serializing (subclasses override with arithmetic)."""
+        return len(self._payload())
+
     def _split(self, max_payload: int) -> Sequence["RpcMsg"]:
         """Split into messages whose payloads each fit max_payload.
         Default: no splitting supported."""
@@ -69,26 +74,26 @@ class RpcMsg:
         max_payload = max_segment_size - HEADER_SIZE
         if max_payload <= 0:
             raise ValueError(f"segment size too small: {max_segment_size}")
-        payload = self._payload()
-        if len(payload) <= max_payload:
-            return [self._frame(payload)]
+        size = self._payload_size()
+        if size <= max_payload:
+            return [self._frame(self._payload())]
         parts = self._split(max_payload)
         if len(parts) == 1:
             raise ValueError(
-                f"{type(self).__name__} payload {len(payload)}B exceeds segment "
+                f"{type(self).__name__} payload {size}B exceeds segment "
                 f"size {max_segment_size}B and cannot be split further"
             )
         out: List[bytes] = []
         for p in parts:
-            pp = p._payload()
-            if len(pp) > max_payload:
+            psize = p._payload_size()
+            if psize > max_payload:
                 # an atomic element (e.g. one id with a very long hostname,
                 # or a fixed header) alone exceeds the segment size
                 raise ValueError(
-                    f"{type(self).__name__} segment payload {len(pp)}B still "
+                    f"{type(self).__name__} segment payload {psize}B still "
                     f"exceeds segment size {max_segment_size}B"
                 )
-            out.append(p._frame(pp))
+            out.append(p._frame(p._payload()))
         return out
 
 
@@ -103,7 +108,11 @@ def decode_msg(data: bytes) -> RpcMsg:
     cls = MSG_TYPES.get(msg_type)
     if cls is None:
         raise ValueError(f"unknown RPC message type {msg_type}")
-    return cls._decode_payload(memoryview(data)[HEADER_SIZE:])
+    try:
+        return cls._decode_payload(memoryview(data)[HEADER_SIZE:])
+    except struct.error as e:
+        # malformed frames must surface as ValueError, the decode contract
+        raise ValueError(f"malformed {cls.__name__} frame: {e}") from e
 
 
 # ---------------------------------------------------------------------------
@@ -124,6 +133,9 @@ class HelloMsg(RpcMsg):
         self.shuffle_manager_id.write(buf)
         buf += struct.pack("<i", self.channel_port)
         return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return self.shuffle_manager_id.serialized_length() + 4
 
     @staticmethod
     def _decode_payload(view: memoryview) -> "HelloMsg":
@@ -150,6 +162,9 @@ class AnnounceShuffleManagersMsg(RpcMsg):
         for smid in self.shuffle_manager_ids:
             smid.write(buf)
         return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return 4 + sum(s.serialized_length() for s in self.shuffle_manager_ids)
 
     def _split(self, max_payload: int) -> Sequence["AnnounceShuffleManagersMsg"]:
         parts: List[AnnounceShuffleManagersMsg] = []
@@ -218,6 +233,9 @@ class PublishMapTaskOutputMsg(RpcMsg):
         )
         buf += self.entries
         return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return self.shuffle_manager_id.serialized_length() + 20 + len(self.entries)
 
     def _split(self, max_payload: int) -> Sequence["PublishMapTaskOutputMsg"]:
         fixed = self.shuffle_manager_id.serialized_length() + 20
@@ -299,6 +317,14 @@ class FetchMapStatusMsg(RpcMsg):
             buf += struct.pack("<ii", map_id, reduce_id)
         return bytes(buf)
 
+    def _payload_size(self) -> int:
+        return (
+            self.requester.serialized_length()
+            + self.host.serialized_length()
+            + 20
+            + 8 * len(self.block_ids)
+        )
+
     def _split(self, max_payload: int) -> Sequence["FetchMapStatusMsg"]:
         fixed = (
             self.requester.serialized_length()
@@ -364,6 +390,9 @@ class FetchMapStatusResponseMsg(RpcMsg):
         for loc in self.locations:
             loc.write(buf)
         return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return 16 + LOCATION_ENTRY_SIZE * len(self.locations)
 
     def _split(self, max_payload: int) -> Sequence["FetchMapStatusResponseMsg"]:
         per_seg = max(1, (max_payload - 16) // LOCATION_ENTRY_SIZE)

@@ -64,11 +64,12 @@ class MapTaskOutput:
         self._mark_filled(first, last)
 
     def _mark_filled(self, first: int, last: int) -> None:
+        n = last - first + 1
         with self._lock:
-            for p in range(first, last + 1):
-                if not self._filled_flags[p]:
-                    self._filled_flags[p] = 1
-                    self._filled += 1
+            already = self._filled_flags.count(1, first, last + 1)
+            if already < n:
+                self._filled_flags[first : last + 1] = b"\x01" * n
+                self._filled += n - already
             if self._filled >= self.num_partitions and not self._fill_future.done():
                 self._fill_future.set_result(self)
 

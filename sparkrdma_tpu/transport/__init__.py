@@ -1,0 +1,31 @@
+"""Transport layer: channels, nodes, flow control, loopback backend.
+
+The reference's L4 (RdmaNode/RdmaChannel/RdmaThread over DiSNI verbs,
+SURVEY.md §1).  Here a ``Channel`` carries the same two traffic classes —
+small control RPCs and bulk one-sided block reads — over pluggable
+backends: an in-process loopback for tests and single-host runs, and the
+ICI collective exchange engine (sparkrdma_tpu.parallel) for the
+device-to-device bulk path.
+"""
+
+from sparkrdma_tpu.transport.channel import (
+    Channel,
+    ChannelState,
+    ChannelType,
+    CompletionListener,
+    FnCompletionListener,
+    TransportError,
+)
+from sparkrdma_tpu.transport.node import Node
+from sparkrdma_tpu.transport.loopback import LoopbackNetwork
+
+__all__ = [
+    "Channel",
+    "ChannelState",
+    "ChannelType",
+    "CompletionListener",
+    "FnCompletionListener",
+    "TransportError",
+    "Node",
+    "LoopbackNetwork",
+]

@@ -1,0 +1,252 @@
+"""Channel abstraction: two traffic classes, flow control, completions.
+
+TPU-native re-design of the reference's RdmaChannel
+(RdmaChannel.java:35-873).  Kept semantics:
+
+- Four channel roles (RdmaChannel.java:41): RPC requestor/responder for
+  the driver↔executor control plane, READ requestor/responder for the
+  executor↔executor bulk plane.
+- Send-budget semaphore + FIFO pending queue so posting more work than
+  the queue depth never blocks the caller or drops work
+  (RdmaChannel.java:61-71,379-439).
+- Async completion listeners; ``on_failure`` must tolerate multiple
+  invocations (RdmaCompletionListener.java:25).
+- Channel state machine IDLE → CONNECTING → CONNECTED → ERROR/STOPPED,
+  with sticky ERROR and ``stop()`` failing all outstanding listeners
+  (RdmaChannel.java:103-110,788-869).
+
+Dropped (no analog on TPU): QP/CQ plumbing, recv WR pools, credit
+immediates — XLA owns scheduling on the bulk plane; the loopback backend
+models completion dispatch with a dispatcher thread instead of a CQ
+polling thread.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+from sparkrdma_tpu.utils.types import BlockLocation
+
+
+class TransportError(Exception):
+    """Raised for channel/node failures (connect, send, read, teardown)."""
+
+
+class ChannelType(enum.Enum):
+    RPC_REQUESTOR = "rpc_requestor"
+    RPC_RESPONDER = "rpc_responder"
+    RPC_WRAPPER = "rpc_wrapper"  # bidirectional (driver side of hello-back)
+    READ_REQUESTOR = "read_requestor"
+    READ_RESPONDER = "read_responder"
+
+
+class ChannelState(enum.Enum):
+    IDLE = 0
+    CONNECTING = 1
+    CONNECTED = 2
+    ERROR = 3
+    STOPPED = 4
+
+
+class CompletionListener:
+    """Async completion contract (reference: RdmaCompletionListener.java).
+
+    on_failure may be invoked more than once and must tolerate it.
+    """
+
+    def on_success(self, result) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def on_failure(self, error: BaseException) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FnCompletionListener(CompletionListener):
+    def __init__(self, on_success: Callable = None, on_failure: Callable = None):
+        self._ok = on_success or (lambda r: None)
+        self._err = on_failure or (lambda e: None)
+
+    def on_success(self, result) -> None:
+        self._ok(result)
+
+    def on_failure(self, error: BaseException) -> None:
+        self._err(error)
+
+
+class Channel:
+    """Base channel: state machine + send budgeting.
+
+    Subclasses implement ``_post_rpc`` and ``_post_read`` which perform
+    the actual transfer and MUST call ``_complete(listener, result)`` or
+    ``_fail(listener, err)`` exactly once when done (possibly on another
+    thread), then ``_release_budget()``.
+    """
+
+    def __init__(self, channel_type: ChannelType, send_queue_depth: int = 4096):
+        self.channel_type = channel_type
+        self._state = ChannelState.IDLE
+        self._state_lock = threading.Lock()
+        # send-WR budget: number of outstanding posted operations
+        self._budget = threading.Semaphore(send_queue_depth)
+        self._send_queue_depth = send_queue_depth
+        self._pending: deque = deque()  # (post_fn, listener)
+        self._pending_lock = threading.Lock()
+        self._outstanding: set = set()  # listeners awaiting completion
+        self._outstanding_lock = threading.Lock()
+
+    # -- state machine ------------------------------------------------------
+    @property
+    def state(self) -> ChannelState:
+        return self._state
+
+    def is_connected(self) -> bool:
+        return self._state == ChannelState.CONNECTED
+
+    def _set_state(self, new: ChannelState) -> None:
+        with self._state_lock:
+            if self._state in (ChannelState.ERROR, ChannelState.STOPPED):
+                return  # sticky terminal states
+            self._state = new
+
+    def _check_usable(self) -> None:
+        if self._state != ChannelState.CONNECTED:
+            raise TransportError(
+                f"channel not connected (state={self._state.name})"
+            )
+
+    # -- public API ---------------------------------------------------------
+    def send_rpc(self, frames: Sequence[bytes], listener: CompletionListener) -> None:
+        """Post control-plane frames (reference: rdmaSendInQueue,
+        RdmaChannel.java:476-505).  Never blocks: if the send budget is
+        exhausted the operation is queued FIFO."""
+        self._check_usable()
+        self._enqueue(lambda: self._post_rpc(list(frames), listener), listener)
+
+    def read_blocks(
+        self, locations: Sequence[BlockLocation], listener: CompletionListener
+    ) -> None:
+        """Post a scatter read of remote blocks — the one-sided RDMA READ
+        analog (reference: rdmaReadInQueue, RdmaChannel.java:441-474).
+        Completion delivers a list of ``bytes``, one per location."""
+        self._check_usable()
+        self._enqueue(lambda: self._post_read(list(locations), listener), listener)
+
+    def stop(self) -> None:
+        """Teardown: fail every outstanding / pending listener
+        (reference: RdmaChannel.java:788-869)."""
+        with self._state_lock:
+            if self._state == ChannelState.STOPPED:
+                return
+            self._state = ChannelState.STOPPED
+        err = TransportError("channel stopped")
+        with self._pending_lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for _, listener in pending:
+            self._safe_fail(listener, err)
+        with self._outstanding_lock:
+            outstanding = list(self._outstanding)
+            self._outstanding.clear()
+        for listener in outstanding:
+            self._safe_fail(listener, err)
+
+    # -- budget / pending machinery -----------------------------------------
+    def _enqueue(self, post_fn: Callable[[], None], listener: CompletionListener):
+        if self._budget.acquire(blocking=False):
+            self._track(listener)
+            self._run_post(post_fn, listener)
+        else:
+            with self._pending_lock:
+                self._pending.append((post_fn, listener))
+
+    def _run_post(self, post_fn, listener) -> None:
+        try:
+            post_fn()
+        except BaseException as e:  # posting failed synchronously
+            self._error(e)
+            self._fail(listener, e)
+            self._release_budget()
+
+    def _track(self, listener) -> None:
+        with self._outstanding_lock:
+            self._outstanding.add(listener)
+
+    def _release_budget(self) -> None:
+        """Called after each completion; drains one pending op
+        (reference: exhaustCq draining pendingSends)."""
+        with self._pending_lock:
+            nxt = self._pending.popleft() if self._pending else None
+        if nxt is None:
+            self._budget.release()
+            return
+        post_fn, listener = nxt
+        self._track(listener)
+        self._run_post(post_fn, listener)
+
+    # -- completion plumbing ------------------------------------------------
+    def _untrack(self, listener) -> None:
+        with self._outstanding_lock:
+            self._outstanding.discard(listener)
+
+    def _complete(self, listener: CompletionListener, result) -> None:
+        self._untrack(listener)
+        try:
+            listener.on_success(result)
+        except BaseException:
+            pass
+
+    def _fail(self, listener: CompletionListener, err: BaseException) -> None:
+        self._untrack(listener)
+        self._safe_fail(listener, err)
+
+    @staticmethod
+    def _safe_fail(listener: CompletionListener, err: BaseException) -> None:
+        try:
+            listener.on_failure(err)
+        except BaseException:
+            pass
+
+    def _error(self, err: BaseException) -> None:
+        """Flip to sticky ERROR (reference: completion-with-error path,
+        RdmaChannel.java:611-637)."""
+        with self._state_lock:
+            if self._state not in (ChannelState.STOPPED,):
+                self._state = ChannelState.ERROR
+
+    # -- subclass hooks -----------------------------------------------------
+    def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:
+        raise NotImplementedError
+
+    def _post_read(
+        self, locations: List[BlockLocation], listener: CompletionListener
+    ) -> None:
+        raise NotImplementedError
+
+
+class BlockStore:
+    """Registered-memory domain served by a node: resolves a
+    BlockLocation's (mkey, address, length) to bytes — what the NIC does
+    for a one-sided READ against an lkey/rkey in the reference."""
+
+    def read_block(self, location: BlockLocation) -> bytes:  # pragma: no cover
+        raise NotImplementedError
+
+
+class BytesBlockStore(BlockStore):
+    """Host-memory block store over one contiguous buffer; ``address``
+    is the byte offset within it."""
+
+    def __init__(self, data: bytes):
+        self._view = memoryview(data)
+
+    def read_block(self, location: BlockLocation) -> bytes:
+        end = location.address + location.length
+        if location.address < 0 or end > len(self._view):
+            raise TransportError(
+                f"read [{location.address},{end}) outside store of "
+                f"{len(self._view)}B"
+            )
+        return bytes(self._view[location.address : end])

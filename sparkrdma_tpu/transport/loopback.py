@@ -1,0 +1,177 @@
+"""In-process loopback transport: the test/fallback backend.
+
+The reference has no fake transport (its only backend is real verbs,
+SURVEY.md §4) — this backend is the test harness the rebuild adds: a
+process-local "network" of Nodes where
+
+- ``send_rpc`` delivers frames to the peer node's receive dispatcher on
+  the peer's dispatcher pool (async, like SEND/RECV + CQ thread), and
+- ``read_blocks`` pulls bytes straight out of the peer node's registered
+  block stores with *no peer-side handler involved* — faithfully modeling
+  the one-sided RDMA READ data plane (the "remote CPU never serves
+  reads" property, SURVEY.md §2 backend notes).
+
+Failure injection: ``partition(addr)`` refuses new connects and kills
+in-flight ops to that address; ``Channel.inject_error()`` flips a single
+channel to sticky ERROR, failing its outstanding ops — exercising the
+same failure semantics the reference gets from CQ error completions.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from sparkrdma_tpu.transport.channel import (
+    Channel,
+    ChannelState,
+    ChannelType,
+    CompletionListener,
+    TransportError,
+)
+from sparkrdma_tpu.transport.node import Address, Node
+
+_PAIRED = {
+    ChannelType.RPC_REQUESTOR: ChannelType.RPC_RESPONDER,
+    ChannelType.RPC_WRAPPER: ChannelType.RPC_WRAPPER,
+    ChannelType.READ_REQUESTOR: ChannelType.READ_RESPONDER,
+}
+
+
+class LoopbackChannel(Channel):
+    """One direction of an in-process channel pair."""
+
+    def __init__(
+        self,
+        channel_type: ChannelType,
+        local: Node,
+        remote: Node,
+        network: "LoopbackNetwork",
+        send_queue_depth: int,
+    ):
+        super().__init__(channel_type, send_queue_depth)
+        self.local = local
+        self.remote = remote
+        self.network = network
+        self.peer_channel: Optional["LoopbackChannel"] = None
+
+    # -- posting ------------------------------------------------------------
+    def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:
+        def deliver():
+            try:
+                if self.network.is_partitioned(self.local.address, self.remote.address):
+                    raise TransportError(
+                        f"network partition to {self.remote.address}"
+                    )
+                if self.state != ChannelState.CONNECTED:
+                    raise TransportError("channel not connected")
+                target = self.peer_channel if self.peer_channel is not None else self
+                for frame in frames:
+                    self.remote.dispatch_frame(target, bytes(frame))
+            except BaseException as e:
+                self._error(e)
+                self._fail(listener, e)
+            else:
+                self._complete(listener, None)
+            finally:
+                self._release_budget()
+
+        self.local.submit(deliver)
+
+    def _post_read(self, locations, listener: CompletionListener) -> None:
+        def deliver():
+            try:
+                if self.network.is_partitioned(self.local.address, self.remote.address):
+                    raise TransportError(
+                        f"network partition to {self.remote.address}"
+                    )
+                if self.state != ChannelState.CONNECTED:
+                    raise TransportError("channel not connected")
+                # one-sided: read directly from the peer's registered memory
+                data = [self.remote.read_local_block(loc) for loc in locations]
+            except BaseException as e:
+                self._error(e)
+                self._fail(listener, e)
+            else:
+                self._complete(listener, data)
+            finally:
+                self._release_budget()
+
+        self.local.submit(deliver)
+
+    # -- failure injection --------------------------------------------------
+    def inject_error(self) -> None:
+        self._error(TransportError("injected channel error"))
+        err = TransportError("injected channel error")
+        with self._outstanding_lock:
+            outstanding = list(self._outstanding)
+            self._outstanding.clear()
+        for l in outstanding:
+            self._safe_fail(l, err)
+
+    def reply_channel(self) -> Channel:
+        """Channel on which the receiver of a frame answers.  Frames are
+        dispatched tagged with the receiver-owned reverse channel, so the
+        reply path is this very channel."""
+        return self
+
+
+class LoopbackNetwork:
+    """Registry of in-process nodes + connector, with failure injection."""
+
+    def __init__(self):
+        self._nodes: Dict[Address, Node] = {}
+        self._lock = threading.Lock()
+        self._partitioned: set = set()  # frozenset({a, b}) pairs or single addr
+
+    # -- membership ---------------------------------------------------------
+    def register(self, node: Node) -> None:
+        with self._lock:
+            if node.address in self._nodes:
+                raise TransportError(f"address already bound: {node.address}")
+            self._nodes[node.address] = node
+
+    def unregister(self, node: Node) -> None:
+        with self._lock:
+            self._nodes.pop(node.address, None)
+
+    def lookup(self, address: Address) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(address)
+
+    # -- failure injection --------------------------------------------------
+    def partition(self, address: Address) -> None:
+        """Cut an endpoint off (executor loss)."""
+        with self._lock:
+            self._partitioned.add(address)
+
+    def heal(self, address: Address) -> None:
+        with self._lock:
+            self._partitioned.discard(address)
+
+    def is_partitioned(self, a: Address, b: Address) -> bool:
+        with self._lock:
+            return a in self._partitioned or b in self._partitioned
+
+    # -- connector (passed to Node.get_channel) -----------------------------
+    def connect(
+        self, src: Node, peer: Address, channel_type: ChannelType
+    ) -> Channel:
+        """CM-handshake analog: create the channel pair, register the
+        passive side with the acceptor (RdmaNode CM listener accepting
+        CONNECT_REQUEST, RdmaNode.java:114-214)."""
+        dst = self.lookup(peer)
+        if dst is None:
+            raise TransportError(f"connection refused: no node at {peer}")
+        if self.is_partitioned(src.address, peer):
+            raise TransportError(f"network partition to {peer}")
+        depth = src.conf.send_queue_depth
+        fwd = LoopbackChannel(channel_type, src, dst, self, depth)
+        back_type = _PAIRED.get(channel_type, channel_type)
+        bwd = LoopbackChannel(back_type, dst, src, self, depth)
+        fwd.peer_channel = bwd
+        bwd.peer_channel = fwd
+        fwd._set_state(ChannelState.CONNECTED)
+        bwd._set_state(ChannelState.CONNECTED)
+        dst.register_passive_channel(bwd)
+        return fwd

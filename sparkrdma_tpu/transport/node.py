@@ -1,0 +1,196 @@
+"""Per-process transport endpoint: channel cache, dispatch, teardown.
+
+TPU-native re-design of the reference's RdmaNode (RdmaNode.java:36-397):
+one ``Node`` per process (driver and each executor) owning
+
+- the process's listening address,
+- the receive dispatcher for incoming control-plane frames (the
+  reference's receiveListener wiring),
+- the block-store registry serving one-sided reads (the PD + registered
+  MRs in the reference; HBM arenas / host stores here),
+- an active-channel cache with racy-create resolution and bounded
+  connect retries (RdmaNode.java:277-351),
+- parallel teardown of all channels on stop (RdmaNode.java:353-394).
+
+The CM event channel / listening thread has no analog: backends
+(loopback now, ICI exchange for bulk) register passive channels directly
+via ``register_passive_channel``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.transport.channel import (
+    BlockStore,
+    Channel,
+    ChannelType,
+    TransportError,
+)
+from sparkrdma_tpu.utils.types import BlockLocation
+
+logger = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+
+# Frames arriving on a channel are handed to: (source_channel, frame_bytes)
+ReceiveListener = Callable[[Channel, bytes], None]
+
+
+class Node:
+    """One transport endpoint per process."""
+
+    def __init__(
+        self,
+        address: Address,
+        conf: Optional[TpuShuffleConf] = None,
+        is_executor: bool = False,
+    ):
+        self.address = address
+        self.conf = conf or TpuShuffleConf()
+        self.is_executor = is_executor
+        self._receive_listener: Optional[ReceiveListener] = None
+        self._block_stores: Dict[int, BlockStore] = {}
+        self._block_store_lock = threading.Lock()
+        # active (locally initiated) channels keyed by (peer, type)
+        self._active: Dict[Tuple[Address, ChannelType], Channel] = {}
+        self._active_lock = threading.Lock()
+        self._passive: List[Channel] = []
+        self._passive_lock = threading.Lock()
+        # completion/dispatch pool — the RdmaThread analog: completions and
+        # inbound frames are delivered off the caller's thread
+        self._dispatcher = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"node-{address[0]}:{address[1]}"
+        )
+        self._stopped = threading.Event()
+
+    # -- receive dispatch ---------------------------------------------------
+    def set_receive_listener(self, listener: ReceiveListener) -> None:
+        self._receive_listener = listener
+
+    def dispatch_frame(self, channel: Channel, frame: bytes) -> None:
+        """Deliver one inbound control-plane frame on the dispatcher."""
+        if self._stopped.is_set():
+            return
+        listener = self._receive_listener
+        if listener is None:
+            logger.warning("%s: dropping frame, no receive listener", self)
+            return
+        self._dispatcher.submit(self._safe_dispatch, listener, channel, frame)
+
+    @staticmethod
+    def _safe_dispatch(listener, channel, frame) -> None:
+        try:
+            listener(channel, frame)
+        except BaseException:
+            logger.exception("receive listener raised")
+
+    def submit(self, fn, *args):
+        """Run fn on the dispatcher (async completion delivery)."""
+        return self._dispatcher.submit(fn, *args)
+
+    # -- block stores (registered memory domains) ---------------------------
+    def register_block_store(self, mkey: int, store: BlockStore) -> None:
+        with self._block_store_lock:
+            self._block_stores[mkey] = store
+
+    def unregister_block_store(self, mkey: int) -> None:
+        with self._block_store_lock:
+            self._block_stores.pop(mkey, None)
+
+    def read_local_block(self, location: BlockLocation) -> bytes:
+        """Serve a one-sided read against this node's registered memory."""
+        with self._block_store_lock:
+            store = self._block_stores.get(location.mkey)
+        if store is None:
+            raise TransportError(
+                f"{self}: no block store registered for mkey={location.mkey}"
+            )
+        return store.read_block(location)
+
+    # -- channel cache ------------------------------------------------------
+    def get_channel(
+        self,
+        peer: Address,
+        channel_type: ChannelType,
+        connect: Callable[["Node", Address, ChannelType], Channel],
+        must_retry: bool = True,
+    ) -> Channel:
+        """Get-or-create a channel to ``peer``.
+
+        ``connect`` is the backend's connector.  Mirrors the reference's
+        racy-create + retry loop (RdmaNode.java:277-351): concurrent
+        callers race benignly, losers close their extra channel; dead
+        cached channels are replaced up to max_connection_attempts.
+        """
+        attempts = 0
+        last_err: Optional[BaseException] = None
+        max_attempts = self.conf.max_connection_attempts if must_retry else 1
+        key = (peer, channel_type)
+        while attempts < max_attempts and not self._stopped.is_set():
+            attempts += 1
+            with self._active_lock:
+                ch = self._active.get(key)
+            if ch is not None and ch.is_connected():
+                return ch
+            try:
+                new_ch = connect(self, peer, channel_type)
+            except BaseException as e:
+                last_err = e
+                time.sleep(min(0.05 * attempts, 0.5))
+                continue
+            with self._active_lock:
+                cur = self._active.get(key)
+                if cur is not None and cur.is_connected():
+                    winner, loser = cur, new_ch  # lost the race
+                else:
+                    self._active[key] = new_ch
+                    winner, loser = new_ch, cur
+            if loser is not None:
+                loser.stop()
+            if winner.is_connected():
+                return winner
+            with self._active_lock:
+                if self._active.get(key) is winner:
+                    del self._active[key]
+            last_err = TransportError("channel died immediately after connect")
+        raise TransportError(
+            f"{self}: could not connect to {peer} ({channel_type.name}) "
+            f"after {attempts} attempts"
+        ) from last_err
+
+    def register_passive_channel(self, channel: Channel) -> None:
+        with self._passive_lock:
+            self._passive.append(channel)
+
+    def active_channels(self) -> List[Channel]:
+        with self._active_lock:
+            return list(self._active.values())
+
+    # -- teardown -----------------------------------------------------------
+    def stop(self) -> None:
+        """Parallel teardown of all channels (RdmaNode.java:353-394)."""
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._active_lock:
+            actives = list(self._active.values())
+            self._active.clear()
+        with self._passive_lock:
+            passives = list(self._passive)
+            self._passive.clear()
+        channels = actives + passives
+        if channels:
+            with ThreadPoolExecutor(max_workers=min(8, len(channels))) as pool:
+                list(pool.map(lambda c: c.stop(), channels))
+        self._dispatcher.shutdown(wait=True)
+        with self._block_store_lock:
+            self._block_stores.clear()
+
+    def __repr__(self) -> str:
+        return f"Node({self.address[0]}:{self.address[1]})"

@@ -73,9 +73,17 @@ def _write_utf8(buf: bytearray, s: str) -> None:
 
 
 def _read_utf8(view: memoryview, offset: int) -> Tuple[str, int]:
+    if offset + 2 > len(view):
+        raise ValueError(f"truncated string header at offset {offset}")
     (n,) = struct.unpack_from("<H", view, offset)
-    s = bytes(view[offset + 2 : offset + 2 + n]).decode("utf-8")
-    return s, offset + 2 + n
+    end = offset + 2 + n
+    if end > len(view):
+        raise ValueError(
+            f"truncated string: need {n}B at offset {offset + 2}, "
+            f"have {len(view) - offset - 2}B"
+        )
+    s = bytes(view[offset + 2 : end]).decode("utf-8")
+    return s, end
 
 
 @dataclass(frozen=True, slots=True)

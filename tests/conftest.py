@@ -6,7 +6,7 @@ device-count spoofing via --xla_force_host_platform_device_count).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override any preset TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,6 +14,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# The environment's sitecustomize registers the real-TPU ("axon") backend
+# via jax.config, which overrides JAX_PLATFORMS from the env — force the
+# spoofed-CPU mesh back on for tests.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,136 @@
+"""Memory layer: native staging pool + device arenas
+(SURVEY.md §2 rows RdmaBufferManager/RdmaBuffer/RdmaMappedFile)."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.memory import ArenaManager, StagingPool
+from sparkrdma_tpu.memory.staging import MIN_BLOCK_SIZE
+from sparkrdma_tpu.transport.channel import TransportError
+from sparkrdma_tpu.utils.types import BlockLocation
+
+
+@pytest.fixture(params=["native", "python"])
+def pool(request):
+    p = StagingPool(max_bytes=4 << 20, force_python=(request.param == "python"))
+    if request.param == "native":
+        assert p.is_native, "native _staging.so should be built (make -C native)"
+    yield p
+    p.close()
+
+
+def test_alloc_rounds_to_size_class(pool):
+    buf = pool.alloc(100)
+    assert buf.capacity == MIN_BLOCK_SIZE
+    buf2 = pool.alloc(MIN_BLOCK_SIZE + 1)
+    assert buf2.capacity == MIN_BLOCK_SIZE * 2
+    buf.free()
+    buf2.free()
+
+
+def test_view_is_writable_and_reusable(pool):
+    with pool.alloc(1024) as buf:
+        buf.view[:4] = [1, 2, 3, 4]
+        addr1 = buf.address
+        assert list(buf.view[:4]) == [1, 2, 3, 4]
+    # freed block returns to its class stack; next alloc reuses it
+    with pool.alloc(1024) as buf2:
+        assert buf2.address == addr1
+
+
+def test_budget_and_stats(pool):
+    stats0 = pool.stats()
+    bufs = [pool.alloc(1 << 20) for _ in range(3)]  # 3 MiB in 1 MiB classes
+    s = pool.stats()
+    assert s["in_use"] == 3 * (1 << 20)
+    assert s["owned"] >= s["in_use"]
+    bufs.append(pool.alloc(1 << 20))  # 4th fits the 4 MiB budget exactly
+    with pytest.raises(MemoryError):
+        pool.alloc(1 << 20)  # 5th exceeds it
+    for b in bufs:
+        b.free()
+    s2 = pool.stats()
+    assert s2["in_use"] == 0
+    assert s2["failed_allocs"] >= 1
+
+
+def test_trim_frees_idle(pool):
+    bufs = [pool.alloc(1 << 20) for _ in range(3)]
+    for b in bufs:
+        b.free()
+    assert pool.stats()["idle"] >= 3 * (1 << 20) * 0.9 or pool.stats()["idle"] == 0
+    pool.trim(0)
+    assert pool.stats()["idle"] == 0
+    assert pool.stats()["owned"] == pool.stats()["in_use"] == 0
+
+
+def test_double_free_is_safe(pool):
+    buf = pool.alloc(64)
+    buf.free()
+    buf.free()  # no-op, no crash
+    assert pool.stats()["in_use"] == 0
+
+
+def test_auto_trim_keeps_idle_below_budget():
+    # fill to the budget, free everything: idle > 90% triggers trim to 65%
+    p = StagingPool(max_bytes=2 << 20)
+    bufs = [p.alloc(256 << 10) for _ in range(8)]  # 2 MiB
+    for b in bufs:
+        b.free()
+    idle = p.stats()["idle"]
+    assert idle <= 0.66 * (2 << 20)
+    p.close()
+
+
+# -- arenas -----------------------------------------------------------------
+
+
+def test_arena_register_read_release(devices):
+    import jax.numpy as jnp
+
+    mgr = ArenaManager()
+    data = np.arange(4096, dtype=np.uint8)
+    seg = mgr.register(jnp.asarray(data), shuffle_id=3)
+    assert seg.mkey >= 1
+    loc = BlockLocation(address=100, length=16, mkey=seg.mkey)
+    assert mgr.read_block(loc) == bytes(data[100:116])
+    assert mgr.total_bytes == 4096
+    mgr.release(seg.mkey)
+    with pytest.raises(TransportError):
+        mgr.read_block(loc)
+    assert mgr.total_bytes == 0
+
+
+def test_arena_release_by_shuffle(devices):
+    import jax.numpy as jnp
+
+    mgr = ArenaManager()
+    for sid in (1, 1, 2):
+        mgr.register(jnp.zeros(1024, dtype=jnp.uint8), shuffle_id=sid)
+    assert mgr.stats()["segments"] == 3
+    freed = mgr.release_shuffle(1)
+    assert freed == 2
+    assert mgr.stats()["segments"] == 1
+    assert mgr.total_bytes == 1024
+
+
+def test_arena_budget_and_validation(devices):
+    import jax.numpy as jnp
+
+    mgr = ArenaManager(max_bytes=2048)
+    mgr.register(jnp.zeros(2048, dtype=jnp.uint8))
+    with pytest.raises(MemoryError):
+        mgr.register(jnp.zeros(1, dtype=jnp.uint8))
+    with pytest.raises(ValueError):
+        mgr.register(jnp.zeros((2, 2), dtype=jnp.uint8))
+    with pytest.raises(ValueError):
+        mgr.register(jnp.zeros(4, dtype=jnp.float32))
+
+
+def test_arena_out_of_bounds_read(devices):
+    import jax.numpy as jnp
+
+    mgr = ArenaManager()
+    seg = mgr.register(jnp.zeros(64, dtype=jnp.uint8))
+    with pytest.raises(TransportError):
+        mgr.read_block(BlockLocation(60, 8, seg.mkey))

@@ -130,3 +130,27 @@ def test_oversized_atomic_element_raises_not_recurses():
     msg = AnnounceShuffleManagersMsg([big, smid(1)])
     with pytest.raises(ValueError, match="exceeds segment size"):
         msg.encode_segments(max_segment_size=256)
+
+
+def test_payload_size_estimates_match_actual():
+    # the no-serialize split decision must agree with real payload sizes
+    locs = [BlockLocation(i, i, 1) for i in range(10)]
+    msgs = [
+        HelloMsg(smid(1), 7),
+        AnnounceShuffleManagersMsg([smid(i) for i in range(5)]),
+        PublishMapStub := PublishMapTaskOutputMsg(
+            smid(2), 1, 2, 4, 0, 3, b"\x00" * 64),
+        FetchMapStatusMsg(smid(3), smid(4), 1, 2, [(0, 1), (2, 3)]),
+        FetchMapStatusResponseMsg(1, 10, 0, locs),
+    ]
+    for m in msgs:
+        assert m._payload_size() == len(m._payload()), type(m).__name__
+
+
+def test_malformed_frame_raises_valueerror_not_struct_error():
+    # reviewer finding: truncated string payloads must surface as ValueError
+    import struct as _s
+    bogus_payload = _s.pack("<H", 1000) + b"ab"  # claims 1000-byte string
+    frame = _s.pack("<ii", 8 + len(bogus_payload), 1) + bogus_payload
+    with pytest.raises(ValueError):
+        decode_msg(frame)

@@ -1,0 +1,212 @@
+"""Transport layer: loopback channels, flow control, failure semantics
+(SURVEY.md §2 rows RdmaNode/RdmaChannel; §5 failure detection)."""
+
+import threading
+import time
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.transport import (
+    Channel,
+    ChannelType,
+    FnCompletionListener,
+    LoopbackNetwork,
+    Node,
+    TransportError,
+)
+from sparkrdma_tpu.transport.channel import BytesBlockStore
+from sparkrdma_tpu.utils.types import BlockLocation
+
+
+@pytest.fixture()
+def net():
+    network = LoopbackNetwork()
+    nodes = []
+
+    def make_node(port, **kw):
+        node = Node(("127.0.0.1", port), **kw)
+        network.register(node)
+        nodes.append(node)
+        return node
+
+    yield network, make_node
+    for n in nodes:
+        n.stop()
+
+
+def wait_for(event, timeout=5.0):
+    assert event.wait(timeout), "timed out"
+
+
+def test_rpc_roundtrip(net):
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    got = []
+    done = threading.Event()
+    b.set_receive_listener(lambda ch, frame: (got.append(frame), done.set()))
+    ch = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, network.connect)
+    sent = threading.Event()
+    ch.send_rpc([b"hello-frame"], FnCompletionListener(lambda r: sent.set()))
+    wait_for(sent)
+    wait_for(done)
+    assert got == [b"hello-frame"]
+
+
+def test_rpc_reply_channel(net):
+    """Responder can answer on the reverse channel (driver↔executor RPC)."""
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    reply_done = threading.Event()
+    replies = []
+
+    def b_listener(ch, frame):
+        ch.reply_channel().send_rpc(
+            [b"re:" + frame], FnCompletionListener()
+        )
+
+    b.set_receive_listener(b_listener)
+    a.set_receive_listener(
+        lambda ch, frame: (replies.append(frame), reply_done.set())
+    )
+    ch = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, network.connect)
+    ch.send_rpc([b"ping"], FnCompletionListener())
+    wait_for(reply_done)
+    assert replies == [b"re:ping"]
+
+
+def test_one_sided_read(net):
+    """read_blocks pulls from the peer's block store without any peer
+    receive listener — the one-sided READ property."""
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    # note: b has NO receive listener at all
+    payload = bytes(range(256)) * 16
+    b.register_block_store(7, BytesBlockStore(payload))
+    ch = a.get_channel(b.address, ChannelType.READ_REQUESTOR, network.connect)
+    result, done = [], threading.Event()
+    locs = [BlockLocation(0, 16, 7), BlockLocation(256, 32, 7), BlockLocation(4000, 8, 7)]
+    ch.read_blocks(locs, FnCompletionListener(lambda r: (result.append(r), done.set())))
+    wait_for(done)
+    blocks = result[0]
+    assert blocks == [payload[0:16], payload[256:288], payload[4000:4008]]
+
+
+def test_read_unknown_mkey_fails(net):
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    ch = a.get_channel(b.address, ChannelType.READ_REQUESTOR, network.connect)
+    errs, done = [], threading.Event()
+    ch.read_blocks(
+        [BlockLocation(0, 4, 99)],
+        FnCompletionListener(on_failure=lambda e: (errs.append(e), done.set())),
+    )
+    wait_for(done)
+    assert isinstance(errs[0], TransportError)
+
+
+def test_connect_refused_and_retries(net):
+    network, make_node = net
+    a = make_node(9000, conf=TpuShuffleConf({"spark.shuffle.tpu.maxConnectionAttempts": 2}))
+    with pytest.raises(TransportError, match="could not connect"):
+        a.get_channel(("127.0.0.1", 9999), ChannelType.RPC_REQUESTOR, network.connect)
+
+
+def test_channel_cache_reuse(net):
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    c1 = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, network.connect)
+    c2 = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, network.connect)
+    assert c1 is c2
+    c3 = a.get_channel(b.address, ChannelType.READ_REQUESTOR, network.connect)
+    assert c3 is not c1  # separate channel per traffic class
+
+
+def test_partition_fails_inflight_and_reconnect_after_heal(net):
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    b.register_block_store(1, BytesBlockStore(b"x" * 64))
+    ch = a.get_channel(b.address, ChannelType.READ_REQUESTOR, network.connect)
+    network.partition(b.address)
+    errs, done = [], threading.Event()
+    ch.read_blocks(
+        [BlockLocation(0, 4, 1)],
+        FnCompletionListener(on_failure=lambda e: (errs.append(e), done.set())),
+    )
+    wait_for(done)
+    assert isinstance(errs[0], TransportError)
+    # channel went sticky-ERROR; cache must replace it after heal
+    network.heal(b.address)
+    ch2 = a.get_channel(b.address, ChannelType.READ_REQUESTOR, network.connect)
+    assert ch2 is not ch
+    ok, done2 = [], threading.Event()
+    ch2.read_blocks(
+        [BlockLocation(0, 4, 1)],
+        FnCompletionListener(lambda r: (ok.append(r), done2.set())),
+    )
+    wait_for(done2)
+    assert ok[0] == [b"xxxx"]
+
+
+def test_stop_fails_outstanding_listeners(net):
+    network, make_node = net
+    a = make_node(9000)
+    b = make_node(9001)
+    ch = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, network.connect)
+    errs = []
+    # stop the channel; queued-but-never-posted ops must fail too
+    ch.stop()
+    done = threading.Event()
+    with pytest.raises(TransportError):
+        ch.send_rpc([b"x"], FnCompletionListener(on_failure=lambda e: done.set()))
+
+
+def test_send_budget_queues_instead_of_dropping(net):
+    """More posts than queue depth: all must eventually complete (the
+    pending-deque drain, reference RdmaChannel.java:379-439)."""
+    network, make_node = net
+    conf = TpuShuffleConf({"spark.shuffle.tpu.sendQueueDepth": 256})
+    a = make_node(9000, conf=conf)
+    b = make_node(9001)
+    n_msgs = 1000  # > depth 256
+    seen = []
+    all_seen = threading.Event()
+
+    def listener(ch, frame):
+        seen.append(frame)
+        if len(seen) == n_msgs:
+            all_seen.set()
+
+    b.set_receive_listener(listener)
+    ch = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, network.connect)
+    completed = []
+    all_done = threading.Event()
+
+    def ok(_):
+        completed.append(1)
+        if len(completed) == n_msgs:
+            all_done.set()
+
+    for i in range(n_msgs):
+        ch.send_rpc([b"m%d" % i], FnCompletionListener(ok))
+    wait_for(all_done, 10)
+    wait_for(all_seen, 10)
+    assert len(seen) == n_msgs
+
+
+def test_node_stop_parallel_teardown(net):
+    network, make_node = net
+    a = make_node(9000)
+    peers = [make_node(9001 + i) for i in range(5)]
+    chans = [
+        a.get_channel(p.address, ChannelType.RPC_REQUESTOR, network.connect)
+        for p in peers
+    ]
+    a.stop()
+    assert all(not c.is_connected() for c in chans)
