@@ -1,0 +1,15 @@
+"""Device-side ops: partitioning, hashing, segment reductions, sort helpers."""
+
+from sparkrdma_tpu.ops.partition import (
+    hash_partition_ids,
+    make_range_splitters,
+    partition_to_buckets,
+    range_partition_ids,
+)
+
+__all__ = [
+    "hash_partition_ids",
+    "range_partition_ids",
+    "make_range_splitters",
+    "partition_to_buckets",
+]

@@ -1,0 +1,113 @@
+"""On-device partitioning: the map side of the shuffle, as XLA programs.
+
+The reference's map side partitions records by ``dependency.partitioner``
+on the CPU while sorting/spilling (RdmaWrapperShuffleWriter.scala:126-128
+reusing Spark's sort-shuffle writers).  On TPU the records for the
+array-native path already live in HBM, so partitioning is a device
+program: compute a partition id per element (hash or range), then bucket
+elements into a ``[n_parts, capacity]`` layout that all_to_all can move
+— static shapes, so buckets are capacity-padded and overflow is
+*detected* (count > capacity) rather than spilled; callers re-run with a
+larger capacity on overflow (the ``maxAggBlock``-style cap inverted for
+SPMD).
+
+Everything here is jit-compatible: no data-dependent shapes, no Python
+branches on traced values.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def hash_partition_ids(keys: jax.Array, n_parts: int) -> jax.Array:
+    """Partition id per key via an avalanching integer hash (the
+    HashPartitioner analog).  Works on any integer dtype; floats/other
+    dtypes should be bitcast by the caller."""
+    x = keys.astype(jnp.uint32)
+    # murmur3-style finalizer: full avalanche so consecutive keys spread
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return (x % jnp.uint32(n_parts)).astype(jnp.int32)
+
+
+def make_range_splitters(
+    sample: jax.Array, n_parts: int
+) -> jax.Array:
+    """n_parts-1 ascending splitters from a key sample (the
+    RangePartitioner analog used by sortByKey): equal-frequency
+    quantiles of the sample."""
+    sorted_sample = jnp.sort(sample)
+    n = sorted_sample.shape[0]
+    # quantile positions 1/n_parts .. (n_parts-1)/n_parts
+    idx = (jnp.arange(1, n_parts) * n) // n_parts
+    return sorted_sample[jnp.clip(idx, 0, n - 1)]
+
+
+def range_partition_ids(keys: jax.Array, splitters: jax.Array) -> jax.Array:
+    """Partition id per key given ascending splitters:
+    id = #splitters <= key (so part 0 gets keys < splitters[0])."""
+    return jnp.searchsorted(splitters, keys, side="right").astype(jnp.int32)
+
+
+def partition_to_buckets(
+    part_ids: jax.Array,
+    values: Tuple[jax.Array, ...],
+    n_parts: int,
+    capacity: int,
+    fill_values: Optional[Tuple] = None,
+) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+    """Bucket elements into a [n_parts, capacity] padded layout.
+
+    Args:
+      part_ids: int32[n] destination partition per element.
+      values:   tuple of arrays, each [n, ...], permuted together (e.g.
+                (keys, vals) — the sort-shuffle's record columns).
+      n_parts:  number of buckets.
+      capacity: max elements per bucket (static). Overflowing elements are
+                DROPPED from the buckets; detect via counts > capacity.
+      fill_values: per-array pad value (default: dtype max for the first
+                array — a +inf-style sentinel that sorts last — and 0 for
+                the rest).
+
+    Returns:
+      (bucketed, counts): bucketed[i] is [n_parts, capacity, ...],
+      counts is int32[n_parts] TRUE element counts (may exceed capacity —
+      that signals overflow; the caller re-runs with larger capacity).
+    """
+    n = part_ids.shape[0]
+    counts = jnp.bincount(part_ids, length=n_parts).astype(jnp.int32)
+    # stable sort groups elements by destination, preserving order
+    order = jnp.argsort(part_ids, stable=True)
+    sorted_ids = part_ids[order]
+    # position of each element within its bucket
+    starts = jnp.searchsorted(sorted_ids, jnp.arange(n_parts, dtype=sorted_ids.dtype))
+    pos = jnp.arange(n) - starts[sorted_ids]
+    in_cap = pos < capacity
+    # overflow entries scatter out-of-bounds and are dropped
+    flat_dest = jnp.where(
+        in_cap, sorted_ids * capacity + pos, n_parts * capacity
+    )
+    if fill_values is None:
+        fill_values = tuple(
+            _default_fill(v.dtype) if i == 0 else jnp.zeros((), v.dtype)
+            for i, v in enumerate(values)
+        )
+    bucketed = []
+    for v, fill in zip(values, fill_values):
+        sv = v[order]
+        flat_shape = (n_parts * capacity,) + v.shape[1:]
+        out = jnp.full(flat_shape, fill, dtype=v.dtype)
+        out = out.at[flat_dest].set(sv, mode="drop")
+        bucketed.append(out.reshape((n_parts, capacity) + v.shape[1:]))
+    return tuple(bucketed), counts
+
+
+def _default_fill(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)

@@ -1,0 +1,6 @@
+"""Device-mesh parallel layer: meshes, collectives, the byte exchange engine."""
+
+from sparkrdma_tpu.parallel.mesh import make_mesh, mesh_devices
+from sparkrdma_tpu.parallel.exchange import ExchangePlan, TileExchange
+
+__all__ = ["make_mesh", "mesh_devices", "ExchangePlan", "TileExchange"]

@@ -1,0 +1,219 @@
+"""Tile-round byte exchange over the device mesh.
+
+This is the data-plane inversion at the core of the TPU-native design
+(SURVEY.md §7 "Hard parts"): the reference's reducers *pull* exactly the
+bytes they want with one-sided RDMA READs (RdmaChannel.java:441-474);
+SPMD collectives instead need every chip participating in lockstep with
+static shapes.  The resolution:
+
+- The control plane still resolves exact block locations (unchanged).
+- The data plane buckets each (src → dst) byte stream into fixed-size
+  padded *tiles* and executes synchronized ``all_to_all`` rounds over the
+  mesh axis; the host-side :class:`ExchangePlan` knows exactly which
+  slice of which stream rides in which round, so no in-band framing is
+  needed.
+- Round count is the global max over pairs (lockstep), tile size is the
+  ``shuffle_read_block_size`` analog (``conf.exchange_tile_bytes``), and
+  the bounded number of in-flight rounds is the ``maxBytesInFlight``
+  window (RdmaShuffleFetcherIterator.scala:241-251) — here it bounds
+  HBM staging memory and lets JAX's async dispatch overlap host staging
+  of round r+1 with the collective of round r (double buffering).
+
+Single-host it runs on the spoofed CPU mesh; on a pod the same code
+rides ICI (and DCN across slices) because the mesh carries real devices.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+
+# tiles are padded to lane multiples so uint8 rows lay out cleanly
+TILE_ALIGN = 128
+
+
+class ExchangePlan:
+    """Static plan for one exchange of per-pair streams of known length.
+
+    lengths[s, d] = bytes queued from source s to destination d.
+    """
+
+    def __init__(self, lengths: np.ndarray, tile_bytes: int):
+        lengths = np.asarray(lengths, dtype=np.int64)
+        if lengths.ndim != 2 or lengths.shape[0] != lengths.shape[1]:
+            raise ValueError(f"lengths must be [D, D], got {lengths.shape}")
+        if (lengths < 0).any():
+            raise ValueError("negative stream length")
+        self.lengths = lengths
+        self.n_devices = lengths.shape[0]
+        max_len = int(lengths.max()) if lengths.size else 0
+        if max_len == 0:
+            self.tile_bytes = 0
+            self.rounds = 0
+            self.total_cols = 0
+            return
+        # tile: lane-aligned, no larger than needed for a single round
+        tile = min(int(tile_bytes), max_len)
+        tile = max(TILE_ALIGN, (tile + TILE_ALIGN - 1) // TILE_ALIGN * TILE_ALIGN)
+        self.tile_bytes = tile
+        self.rounds = math.ceil(max_len / tile)
+        self.total_cols = self.rounds * tile
+
+    @property
+    def payload_bytes(self) -> int:
+        return int(self.lengths.sum())
+
+    @property
+    def moved_bytes(self) -> int:
+        """Bytes actually moved per full exchange incl. padding."""
+        return self.n_devices * self.n_devices * self.total_cols
+
+    def round_slice(self, r: int) -> Tuple[int, int]:
+        """[start, end) byte range of round r within each pair stream."""
+        return r * self.tile_bytes, (r + 1) * self.tile_bytes
+
+
+@functools.lru_cache(maxsize=64)
+def _a2a_fn(mesh: Mesh, n_devices: int, cols: int, donate: bool):
+    """Jitted all_to_all: S[s, d, c] → R[d, s, c] over the mesh axis.
+
+    The one XLA program that *is* the shuffle data plane: each device
+    contributes its row of destination tiles and receives its row of
+    source tiles; XLA lowers the permutation onto ICI links.
+
+    ``donate`` lets XLA reuse the input buffer (halves HBM pressure) —
+    only safe when the caller owns the array and won't touch it again.
+    """
+    spec = P(EXCHANGE_AXIS, None, None)
+    sharding = NamedSharding(mesh, spec)
+
+    def body(x):  # local view: [1, D, C]
+        y = jax.lax.all_to_all(
+            x, EXCHANGE_AXIS, split_axis=1, concat_axis=0, tiled=False
+        )  # → [D, 1, C], row s = tile from source s
+        return jnp.swapaxes(y, 0, 1)  # → [1, D, C]
+
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
+    fn = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return fn, sharding
+
+
+class TileExchange:
+    """The exchange engine: pack → all_to_all rounds → unpack.
+
+    ``exchange_bytes(streams)`` moves ``streams[s][d]`` (bytes from
+    source s to destination d) and returns ``out[d][s]``.  Large
+    exchanges run as multiple rounds with at most
+    ``max_rounds_in_flight`` outstanding device computations.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        tile_bytes: int = 4 << 20,
+        max_rounds_in_flight: int = 2,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.devices = list(self.mesh.devices.flat)
+        self.n_devices = len(self.devices)
+        self.tile_bytes = int(tile_bytes)
+        self.max_rounds_in_flight = max(1, int(max_rounds_in_flight))
+        # stats (reader-stats analog for the collective plane)
+        self.rounds_executed = 0
+        self.payload_bytes_moved = 0
+        self.padded_bytes_moved = 0
+
+    # -- planning -----------------------------------------------------------
+    def plan(self, lengths: np.ndarray) -> ExchangePlan:
+        return ExchangePlan(lengths, self.tile_bytes)
+
+    # -- host-driven byte exchange ------------------------------------------
+    def exchange_bytes(
+        self, streams: Sequence[Sequence[bytes]]
+    ) -> List[List[bytes]]:
+        D = self.n_devices
+        if len(streams) != D or any(len(row) != D for row in streams):
+            raise ValueError(
+                f"streams must be [{D}][{D}], got "
+                f"[{len(streams)}][{[len(r) for r in streams]}]"
+            )
+        lengths = np.array(
+            [[len(streams[s][d]) for d in range(D)] for s in range(D)],
+            dtype=np.int64,
+        )
+        plan = self.plan(lengths)
+        out: List[List[bytearray]] = [
+            [bytearray() for _ in range(D)] for _ in range(D)
+        ]
+        if plan.rounds == 0:
+            return [[bytes(out[d][s]) for s in range(D)] for d in range(D)]
+
+        # our own staging arrays: safe to donate, halves HBM per round
+        fn, sharding = _a2a_fn(self.mesh, D, plan.tile_bytes, True)
+        inflight: deque = deque()
+
+        def collect(done):
+            # pull each destination's local shard and append its per-src
+            # tile slices (on a pod each host pulls only its own shard)
+            for shard in done.addressable_shards:
+                d = shard.index[0].start if shard.index[0].start is not None else 0
+                local = np.asarray(shard.data)[0]  # [D, tile]
+                for s in range(D):
+                    out[d][s] += local[s].tobytes()
+
+        for r in range(plan.rounds):
+            lo, hi = plan.round_slice(r)
+            mat = np.zeros((D, D, plan.tile_bytes), dtype=np.uint8)
+            for s in range(D):
+                for d in range(D):
+                    chunk = streams[s][d][lo:hi]
+                    if chunk:
+                        mat[s, d, : len(chunk)] = np.frombuffer(chunk, np.uint8)
+            garr = jax.device_put(mat, sharding)
+            inflight.append(fn(garr))
+            self.rounds_executed += 1
+            if len(inflight) >= self.max_rounds_in_flight:
+                collect(inflight.popleft())
+        while inflight:
+            collect(inflight.popleft())
+
+        self.payload_bytes_moved += plan.payload_bytes
+        self.padded_bytes_moved += plan.moved_bytes
+        # trim pair streams to their true lengths (drop tile padding)
+        return [
+            [bytes(out[d][s][: int(lengths[s, d])]) for s in range(D)]
+            for d in range(D)
+        ]
+
+    # -- on-device exchange (arrays already in HBM) -------------------------
+    def a2a(self, x: jax.Array, donate: bool = False) -> jax.Array:
+        """All-to-all a device-resident [D, D, C] uint8 array (sharded or
+        shardable over the mesh): returns [D, S, C] with out[d, s] =
+        x[s, d].  No host round-trip — the pure ICI path used when map
+        outputs already live in HBM arenas.
+
+        Pass ``donate=True`` ONLY when the caller gives up ``x``: XLA
+        then reuses its buffer and ``x`` becomes invalid afterwards."""
+        D = self.n_devices
+        if x.ndim != 3 or x.shape[0] != D or x.shape[1] != D:
+            raise ValueError(f"expected [D={D}, D, C] array, got {x.shape}")
+        fn, sharding = _a2a_fn(self.mesh, D, int(x.shape[2]), donate)
+        if not hasattr(x, "sharding") or x.sharding != sharding:
+            x = jax.device_put(x, sharding)
+        return fn(x)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "rounds_executed": self.rounds_executed,
+            "payload_bytes_moved": self.payload_bytes_moved,
+            "padded_bytes_moved": self.padded_bytes_moved,
+        }

@@ -1,0 +1,51 @@
+"""Mesh construction helpers.
+
+The TPU analog of the reference's endpoint topology: where SparkRDMA
+discovers a full mesh of RC queue pairs lazily via hello/announce RPCs
+(RdmaShuffleManager.scala:70-118), a TPU pod's topology is known up
+front — we fix a ``jax.sharding.Mesh`` at job start and the control
+plane only tracks *logical* membership on top of it (SURVEY.md §7
+"Dynamic membership" hard part).
+
+One mesh axis ``"x"`` carries the shuffle exchange: ``all_to_all`` over
+"x" rides ICI within a slice and DCN across slices — XLA picks the
+transport per hop, exactly the RoCE/IB duality the reference gets from
+ibverbs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+EXCHANGE_AXIS = "x"
+
+
+def mesh_devices(
+    n_devices: Optional[int] = None, device_list: Optional[Sequence[int]] = None
+):
+    """Pick the devices serving the exchange (conf.device_list analog of
+    the reference's cpuList pinning, RdmaNode.java:216-273)."""
+    devs = jax.devices()
+    if device_list:
+        devs = [devs[i] for i in device_list if i < len(devs)]
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}"
+            )
+        devs = devs[:n_devices]
+    return devs
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    device_list: Optional[Sequence[int]] = None,
+    axis_name: str = EXCHANGE_AXIS,
+) -> Mesh:
+    """1-D exchange mesh over the chosen devices."""
+    devs = mesh_devices(n_devices, device_list)
+    return Mesh(np.array(devs), (axis_name,))

@@ -1,0 +1,485 @@
+"""TpuShuffleManager: the plugin-root API + driver control plane.
+
+Analog of RdmaShuffleManager (RdmaShuffleManager.scala:38-388), the L1
+surface of SURVEY.md §1: ``register_shuffle`` / ``get_writer`` /
+``get_reader`` / ``unregister_shuffle`` / ``stop``, plus the
+driver-mediated control plane:
+
+- executors **hello** the driver on lazy start
+  (startRdmaNodeIfMissing, :277-318),
+- the driver **announces** full membership so executors pre-connect the
+  peer mesh hot (:70-118),
+- map tasks **publish** their location tables (:120-141),
+- reducers **fetch-status** and the driver answers once the relevant
+  tables' fill-futures resolve (:143-216),
+- executor loss **prunes** driver maps (onBlockManagerRemoved,
+  :253-263).
+
+One manager per process; driver and executors are distinguished by
+``is_driver`` exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.memory.arena import ArenaManager
+from sparkrdma_tpu.rpc.messages import (
+    AnnounceShuffleManagersMsg,
+    FetchMapStatusMsg,
+    FetchMapStatusResponseMsg,
+    HelloMsg,
+    PublishMapTaskOutputMsg,
+    RpcMsg,
+    decode_msg,
+)
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.shuffle.partitioner import Partitioner
+from sparkrdma_tpu.shuffle.resolver import ShuffleBlockResolver
+from sparkrdma_tpu.shuffle.writer import ShuffleWriter
+from sparkrdma_tpu.stats import ShuffleReaderStats
+from sparkrdma_tpu.transport.channel import Channel, ChannelType, FnCompletionListener
+from sparkrdma_tpu.transport.node import Node
+from sparkrdma_tpu.utils.serde import PickleSerializer, Serializer
+from sparkrdma_tpu.utils.types import (
+    BlockLocation,
+    BlockManagerId,
+    ShuffleManagerId,
+    get_cached_shuffle_manager_id,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Aggregator:
+    """Combiner triple (Spark Aggregator analog)."""
+
+    create_combiner: Callable[[Any], Any]
+    merge_value: Callable[[Any, Any], Any]
+    merge_combiners: Callable[[Any, Any], Any]
+
+
+@dataclass
+class ShuffleHandle:
+    """Returned by register_shuffle; carried to writers and readers
+    (reference: Serialized/BaseShuffleHandle selection,
+    RdmaShuffleManager.scala:267-274 — serialization strategy here is a
+    Serializer instance rather than a handle subclass)."""
+
+    shuffle_id: int
+    num_maps: int
+    partitioner: Partitioner
+    aggregator: Optional[Aggregator] = None
+    map_side_combine: bool = False
+    key_ordering: bool = False
+
+    def __post_init__(self):
+        if self.map_side_combine and self.aggregator is None:
+            raise ValueError("map_side_combine requires an aggregator")
+
+
+class _FetchCallback:
+    """Reassembles segmented fetch-status responses by (index, total)
+    and fires once complete (registry analog of
+    RdmaShuffleManager.scala:378-387)."""
+
+    def __init__(self, on_locations: Callable[[List[BlockLocation]], None]):
+        self.on_locations = on_locations
+        self._parts: Dict[int, Tuple[BlockLocation, ...]] = {}
+        self._got = 0
+        self._lock = threading.Lock()
+
+    def on_response(self, msg: FetchMapStatusResponseMsg) -> None:
+        with self._lock:
+            if msg.index in self._parts:
+                return  # duplicate segment
+            self._parts[msg.index] = msg.locations
+            self._got += len(msg.locations)
+            done = self._got >= msg.total
+        if done:
+            locs: List[BlockLocation] = []
+            for idx in sorted(self._parts):
+                locs.extend(self._parts[idx])
+            self.on_locations(locs)
+
+
+class TpuShuffleManager:
+    """One per process.  ``network`` supplies the transport connector
+    (LoopbackNetwork in-process; a real fabric connector on a pod)."""
+
+    def __init__(
+        self,
+        conf: TpuShuffleConf,
+        is_driver: bool,
+        network,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        executor_id: str = "driver",
+        serializer: Optional[Serializer] = None,
+        stage_to_device: bool = True,
+    ):
+        self.conf = conf
+        self.is_driver = is_driver
+        self.network = network
+        self.executor_id = executor_id
+        self.serializer = serializer or PickleSerializer()
+        self.stats = ShuffleReaderStats(conf) if conf.collect_shuffle_reader_stats else None
+
+        if is_driver:
+            port = port or conf.driver_port or 37000
+        self.node = self._bind_node(host, port)
+        self.node.set_receive_listener(self._receive)
+        if is_driver:
+            conf.set_driver_port(self.node.address[1])
+            conf.set("driverHost", host)
+        self.local_smid = get_cached_shuffle_manager_id(
+            ShuffleManagerId(
+                self.node.address[0],
+                self.node.address[1],
+                BlockManagerId(executor_id, host, self.node.address[1]),
+            )
+        )
+
+        self.arena = ArenaManager(conf.max_buffer_allocation_size)
+        self.resolver = ShuffleBlockResolver(
+            self.arena, self.node, stage_to_device=stage_to_device
+        )
+
+        # driver-side metadata (RdmaShuffleManager.scala:46-57)
+        self._executors: List[ShuffleManagerId] = []  # join order
+        self._executors_lock = threading.Lock()
+        self._shuffle_partitions: Dict[int, int] = {}
+        self._shuffle_num_maps: Dict[int, int] = {}
+        # shuffle -> host smid -> map_id -> table
+        self._outputs: Dict[int, Dict[ShuffleManagerId, Dict[int, MapTaskOutput]]] = {}
+        self._outputs_lock = threading.Lock()
+        self._fetch_pool = (
+            ThreadPoolExecutor(max_workers=8, thread_name_prefix="drv-fetch")
+            if is_driver
+            else None
+        )
+
+        # executor-side state
+        self._peers: List[ShuffleManagerId] = []
+        self._callbacks: Dict[int, _FetchCallback] = {}
+        self._callbacks_lock = threading.Lock()
+        self._next_callback_id = 1
+        self._hello_sent = False
+        self._stopped = False
+
+        if not is_driver:
+            self._say_hello()
+
+    # -- node binding with port retries (RdmaNode.java:73-87) ---------------
+    def _bind_node(self, host: str, port: int) -> Node:
+        last_err = None
+        base = port or 38000
+        for attempt in range(self.conf.port_max_retries):
+            node = Node((host, base + attempt), self.conf,
+                        is_executor=not self.is_driver)
+            try:
+                self.network.register(node)
+                return node
+            except Exception as e:
+                node.stop()  # release the failed node's dispatcher threads
+                last_err = e
+        raise RuntimeError(f"could not bind node near {host}:{base}") from last_err
+
+    # -- control-plane send helpers -----------------------------------------
+    def _driver_channel(self) -> Channel:
+        addr = (self.conf.driver_host, self.conf.driver_port)
+        return self.node.get_channel(
+            addr, ChannelType.RPC_REQUESTOR, self.network.connect
+        )
+
+    def _send_msg(self, channel: Channel, msg: RpcMsg,
+                  on_failure: Optional[Callable] = None) -> None:
+        frames = msg.encode_segments(self.conf.recv_wr_size)
+        channel.send_rpc(
+            frames, FnCompletionListener(on_failure=on_failure or (lambda e: logger.warning(
+                "rpc send failed: %s", e)))
+        )
+
+    def _say_hello(self) -> None:
+        if self._hello_sent:
+            return
+        self._hello_sent = True
+        msg = HelloMsg(self.local_smid, self.node.address[1])
+        self._send_msg(self._driver_channel(), msg)
+
+    # -- receive dispatch ----------------------------------------------------
+    def _receive(self, channel: Channel, frame: bytes) -> None:
+        try:
+            msg = decode_msg(frame)
+        except ValueError:
+            logger.exception("dropping malformed control frame")
+            return
+        if isinstance(msg, HelloMsg):
+            self._handle_hello(msg)
+        elif isinstance(msg, AnnounceShuffleManagersMsg):
+            self._handle_announce(msg)
+        elif isinstance(msg, PublishMapTaskOutputMsg):
+            self._handle_publish(msg)
+        elif isinstance(msg, FetchMapStatusMsg):
+            self._handle_fetch_status(msg, channel)
+        elif isinstance(msg, FetchMapStatusResponseMsg):
+            self._handle_fetch_response(msg)
+
+    # -- driver handlers -----------------------------------------------------
+    def _handle_hello(self, msg: HelloMsg) -> None:
+        assert self.is_driver, "hello must only reach the driver"
+        smid = msg.shuffle_manager_id
+        with self._executors_lock:
+            if smid not in self._executors:
+                self._executors.append(smid)
+            members = list(self._executors)
+        logger.info("driver: hello from %s (now %d executors)",
+                    smid.block_manager_id.executor_id, len(members))
+        announce = AnnounceShuffleManagersMsg(members)
+        for peer in members:
+            try:
+                ch = self.node.get_channel(
+                    (peer.host, peer.port), ChannelType.RPC_REQUESTOR,
+                    self.network.connect,
+                )
+                self._send_msg(ch, announce)
+            except Exception:
+                logger.exception("driver: announce to %s failed", peer.host)
+
+    def _handle_announce(self, msg: AnnounceShuffleManagersMsg) -> None:
+        with self._executors_lock:
+            for smid in msg.shuffle_manager_ids:
+                if smid not in self._peers:
+                    self._peers.append(smid)
+            peers = [p for p in self._peers if p != self.local_smid]
+        # pre-connect the peer mesh in the background so the first fetch
+        # is hot (reference: RdmaShuffleManager.scala:111-118)
+        def warm():
+            for peer in peers:
+                try:
+                    self.node.get_channel(
+                        (peer.host, peer.port), ChannelType.READ_REQUESTOR,
+                        self.network.connect,
+                    )
+                except Exception:
+                    logger.warning("pre-connect to %s:%d failed",
+                                   peer.host, peer.port)
+        threading.Thread(target=warm, daemon=True).start()
+
+    def _get_or_create_mto(
+        self, shuffle_id: int, host: ShuffleManagerId, map_id: int,
+        num_partitions: Optional[int] = None,
+    ) -> MapTaskOutput:
+        with self._outputs_lock:
+            by_host = self._outputs.setdefault(shuffle_id, {})
+            by_map = by_host.setdefault(host, {})
+            mto = by_map.get(map_id)
+            if mto is None:
+                n = num_partitions or self._shuffle_partitions.get(shuffle_id)
+                if n is None:
+                    raise KeyError(
+                        f"shuffle {shuffle_id} not registered on driver"
+                    )
+                mto = by_map.setdefault(map_id, MapTaskOutput(n))
+            return mto
+
+    def _handle_publish(self, msg: PublishMapTaskOutputMsg) -> None:
+        assert self.is_driver, "publish must only reach the driver"
+        mto = self._get_or_create_mto(
+            msg.shuffle_id, msg.shuffle_manager_id, msg.map_id,
+            msg.total_num_partitions,
+        )
+        mto.put_range(msg.first_reduce_id, msg.last_reduce_id, msg.entries)
+
+    def _handle_fetch_status(self, msg: FetchMapStatusMsg, channel: Channel) -> None:
+        assert self.is_driver, "fetch-status must only reach the driver"
+        try:
+            mtos = {
+                mid: self._get_or_create_mto(msg.shuffle_id, msg.host, mid)
+                for mid in {m for m, _ in msg.block_ids}
+            }
+        except KeyError:
+            logger.warning("fetch-status for unregistered shuffle %d",
+                           msg.shuffle_id)
+            return
+
+        def answer():
+            # all futures are complete (or failed) by the time this runs
+            try:
+                failed = [
+                    m for m, t in mtos.items()
+                    if t.fill_future.exception() is not None
+                ]
+                if failed:
+                    # executor lost mid-publish; requester's timer converts
+                    # this to a metadata fetch failure
+                    logger.warning(
+                        "fetch-status unanswerable: maps %s of shuffle %d "
+                        "lost before publish completed", failed, msg.shuffle_id,
+                    )
+                    return
+                locs = [mtos[m].get_location(r) for m, r in msg.block_ids]
+                resp = FetchMapStatusResponseMsg(
+                    msg.callback_id, msg.total, msg.index, locs
+                )
+                self._send_msg(channel.reply_channel(), resp)
+            except Exception:
+                logger.exception(
+                    "fetch-status reply failed (shuffle=%d host=%s)",
+                    msg.shuffle_id, msg.host.host,
+                )
+
+        # chain on the fill futures instead of blocking a pool thread, so
+        # a straggler map can never starve answerable requests
+        remaining = [t for t in mtos.values() if not t.fill_future.done()]
+        if not remaining:
+            self._fetch_pool.submit(answer)
+            return
+        countdown = {"n": len(remaining)}
+        lock = threading.Lock()
+
+        def on_done(_fut):
+            with lock:
+                countdown["n"] -= 1
+                last = countdown["n"] == 0
+            if last:
+                self._fetch_pool.submit(answer)
+
+        for t in remaining:
+            t.fill_future.add_done_callback(on_done)
+
+    # -- executor handlers ---------------------------------------------------
+    def _handle_fetch_response(self, msg: FetchMapStatusResponseMsg) -> None:
+        with self._callbacks_lock:
+            cb = self._callbacks.get(msg.callback_id)
+        if cb is None:
+            logger.warning("fetch response for unknown callback %d",
+                           msg.callback_id)
+            return
+        cb.on_response(msg)
+
+    def register_fetch_callback(
+        self, on_locations: Callable[[List[BlockLocation]], None]
+    ) -> int:
+        with self._callbacks_lock:
+            cb_id = self._next_callback_id
+            self._next_callback_id += 1
+            self._callbacks[cb_id] = _FetchCallback(on_locations)
+        return cb_id
+
+    def unregister_fetch_callback(self, cb_id: int) -> None:
+        with self._callbacks_lock:
+            self._callbacks.pop(cb_id, None)
+
+    # -- public API (the ShuffleManager SPI) ---------------------------------
+    def register_shuffle(
+        self,
+        shuffle_id: int,
+        num_maps: int,
+        partitioner: Partitioner,
+        aggregator: Optional[Aggregator] = None,
+        map_side_combine: bool = False,
+        key_ordering: bool = False,
+    ) -> ShuffleHandle:
+        """Driver-side registration (reference:
+        RdmaShuffleManager.scala:242-274)."""
+        handle = ShuffleHandle(
+            shuffle_id, num_maps, partitioner, aggregator,
+            map_side_combine, key_ordering,
+        )
+        self._shuffle_partitions[shuffle_id] = partitioner.num_partitions
+        self._shuffle_num_maps[shuffle_id] = num_maps
+        return handle
+
+    def get_writer(self, handle: ShuffleHandle, map_id: int) -> ShuffleWriter:
+        return ShuffleWriter(self, handle, map_id)
+
+    def get_reader(
+        self,
+        handle: ShuffleHandle,
+        start_partition: int,
+        end_partition: int,
+        maps_by_host: Dict[ShuffleManagerId, List[int]],
+    ):
+        """maps_by_host plays the MapOutputTracker's
+        getMapSizesByExecutorId role (RdmaShuffleReader.scala:44-49):
+        which host ran which map tasks — known to the job scheduler."""
+        from sparkrdma_tpu.shuffle.reader import ShuffleReader
+
+        return ShuffleReader(
+            self, handle, start_partition, end_partition, maps_by_host
+        )
+
+    def publish_map_output(
+        self, shuffle_id: int, map_id: int, mto: MapTaskOutput
+    ) -> None:
+        """Executor → driver publish (RdmaWrapperShuffleWriter.scala:115-149)."""
+        n = mto.num_partitions
+        msg = PublishMapTaskOutputMsg(
+            self.local_smid, shuffle_id, map_id, n, 0, n - 1,
+            mto.get_range_bytes(0, n - 1),
+        )
+        if self.is_driver:
+            # driver-local writer (local[*] mode): install directly
+            self._handle_publish(msg)
+        else:
+            self._send_msg(self._driver_channel(), msg)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self.resolver.remove_shuffle(shuffle_id)
+        with self._outputs_lock:
+            self._outputs.pop(shuffle_id, None)
+        self._shuffle_partitions.pop(shuffle_id, None)
+        self._shuffle_num_maps.pop(shuffle_id, None)
+
+    def remove_executor(self, smid: ShuffleManagerId) -> None:
+        """Elastic membership pruning (reference onBlockManagerRemoved,
+        RdmaShuffleManager.scala:253-263).  Unfilled tables from the lost
+        executor get their futures failed so driver-side fetch-status
+        waits unblock immediately instead of timing out."""
+        with self._executors_lock:
+            if smid in self._executors:
+                self._executors.remove(smid)
+        with self._outputs_lock:
+            doomed: List[MapTaskOutput] = []
+            for by_host in self._outputs.values():
+                by_map = by_host.pop(smid, None)
+                if by_map:
+                    doomed.extend(by_map.values())
+        for mto in doomed:
+            if not mto.fill_future.done():
+                mto.fill_future.set_exception(
+                    RuntimeError(f"executor lost: {smid.host}:{smid.port}")
+                )
+
+    # -- in-process helpers for the job layer --------------------------------
+    def maps_by_host(self, shuffle_id: int) -> Dict[ShuffleManagerId, List[int]]:
+        """Driver-side view of which host published which maps."""
+        with self._outputs_lock:
+            by_host = self._outputs.get(shuffle_id, {})
+            return {h: sorted(m.keys()) for h, m in by_host.items()}
+
+    @property
+    def executors(self) -> List[ShuffleManagerId]:
+        with self._executors_lock:
+            return list(self._executors)
+
+    def stop(self) -> None:
+        """Teardown (reference: RdmaShuffleManager.scala:348-357)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if self.stats is not None:
+            self.stats.print_stats()
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown(wait=False)
+        self.resolver.stop()
+        self.node.stop()
+        self.network.unregister(self.node)
+        self.arena.stop()

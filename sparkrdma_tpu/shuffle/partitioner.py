@@ -1,0 +1,76 @@
+"""Host-side partitioners for the record path.
+
+The reference reuses Spark's ``dependency.partitioner``
+(RdmaWrapperShuffleWriter.scala:126-128); these are the standalone
+equivalents.  The device path uses sparkrdma_tpu.ops.partition instead.
+"""
+
+from __future__ import annotations
+
+import bisect
+import pickle
+import zlib
+from typing import Any, List, Sequence
+
+
+def stable_hash(key: Any) -> int:
+    """Process-stable hash: Python's builtin ``hash`` is salted per
+    interpreter (PYTHONHASHSEED), so map tasks in different executor
+    processes would disagree on key → partition.  Primitives hash via a
+    canonical byte encoding; everything else via a fixed-protocol pickle."""
+    if isinstance(key, bool):  # bool before int: True/1 must collide as in dicts
+        key = int(key)
+    if isinstance(key, int):
+        data = key.to_bytes(
+            max(1, (key.bit_length() + 8) // 8), "little", signed=True
+        )
+    elif isinstance(key, str):
+        data = key.encode("utf-8")
+    elif isinstance(key, (bytes, bytearray)):
+        data = bytes(key)
+    elif isinstance(key, float):
+        import struct as _s
+
+        data = _s.pack("<d", key)
+    elif isinstance(key, tuple):
+        data = b"".join(stable_hash(k).to_bytes(4, "little") for k in key)
+    else:
+        data = pickle.dumps(key, protocol=4)
+    return zlib.crc32(data)
+
+
+class Partitioner:
+    num_partitions: int
+
+    def partition(self, key: Any) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class HashPartitioner(Partitioner):
+    def __init__(self, num_partitions: int):
+        if num_partitions <= 0:
+            raise ValueError(f"num_partitions must be > 0: {num_partitions}")
+        self.num_partitions = num_partitions
+
+    def partition(self, key: Any) -> int:
+        return stable_hash(key) % self.num_partitions
+
+
+class RangePartitioner(Partitioner):
+    """Equal-frequency range partitioner from a key sample (sortByKey)."""
+
+    def __init__(self, num_partitions: int, sample: Sequence[Any]):
+        if num_partitions <= 0:
+            raise ValueError(f"num_partitions must be > 0: {num_partitions}")
+        self.num_partitions = num_partitions
+        s = sorted(sample)
+        if not s:
+            self.splitters: List[Any] = []
+        else:
+            self.splitters = [
+                s[min(len(s) - 1, (i * len(s)) // num_partitions)]
+                for i in range(1, num_partitions)
+            ]
+
+    def partition(self, key: Any) -> int:
+        return bisect.bisect_right(self.splitters, key)

@@ -1,0 +1,343 @@
+"""Reduce-side reader: async location resolution + windowed block fetch.
+
+Analog of RdmaShuffleReader + RdmaShuffleFetcherIterator
+(RdmaShuffleReader.scala:31-127, RdmaShuffleFetcherIterator.scala:39-425),
+the reference's critical path (SURVEY.md §3.4):
+
+- local partitions short-circuit to the arena (no transport),
+- per remote host: a fetch-status RPC resolves exact block locations
+  (with a timeout timer → metadata fetch failure),
+- locations are grouped into pending fetches of ≤ shuffle_read_block_size
+  (and ≤ max_agg_block), throttled by the max_bytes_in_flight window,
+- completions land in a blocking results queue consumed by the record
+  iterator; failures convert to :class:`FetchFailedError` so the job
+  layer can retry the stage (the reference's FetchFailedException
+  bridge),
+- then deserialization → aggregation → optional key sort
+  (RdmaShuffleReader.scala:82-113).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from sparkrdma_tpu.shuffle.manager import ShuffleHandle
+from sparkrdma_tpu.transport.channel import ChannelType, FnCompletionListener
+from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
+from sparkrdma_tpu.utils.serde import Record
+from sparkrdma_tpu.utils.types import BlockLocation, ShuffleManagerId
+
+logger = logging.getLogger(__name__)
+
+
+class FetchFailedError(Exception):
+    """Remote block fetch failed; the stage should be retried
+    (reference: FetchFailedException conversion,
+    RdmaShuffleFetcherIterator.scala:368-373)."""
+
+    def __init__(self, host: str, shuffle_id: int, reason: str):
+        super().__init__(
+            f"fetch failed from {host} (shuffle {shuffle_id}): {reason}"
+        )
+        self.host = host
+        self.shuffle_id = shuffle_id
+
+
+class MetadataFetchFailedError(FetchFailedError):
+    """Location resolution timed out / failed
+    (reference: MetadataFetchFailedException)."""
+
+
+@dataclass
+class ReadMetrics:
+    local_blocks: int = 0
+    remote_blocks: int = 0
+    local_bytes: int = 0
+    remote_bytes: int = 0
+    records_read: int = 0
+    fetch_wait_ms: float = 0.0
+
+
+@dataclass
+class _PendingFetch:
+    """One grouped fetch against one host
+    (reference: PendingFetch, RdmaShuffleFetcherIterator.scala:112-127)."""
+
+    host: ShuffleManagerId
+    locations: List[BlockLocation]
+    total_bytes: int
+
+
+class _Result:
+    __slots__ = ("blocks", "host", "error", "latency_ms")
+
+    def __init__(self, blocks=None, host=None, error=None, latency_ms=0.0):
+        self.blocks = blocks
+        self.host = host
+        self.error = error
+        self.latency_ms = latency_ms
+
+
+class ShuffleReader:
+    """Reads partitions [start_partition, end_partition) of one shuffle."""
+
+    def __init__(
+        self,
+        manager,
+        handle: ShuffleHandle,
+        start_partition: int,
+        end_partition: int,
+        maps_by_host: Dict[ShuffleManagerId, List[int]],
+    ):
+        self.manager = manager
+        self.handle = handle
+        self.start_partition = start_partition
+        self.end_partition = end_partition
+        self.maps_by_host = maps_by_host
+        self.metrics = ReadMetrics()
+        self._results: "queue.Queue[_Result]" = queue.Queue()
+        self._pending: List[_PendingFetch] = []
+        self._pending_lock = threading.Lock()
+        self._bytes_in_flight = 0
+        self._outstanding_blocks = 0  # non-empty remote blocks not yet delivered
+        self._awaiting_hosts = 0      # hosts whose locations are unresolved
+        self._failed: Optional[FetchFailedError] = None
+        self._timers: List[threading.Timer] = []
+        self._callback_ids: List[int] = []
+
+    # -- fetch machinery ----------------------------------------------------
+    def _start_remote_fetches(self) -> List[bytes]:
+        """Kick off async location fetches; returns local block payloads.
+        (startAsyncRemoteFetches, RdmaShuffleFetcherIterator.scala:174-311)."""
+        local_payloads: List[bytes] = []
+        conf = self.manager.conf
+        reduce_ids = range(self.start_partition, self.end_partition)
+        for host, map_ids in self.maps_by_host.items():
+            if host == self.manager.local_smid:
+                for mid in map_ids:
+                    for rid in reduce_ids:
+                        data = self.manager.resolver.get_local_block(
+                            self.handle.shuffle_id, mid, rid
+                        )
+                        self.metrics.local_blocks += 1
+                        self.metrics.local_bytes += len(data)
+                        if data:
+                            local_payloads.append(data)
+                continue
+
+            pairs = [(mid, rid) for mid in map_ids for rid in reduce_ids]
+            if not pairs:
+                continue
+            with self._pending_lock:
+                self._awaiting_hosts += 1
+            t0 = time.monotonic()
+            timer = threading.Timer(
+                conf.partition_location_fetch_timeout_ms / 1000.0,
+                self._on_metadata_timeout,
+                args=(host,),
+            )
+            timer.daemon = True
+            self._timers.append(timer)
+
+            def on_locations(locs, host=host, timer=timer, t0=t0):
+                timer.cancel()
+                logger.debug(
+                    "locations for %s resolved in %.1fms",
+                    host.host, (time.monotonic() - t0) * 1000,
+                )
+                self._enqueue_fetches(host, locs)
+
+            cb_id = self.manager.register_fetch_callback(on_locations)
+            self._callback_ids.append(cb_id)
+            msg = FetchMapStatusMsg(
+                self.manager.local_smid, host, self.handle.shuffle_id,
+                cb_id, pairs,
+            )
+            timer.start()
+            try:
+                self.manager._send_msg(
+                    self.manager._driver_channel(), msg,
+                    on_failure=lambda e, host=host: self._fail(
+                        MetadataFetchFailedError(
+                            host.host, self.handle.shuffle_id,
+                            f"status rpc failed: {e}",
+                        )
+                    ),
+                )
+            except Exception as e:
+                self._fail(MetadataFetchFailedError(
+                    host.host, self.handle.shuffle_id, str(e)))
+        return local_payloads
+
+    def _on_metadata_timeout(self, host: ShuffleManagerId) -> None:
+        self._fail(
+            MetadataFetchFailedError(
+                host.host, self.handle.shuffle_id,
+                f"no location response within "
+                f"{self.manager.conf.partition_location_fetch_timeout_ms}ms",
+            )
+        )
+
+    def _enqueue_fetches(self, host: ShuffleManagerId,
+                         locations: Sequence[BlockLocation]) -> None:
+        """Group locations into bounded fetches
+        (RdmaShuffleFetcherIterator.scala:214-240)."""
+        conf = self.manager.conf
+        group: List[BlockLocation] = []
+        group_bytes = 0
+        new_fetches: List[_PendingFetch] = []
+        nonempty = 0
+        for loc in locations:
+            if loc.is_empty:
+                continue
+            nonempty += 1
+            if group and (
+                group_bytes + loc.length > conf.shuffle_read_block_size
+                or group_bytes + loc.length > conf.max_agg_block
+            ):
+                new_fetches.append(_PendingFetch(host, group, group_bytes))
+                group, group_bytes = [], 0
+            group.append(loc)
+            group_bytes += loc.length
+        if group:
+            new_fetches.append(_PendingFetch(host, group, group_bytes))
+        with self._pending_lock:
+            self._outstanding_blocks += nonempty
+            self._pending.extend(new_fetches)
+            self._awaiting_hosts -= 1
+        # deliver a wake-up marker even if everything was empty so the
+        # consumer can re-check its termination condition
+        self._results.put(_Result(blocks=[], host=host))
+        self._pump()
+
+    def _pump(self) -> None:
+        """Issue pending fetches within the in-flight byte window
+        (RdmaShuffleFetcherIterator.scala:241-251,357-366)."""
+        conf = self.manager.conf
+        while True:
+            with self._pending_lock:
+                if not self._pending:
+                    return
+                if (
+                    self._bytes_in_flight > 0
+                    and self._bytes_in_flight + self._pending[0].total_bytes
+                    > conf.max_bytes_in_flight
+                ):
+                    return
+                fetch = self._pending.pop(0)
+                self._bytes_in_flight += fetch.total_bytes
+            self._issue(fetch)
+
+    def _issue(self, fetch: _PendingFetch) -> None:
+        t0 = time.monotonic()
+
+        def on_success(blocks):
+            latency = (time.monotonic() - t0) * 1000
+            with self._pending_lock:
+                self._bytes_in_flight -= fetch.total_bytes
+            if self.manager.stats is not None:
+                self.manager.stats.update(fetch.host.host, latency)
+            self._results.put(
+                _Result(blocks=blocks, host=fetch.host, latency_ms=latency)
+            )
+            self._pump()
+
+        def on_failure(err):
+            with self._pending_lock:
+                self._bytes_in_flight -= fetch.total_bytes
+            self._fail(
+                FetchFailedError(
+                    fetch.host.host, self.handle.shuffle_id, str(err)
+                )
+            )
+
+        try:
+            ch = self.manager.node.get_channel(
+                (fetch.host.host, fetch.host.port),
+                ChannelType.READ_REQUESTOR,
+                self.manager.network.connect,
+            )
+            ch.read_blocks(
+                fetch.locations, FnCompletionListener(on_success, on_failure)
+            )
+        except Exception as e:
+            on_failure(e)
+
+    def _fail(self, err: FetchFailedError) -> None:
+        self._failed = err
+        self._results.put(_Result(error=err))
+
+    # -- consumption --------------------------------------------------------
+    def _iter_raw(self) -> Iterator[Record]:
+        """Blocking consume: local payloads first, then remote completions
+        (hasNext/next, RdmaShuffleFetcherIterator.scala:332-374)."""
+        try:
+            local_payloads = self._start_remote_fetches()
+            deser = self.manager.serializer.deserialize
+            for data in local_payloads:
+                for rec in deser(data):
+                    self.metrics.records_read += 1
+                    yield rec
+            while True:
+                with self._pending_lock:
+                    if (
+                        self._awaiting_hosts == 0
+                        and self._outstanding_blocks == 0
+                        and not self._pending
+                    ):
+                        break
+                t0 = time.monotonic()
+                res = self._results.get()
+                self.metrics.fetch_wait_ms += (time.monotonic() - t0) * 1000
+                if res.error is not None:
+                    raise res.error
+                if not res.blocks:
+                    continue  # wake-up marker
+                with self._pending_lock:
+                    self._outstanding_blocks -= len(res.blocks)
+                for data in res.blocks:
+                    self.metrics.remote_blocks += 1
+                    self.metrics.remote_bytes += len(data)
+                    for rec in deser(data):
+                        self.metrics.records_read += 1
+                        yield rec
+        finally:
+            # runs on normal exhaustion, fetch failure, AND abandoned
+            # iteration (GeneratorExit) — timers and callbacks never leak
+            self._cleanup()
+
+    def _cleanup(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        for cb_id in self._callback_ids:
+            self.manager.unregister_fetch_callback(cb_id)
+
+    def read(self) -> Iterator[Record]:
+        """Full read path: fetch → deserialize → aggregate → sort
+        (RdmaShuffleReader.scala:43-113)."""
+        records = self._iter_raw()
+        agg = self.handle.aggregator
+        if agg is not None:
+            combined: Dict[Any, Any] = {}
+            if self.handle.map_side_combine:
+                # records are (key, combiner) pairs already
+                for k, c in records:
+                    combined[k] = (
+                        agg.merge_combiners(combined[k], c)
+                        if k in combined else c
+                    )
+            else:
+                for k, v in records:
+                    combined[k] = (
+                        agg.merge_value(combined[k], v)
+                        if k in combined else agg.create_combiner(v)
+                    )
+            records = iter(combined.items())
+        if self.handle.key_ordering:
+            records = iter(sorted(records, key=lambda kv: kv[0]))
+        return records

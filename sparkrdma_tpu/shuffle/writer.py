@@ -1,0 +1,92 @@
+"""Map-side shuffle writer: partition → (combine) → serialize → commit → publish.
+
+Analog of RdmaWrapperShuffleWriter (RdmaWrapperShuffleWriter.scala:76-153).
+Where the reference wraps Spark's UnsafeShuffleWriter/SortShuffleWriter
+and intercepts the commit to mmap+register the produced file, this
+writer owns the whole path: bucket records by partitioner, optionally
+map-side combine, serialize per partition, commit into a registered HBM
+segment via the resolver, and publish the location table to the driver
+(the ``stop(success=true)`` publish at
+RdmaWrapperShuffleWriter.scala:115-149).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional
+
+from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.utils.serde import Record
+
+
+class WriteMetrics:
+    def __init__(self):
+        self.records_written = 0
+        self.bytes_written = 0
+        self.write_time_ms = 0.0
+
+
+class ShuffleWriter:
+    """One writer per (shuffle, map task)."""
+
+    def __init__(self, manager, handle, map_id: int):
+        self.manager = manager
+        self.handle = handle
+        self.map_id = map_id
+        self.metrics = WriteMetrics()
+        self._buckets: List[List[Record]] = [
+            [] for _ in range(handle.partitioner.num_partitions)
+        ]
+        self._combined: Optional[List[dict]] = (
+            [dict() for _ in range(handle.partitioner.num_partitions)]
+            if (handle.aggregator is not None and handle.map_side_combine)
+            else None
+        )
+        self._stopped = False
+
+    # -- write --------------------------------------------------------------
+    def write(self, records: Iterable[Record]) -> None:
+        t0 = time.monotonic()
+        part = self.handle.partitioner.partition
+        if self._combined is not None:
+            agg = self.handle.aggregator
+            for k, v in records:
+                d = self._combined[part(k)]
+                if k in d:
+                    d[k] = agg.merge_value(d[k], v)
+                else:
+                    d[k] = agg.create_combiner(v)
+                self.metrics.records_written += 1
+        else:
+            for rec in records:
+                self._buckets[part(rec[0])].append(rec)
+                self.metrics.records_written += 1
+        self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
+
+    # -- commit + publish ---------------------------------------------------
+    def stop(self, success: bool = True) -> Optional[MapTaskOutput]:
+        if self._stopped:
+            return None
+        self._stopped = True
+        if not success:
+            return None
+        t0 = time.monotonic()
+        serializer = self.manager.serializer
+        if self._combined is not None:
+            partition_bytes = [
+                serializer.serialize(d.items()) if d else b""
+                for d in self._combined
+            ]
+        else:
+            partition_bytes = [
+                serializer.serialize(b) if b else b"" for b in self._buckets
+            ]
+        self.metrics.bytes_written = sum(len(b) for b in partition_bytes)
+        mto = self.manager.resolver.commit_map_output(
+            self.handle.shuffle_id, self.map_id, partition_bytes
+        )
+        self.manager.publish_map_output(self.handle.shuffle_id, self.map_id, mto)
+        self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
+        return mto

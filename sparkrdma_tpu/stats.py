@@ -1,0 +1,82 @@
+"""Reader-side observability: remote-fetch latency histograms.
+
+Analog of RdmaShuffleReaderStats (RdmaShuffleReaderStats.scala:29-79):
+per-remote-host and global fixed-bucket latency histograms, printed at
+manager stop.  Bucket geometry from conf
+(fetchTimeBucketSizeInMs × fetchTimeNumBuckets; last bucket is
+open-ended).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+
+logger = logging.getLogger(__name__)
+
+
+class FetchHistogram:
+    def __init__(self, bucket_ms: int, num_buckets: int):
+        self.bucket_ms = bucket_ms
+        self.num_buckets = num_buckets
+        self._counts = [0] * num_buckets
+        self._lock = threading.Lock()
+
+    def add_sample(self, latency_ms: float) -> None:
+        idx = min(int(latency_ms // self.bucket_ms), self.num_buckets - 1)
+        with self._lock:
+            self._counts[idx] += 1
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    def to_string(self) -> str:
+        with self._lock:
+            counts = list(self._counts)
+        parts = []
+        for i, c in enumerate(counts):
+            lo = i * self.bucket_ms
+            if i == self.num_buckets - 1:
+                parts.append(f"[{lo}ms+]: {c}")
+            else:
+                parts.append(f"[{lo}-{lo + self.bucket_ms}ms]: {c}")
+        return ", ".join(parts)
+
+
+class ShuffleReaderStats:
+    """Per-remote-host fetch-latency histograms + a global one."""
+
+    def __init__(self, conf: TpuShuffleConf):
+        self.conf = conf
+        self._bucket_ms = conf.fetch_time_bucket_size_ms
+        self._num_buckets = conf.fetch_time_num_buckets
+        self._global = FetchHistogram(self._bucket_ms, self._num_buckets)
+        self._per_host: Dict[str, FetchHistogram] = {}
+        self._lock = threading.Lock()
+
+    def update(self, host: str, latency_ms: float) -> None:
+        with self._lock:
+            hist = self._per_host.get(host)
+            if hist is None:
+                hist = self._per_host.setdefault(
+                    host, FetchHistogram(self._bucket_ms, self._num_buckets)
+                )
+        hist.add_sample(latency_ms)
+        self._global.add_sample(latency_ms)
+
+    def print_stats(self) -> str:
+        """Log and return the formatted histograms (called at manager
+        stop, reference RdmaShuffleManager.scala:349-351)."""
+        lines = [f"remote fetch histogram (all hosts): {self._global.to_string()}"]
+        with self._lock:
+            hosts = dict(self._per_host)
+        for host, hist in sorted(hosts.items()):
+            lines.append(f"  {host}: {hist.to_string()}")
+        text = "\n".join(lines)
+        logger.info(text)
+        return text

@@ -1,0 +1,66 @@
+"""Record serialization for the host record path.
+
+The reference delegates serialization to Spark's SerializerInstance
+inside the wrapped sort-shuffle writers (RdmaWrapperShuffleWriter.scala:85-101)
+and wraps fetched streams for decompression on read
+(RdmaShuffleReader.scala:51-58).  Here serializers are pluggable; the
+default pickles record batches with a small length-prefixed framing so
+partitions can be concatenated and sliced bytewise.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Iterable, Iterator, List, Tuple
+
+Record = Tuple[Any, Any]
+
+_LEN = struct.Struct("<I")
+
+
+class Serializer:
+    def serialize(self, records: Iterable[Record]) -> bytes:  # pragma: no cover
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes) -> Iterator[Record]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class PickleSerializer(Serializer):
+    """Batched pickle with 4-byte batch length prefixes."""
+
+    def __init__(self, batch_size: int = 4096):
+        self.batch_size = batch_size
+
+    def serialize(self, records: Iterable[Record]) -> bytes:
+        out = bytearray()
+        batch: List[Record] = []
+        for rec in records:
+            batch.append(rec)
+            if len(batch) >= self.batch_size:
+                raw = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+                out += _LEN.pack(len(raw))
+                out += raw
+                batch = []
+        if batch:
+            raw = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+            out += _LEN.pack(len(raw))
+            out += raw
+        return bytes(out)
+
+    def deserialize(self, data: bytes) -> Iterator[Record]:
+        view = memoryview(data)
+        off = 0
+        while off < len(view):
+            if off + _LEN.size > len(view):
+                raise ValueError(f"truncated batch header at offset {off}")
+            (n,) = _LEN.unpack_from(view, off)
+            off += _LEN.size
+            if off + n > len(view):
+                raise ValueError(
+                    f"truncated batch: need {n}B at {off}, have {len(view) - off}B"
+                )
+            for rec in pickle.loads(view[off : off + n]):
+                yield rec
+            off += n

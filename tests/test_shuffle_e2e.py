@@ -1,0 +1,274 @@
+"""End-to-end shuffle over loopback: the minimum slice of SURVEY.md §7 —
+write → publish → resolve → fetch → read across multiple executors."""
+
+import threading
+import time
+from collections import defaultdict
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.shuffle.manager import Aggregator, TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner, RangePartitioner
+from sparkrdma_tpu.shuffle.reader import (
+    FetchFailedError,
+    MetadataFetchFailedError,
+)
+from sparkrdma_tpu.transport import LoopbackNetwork
+
+
+@pytest.fixture()
+def cluster(devices):
+    """Driver + 3 executors sharing one loopback network and conf."""
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.collectShuffleReaderStats": True,
+        "spark.shuffle.tpu.driverPort": 37000,
+        # keep failure tests fast; production default is 120s
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "5s",
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=38000 + i * 10, executor_id=str(i),
+        )
+        for i in range(3)
+    ]
+    # wait until announce reached everyone (control plane is async)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == 3 for e in executors):
+            break
+        time.sleep(0.01)
+    yield net, conf, driver, executors
+    for m in executors + [driver]:
+        m.stop()
+
+
+def run_maps(handle, executors, records_per_map):
+    """Job-layer stand-in: run map tasks round-robin over executors.
+    Returns maps_by_host (the MapOutputTracker analog)."""
+    maps_by_host = defaultdict(list)
+    for map_id, records in enumerate(records_per_map):
+        ex = executors[map_id % len(executors)]
+        w = ex.get_writer(handle, map_id)
+        w.write(records)
+        w.stop(True)
+        maps_by_host[ex.local_smid].append(map_id)
+    return dict(maps_by_host)
+
+
+def test_membership_and_announce(cluster):
+    net, conf, driver, executors = cluster
+    assert len(driver.executors) == 3
+    for e in executors:
+        assert len(e._peers) == 3
+
+
+def test_group_by_key_e2e(cluster):
+    net, conf, driver, executors = cluster
+    num_maps, num_parts = 4, 6
+    part = HashPartitioner(num_parts)
+    handle = driver.register_shuffle(0, num_maps, part)
+
+    records_per_map = [
+        [(f"k{j}", (m, j)) for j in range(50)] for m in range(num_maps)
+    ]
+    maps_by_host = run_maps(handle, executors, records_per_map)
+
+    expected = defaultdict(list)
+    for recs in records_per_map:
+        for k, v in recs:
+            expected[k].append(v)
+
+    got = {}
+    for i, ex in enumerate(executors):
+        # executor i reads partitions [i*2, i*2+2)
+        reader = ex.get_reader(handle, i * 2, i * 2 + 2, maps_by_host)
+        for k, v in reader.read():
+            got.setdefault(k, []).append(v)
+        assert reader.metrics.records_read > 0
+        assert reader.metrics.remote_blocks > 0  # cross-executor traffic
+        assert reader.metrics.local_blocks > 0
+
+    assert set(got) == set(expected)
+    for k in expected:
+        assert sorted(got[k]) == sorted(expected[k]), k
+
+
+def test_reduce_by_key_with_map_side_combine(cluster):
+    net, conf, driver, executors = cluster
+    agg = Aggregator(
+        create_combiner=lambda v: v,
+        merge_value=lambda c, v: c + v,
+        merge_combiners=lambda a, b: a + b,
+    )
+    part = HashPartitioner(4)
+    handle = driver.register_shuffle(
+        1, 3, part, aggregator=agg, map_side_combine=True
+    )
+    records_per_map = [
+        [(j % 10, 1) for j in range(100)] for _ in range(3)
+    ]
+    maps_by_host = run_maps(handle, executors, records_per_map)
+
+    got = {}
+    for ex in executors[:2]:
+        reader = ex.get_reader(handle, 0 if ex is executors[0] else 2,
+                               2 if ex is executors[0] else 4, maps_by_host)
+        got.update(dict(reader.read()))
+    assert got == {k: 30 for k in range(10)}
+
+
+def test_sort_by_key_e2e(cluster):
+    net, conf, driver, executors = cluster
+    import random
+
+    rng = random.Random(0)
+    all_keys = [rng.randrange(10**6) for _ in range(600)]
+    part = RangePartitioner(6, rng.sample(all_keys, 100))
+    handle = driver.register_shuffle(2, 3, part, key_ordering=True)
+    records_per_map = [
+        [(k, k * 2) for k in all_keys[m * 200 : (m + 1) * 200]]
+        for m in range(3)
+    ]
+    maps_by_host = run_maps(handle, executors, records_per_map)
+
+    out = []
+    for pid in range(6):
+        reader = executors[pid % 3].get_reader(handle, pid, pid + 1, maps_by_host)
+        chunk = list(reader.read())
+        # each partition comes out key-sorted
+        assert chunk == sorted(chunk, key=lambda kv: kv[0])
+        assert all(v == k * 2 for k, v in chunk)
+        out.extend(k for k, _ in chunk)
+    # concatenating the range partitions in order gives the global sort
+    assert out == sorted(all_keys)
+
+
+def test_empty_partitions(cluster):
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(8)
+    handle = driver.register_shuffle(3, 2, part)
+    # map 0 writes nothing at all; map 1 writes one record
+    maps_by_host = run_maps(handle, executors, [[], [("x", 1)]])
+    total = []
+    for pid in range(8):
+        r = executors[0].get_reader(handle, pid, pid + 1, maps_by_host)
+        total.extend(r.read())
+    assert total == [("x", 1)]
+
+
+def test_metadata_fetch_timeout(cluster):
+    net, conf, driver, executors = cluster
+    fast_conf_ms = 300
+    conf.set("partitionLocationFetchTimeout", f"{fast_conf_ms}ms")
+    part = HashPartitioner(2)
+    handle = driver.register_shuffle(4, 2, part)
+    # claim executor 1 hosts map 0, but never run the map task: locations
+    # can never resolve and the reader's timer must fire
+    maps_by_host = {executors[1].local_smid: [0]}
+    reader = executors[0].get_reader(handle, 0, 1, maps_by_host)
+    with pytest.raises(MetadataFetchFailedError):
+        list(reader.read())
+    conf.set("partitionLocationFetchTimeout", "120s")
+
+
+def test_executor_loss_fails_fetch(cluster):
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(2)
+    handle = driver.register_shuffle(5, 2, part)
+    maps_by_host = run_maps(handle, executors[:2], [[("a", 1)], [("b", 2)]])
+    # wait until both async publishes landed on the driver, THEN kill the
+    # executor — isolates the data-plane failure from the publish race
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if sum(len(v) for v in driver.maps_by_host(5).values()) == 2:
+            break
+        time.sleep(0.01)
+    victim = executors[1]
+    net.partition(victim.node.address)
+    reader = executors[0].get_reader(handle, 0, 2, maps_by_host)
+    with pytest.raises(FetchFailedError):
+        list(reader.read())
+    net.heal(victim.node.address)
+    # driver-side pruning (elastic membership)
+    driver.remove_executor(victim.local_smid)
+    assert victim.local_smid not in driver.executors
+
+
+def test_unregister_shuffle_releases_segments(cluster):
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(2)
+    handle = driver.register_shuffle(6, 2, part)
+    run_maps(handle, executors[:1], [[("a", 1)], [("b", 2)]])
+    ex = executors[0]
+    assert ex.arena.stats()["segments"] == 2
+    ex.unregister_shuffle(6)
+    assert ex.arena.stats()["segments"] == 0
+
+
+def test_stable_hash_cross_process():
+    # reviewer finding: builtin hash() is interpreter-salted; the
+    # partitioner must agree across executor processes
+    import subprocess
+    import sys
+
+    from sparkrdma_tpu.shuffle.partitioner import stable_hash
+
+    keys = ["k1", 42, -7, 3.5, (1, "a"), b"raw", True, "日本語"]
+    here = [stable_hash(k) for k in keys]
+    code = (
+        "from sparkrdma_tpu.shuffle.partitioner import stable_hash\n"
+        f"print([stable_hash(k) for k in {keys!r}])"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd="/root/repo", env={"PATH": "/usr/local/bin:/usr/bin:/bin",
+                               "PYTHONHASHSEED": "random",
+                               "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert eval(out.stdout) == here
+
+
+def test_map_task_retry_releases_old_segment(cluster):
+    # reviewer finding: re-committing a map output (speculation/retry)
+    # must release the superseded HBM segment
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(2)
+    handle = driver.register_shuffle(7, 1, part)
+    ex = executors[0]
+    w1 = ex.get_writer(handle, 0)
+    w1.write([("a", 1)])
+    w1.stop(True)
+    assert ex.arena.stats()["segments"] == 1
+    w2 = ex.get_writer(handle, 0)  # speculative re-run of map 0
+    w2.write([("a", 1)])
+    w2.stop(True)
+    s = ex.arena.stats()
+    assert s["segments"] == 1 and s["released_ever"] == 1
+
+
+def test_abandoned_reader_cleans_up(cluster):
+    # reviewer finding: abandoning the iterator mid-read must not leak
+    # callbacks or timers
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(2)
+    handle = driver.register_shuffle(8, 2, part)
+    maps_by_host = run_maps(
+        handle, executors[:2],
+        [[(f"k{i}", i) for i in range(500)], [(f"j{i}", i) for i in range(500)]],
+    )
+    ex = executors[0]
+    before = len(ex._callbacks)
+    it = ex.get_reader(handle, 0, 2, maps_by_host).read()
+    next(it)  # take one record, abandon the rest
+    del it
+    import gc
+    gc.collect()
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and len(ex._callbacks) > before:
+        time.sleep(0.05)
+    assert len(ex._callbacks) == before
