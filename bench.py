@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Benchmark: distributed-sort (TeraSort-style) shuffle throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference's headline result is HiBench TeraSort over 100 GbE RoCE
+(README.md:7-19): its shuffle data plane is bounded by the NIC line rate
+of 12.5 GB/s per node.  Here the same sortByKey pipeline (sample →
+range-partition → all_to_all → local sort) runs as one XLA program with
+the exchange riding ICI/HBM, so the comparable per-chip figure is
+end-to-end sorted bytes per second; vs_baseline divides by the
+reference's 12.5 GB/s per-node line rate ceiling.
+
+Runs on whatever devices are visible (the driver provides one real TPU
+chip; multi-chip scaling is validated separately by
+__graft_entry__.dryrun_multichip).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.models.terasort import TeraSorter
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+# 100 GbE RoCE line rate, the reference data plane's per-node ceiling (GB/s)
+BASELINE_GBPS = 12.5
+
+N_RECORDS = 1 << 24  # 16.7M records x 8B (int32 key + int32 val) = 134 MB
+WARMUP = 2
+ITERS = 5
+
+
+def main():
+    mesh = make_mesh()
+    sorter = TeraSorter(mesh)
+    rng = np.random.default_rng(42)
+    keys = jnp.asarray(
+        rng.integers(0, 1 << 31, size=N_RECORDS, dtype=np.int32)
+    )
+    vals = jnp.asarray(
+        rng.integers(0, 1 << 31, size=N_RECORDS, dtype=np.int32)
+    )
+    keys = jax.device_put(keys, sorter.sharding)
+    vals = jax.device_put(vals, sorter.sharding)
+
+    def run_once():
+        (sk, sv, n_valid, _), _cap = sorter.sort_device(keys, vals)
+        # fetch a real result: on the axon platform block_until_ready can
+        # return before the computation drains, so a device_get is the
+        # only trustworthy fence
+        np.asarray(jax.device_get(n_valid))
+        return sk, n_valid
+
+    for _ in range(WARMUP):
+        sk, n_valid = run_once()
+    # sanity: every record accounted for
+    assert int(jnp.sum(n_valid)) == N_RECORDS, "records lost in exchange"
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        run_once()
+    dt = (time.perf_counter() - t0) / ITERS
+
+    bytes_per_iter = N_RECORDS * 8  # key + value
+    gbps = bytes_per_iter / dt / 1e9
+    n_chips = len(list(mesh.devices.flat))
+    per_chip = gbps / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "terasort shuffle+sort throughput per chip "
+                          f"({N_RECORDS} records, {n_chips} chip(s))",
+                "value": round(per_chip, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

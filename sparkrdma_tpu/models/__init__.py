@@ -1,0 +1,10 @@
+"""Device-native dataflow "models": the benchmark workloads of the
+reference (HiBench TeraSort / Sort / WordCount, README.md:7-19) rebuilt
+as single XLA programs over the exchange mesh — partition, all_to_all,
+and reduce/sort fused into one jitted SPMD step instead of a CPU
+serializer + NIC pull loop."""
+
+from sparkrdma_tpu.models.terasort import TeraSorter, make_sort_step
+from sparkrdma_tpu.models.wordcount import WordCounter, make_count_step
+
+__all__ = ["TeraSorter", "make_sort_step", "WordCounter", "make_count_step"]

@@ -1,0 +1,188 @@
+"""TeraSort on the mesh: the flagship workload.
+
+The reference's headline benchmark is HiBench TeraSort 175 GB — a
+``sortByKey`` whose shuffle moves every record once over the NIC
+(README.md:7-19).  Here the whole job is ONE jitted SPMD program per
+step:
+
+    sample → splitters → range partition → all_to_all → local sort
+
+Each device samples its keys, the sample is all-gathered to derive
+global equal-frequency splitters, records are capacity-bucketed per
+destination (sparkrdma_tpu.ops.partition), exchanged with a single
+``all_to_all`` riding ICI, and sorted locally — the concatenation of the
+devices' outputs (minus sentinel padding) is the global sort.
+
+Skew handling: buckets are capacity-padded (static shapes); true counts
+travel with the exchange, and overflow (count > capacity) is detected on
+the host, which re-runs with a larger capacity factor — the SPMD analog
+of the reference's maxAggBlock fetch cap (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.ops.partition import make_range_splitters
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+
+
+def _local_sort_step(keys, vals, n_devices, capacity, sample_size):
+    """Per-device body (runs under shard_map).  keys/vals: [n_local].
+
+    TPU-tuned shape: sort the LOCAL pairs first, so (a) the sample is an
+    exact local quantile sketch and (b) each destination's records form
+    one contiguous window of the sorted run — bucketing is then pure
+    sequential gathers with zero scatters and no second keyed sort.
+    """
+    n_local = keys.shape[0]
+    k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+    # exact local quantiles (k is sorted): positions i*n/S
+    sample = k[(jnp.arange(sample_size) * n_local) // sample_size]
+    all_samples = jax.lax.all_gather(sample, EXCHANGE_AXIS)  # [D, S]
+    splitters = make_range_splitters(all_samples.reshape(-1), n_devices)
+    # destination windows: device p gets keys in [splitters[p-1], splitters[p])
+    edges = jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.searchsorted(k, splitters, side="right").astype(jnp.int32),
+        jnp.full((1,), n_local, jnp.int32),
+    ])
+    counts = edges[1:] - edges[:-1]                       # true counts [D]
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    idx = jnp.clip(edges[:-1][:, None] + slot[None, :], 0, n_local - 1)
+    valid = slot[None, :] < jnp.minimum(counts, capacity)[:, None]
+    sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
+    bk = jnp.where(valid, k[idx], sentinel)               # [D, cap]
+    bv = jnp.where(valid, v[idx], jnp.zeros((), v.dtype))
+    # exchange: device d keeps row d of every source
+    rk = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    rv = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    rcounts = jax.lax.all_to_all(
+        jnp.minimum(counts, capacity).reshape(n_devices, 1), EXCHANGE_AXIS,
+        split_axis=0, concat_axis=0,
+    ).reshape(n_devices)
+    # merge the D received sorted runs; sentinel padding sorts to the tail
+    sorted_k, sorted_v = jax.lax.sort(
+        (rk.reshape(-1), rv.reshape(-1)), num_keys=1, is_stable=True
+    )
+    n_valid = jnp.sum(rcounts).astype(jnp.int32)
+    # overflow indicator: true pre-clamp counts, maxed over destinations
+    overflow = jnp.max(counts).astype(jnp.int32)
+    return sorted_k, sorted_v, n_valid, overflow
+
+
+@functools.lru_cache(maxsize=16)
+def make_sort_step(
+    mesh: Mesh, n_local: int, capacity: int, sample_size: int = 1024
+):
+    """Build the jitted distributed-sort step for a fixed local size.
+
+    Returns fn(keys, vals) over GLOBAL arrays [D * n_local] sharded on
+    the mesh axis, producing per-device sorted runs
+    (keys' [D, D*capacity], vals', valid counts [D], max bucket fill [D]).
+    """
+    D = len(list(mesh.devices.flat))
+    spec = P(EXCHANGE_AXIS)
+
+    def body(k, v):  # local [n_local]
+        sk, sv, n_valid, overflow = _local_sort_step(
+            k, v, D, capacity, sample_size
+        )
+        return sk, sv, n_valid[None], overflow[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec),
+        out_specs=(spec, spec, spec, spec),
+    )
+    return jax.jit(mapped)
+
+
+class TeraSorter:
+    """Host-facing driver for the distributed sort (the sortByKey job).
+
+    ``sort(keys, vals)`` pads to the mesh, runs the SPMD step, re-runs
+    with doubled capacity on overflow, and returns globally sorted
+    host arrays.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        capacity_factor: float = 1.3,
+        sample_size: int = 1024,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_devices = len(list(self.mesh.devices.flat))
+        self.capacity_factor = capacity_factor
+        self.sample_size = sample_size
+        self.sharding = NamedSharding(self.mesh, P(EXCHANGE_AXIS))
+
+    def _capacity(self, n_local: int, factor: float) -> int:
+        cap = int(math.ceil(n_local / self.n_devices * factor))
+        return max(8, (cap + 7) // 8 * 8)  # sublane-friendly
+
+    def sort_device(
+        self, keys: jax.Array, vals: jax.Array, capacity: Optional[int] = None
+    ):
+        """One SPMD sort step on device-resident global arrays whose
+        length is a multiple of D.  Returns device results unfetched
+        (async) — the jittable hot path."""
+        n = keys.shape[0]
+        if n % self.n_devices:
+            raise ValueError(f"length {n} not divisible by D={self.n_devices}")
+        n_local = n // self.n_devices
+        cap = capacity or self._capacity(n_local, self.capacity_factor)
+        step = make_sort_step(
+            self.mesh, n_local, cap, min(self.sample_size, max(1, n_local))
+        )
+        keys = jax.device_put(keys, self.sharding)
+        vals = jax.device_put(vals, self.sharding)
+        return step(keys, vals), cap
+
+    def sort(self, keys, vals=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Full host-facing sortByKey: returns (sorted_keys, sorted_vals)."""
+        keys = np.asarray(keys)
+        if vals is None:
+            vals = np.zeros_like(keys)
+        vals = np.asarray(vals)
+        if keys.shape != vals.shape or keys.ndim != 1:
+            raise ValueError("keys/vals must be equal-length 1-D arrays")
+        n = keys.shape[0]
+        if n == 0:
+            return keys.copy(), vals.copy()
+        # pad to a multiple of D with sentinels that sort last and are
+        # trimmed via the valid counts
+        sentinel = np.array(np.iinfo(keys.dtype).max, keys.dtype)
+        D = self.n_devices
+        n_pad = (-n) % D
+        if n_pad:
+            keys = np.concatenate([keys, np.full(n_pad, sentinel, keys.dtype)])
+            vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
+        factor = self.capacity_factor
+        for _attempt in range(6):
+            (sk, sv, n_valid, max_fill), cap = self.sort_device(
+                jnp.asarray(keys), jnp.asarray(vals),
+                capacity=self._capacity(keys.shape[0] // D, factor),
+            )
+            if int(jnp.max(max_fill)) <= cap:
+                break
+            factor *= 2  # skewed keys overflowed a bucket: re-run bigger
+        else:
+            raise RuntimeError("bucket overflow persisted after 6 retries")
+        # stitch: per-device sorted runs, trimmed to their valid counts
+        sk_h = np.asarray(sk).reshape(D, -1)
+        sv_h = np.asarray(sv).reshape(D, -1)
+        nv = np.asarray(n_valid).reshape(-1)
+        out_k = np.concatenate([sk_h[d, : nv[d]] for d in range(D)])
+        out_v = np.concatenate([sv_h[d, : nv[d]] for d in range(D)])
+        # drop host padding sentinels (they sorted into the final run)
+        if n_pad:
+            out_k, out_v = out_k[:n], out_v[:n]
+        return out_k, out_v

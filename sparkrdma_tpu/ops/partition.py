@@ -80,30 +80,58 @@ def partition_to_buckets(
       that signals overflow; the caller re-runs with larger capacity).
     """
     n = part_ids.shape[0]
-    counts = jnp.bincount(part_ids, length=n_parts).astype(jnp.int32)
-    # stable sort groups elements by destination, preserving order
-    order = jnp.argsort(part_ids, stable=True)
-    sorted_ids = part_ids[order]
-    # position of each element within its bucket
-    starts = jnp.searchsorted(sorted_ids, jnp.arange(n_parts, dtype=sorted_ids.dtype))
-    pos = jnp.arange(n) - starts[sorted_ids]
-    in_cap = pos < capacity
-    # overflow entries scatter out-of-bounds and are dropped
-    flat_dest = jnp.where(
-        in_cap, sorted_ids * capacity + pos, n_parts * capacity
-    )
     if fill_values is None:
         fill_values = tuple(
             _default_fill(v.dtype) if i == 0 else jnp.zeros((), v.dtype)
             for i, v in enumerate(values)
         )
+    if n == 0:
+        # empty local shard (legal under SPMD): all-fill buckets
+        counts = jnp.zeros((n_parts,), jnp.int32)
+        bucketed = tuple(
+            jnp.full((n_parts, capacity) + v.shape[1:], fill, v.dtype)
+            for v, fill in zip(values, fill_values)
+        )
+        return bucketed, counts
+    # TPU-critical: NO scatters on the hot path — random scatter is ~30x
+    # slower than sort+gather on TPU.  One stable multi-operand sort
+    # groups elements by destination; buckets are then near-sequential
+    # gathers at starts[p] + j.  1-D values ride the sort directly;
+    # multi-dim values are gathered through the sorted permutation
+    # (lax.sort requires equal operand shapes).
+    flat_vals = [v for v in values if v.ndim == 1]
+    nd_vals = [v for v in values if v.ndim > 1]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        (part_ids.astype(jnp.int32),)
+        + ((iota,) if nd_vals else ())
+        + tuple(flat_vals),
+        num_keys=1, is_stable=True,
+    )
+    sorted_ids = sorted_ops[0]
+    perm = sorted_ops[1] if nd_vals else None
+    sorted_flat = sorted_ops[2:] if nd_vals else sorted_ops[1:]
+    edges = jnp.searchsorted(
+        sorted_ids, jnp.arange(n_parts + 1, dtype=jnp.int32)
+    )  # [n_parts+1] bucket boundaries in the sorted order
+    counts = (edges[1:] - edges[:-1]).astype(jnp.int32)
+    starts = edges[:-1]
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    idx = starts[:, None] + slot[None, :]              # [n_parts, capacity]
+    # overflow entries simply fall outside the capacity window
+    valid = slot[None, :] < jnp.minimum(counts, capacity)[:, None]
+    gather_idx = jnp.clip(idx, 0, n - 1)
     bucketed = []
+    flat_iter = iter(sorted_flat)
     for v, fill in zip(values, fill_values):
-        sv = v[order]
-        flat_shape = (n_parts * capacity,) + v.shape[1:]
-        out = jnp.full(flat_shape, fill, dtype=v.dtype)
-        out = out.at[flat_dest].set(sv, mode="drop")
-        bucketed.append(out.reshape((n_parts, capacity) + v.shape[1:]))
+        if v.ndim == 1:
+            b = next(flat_iter)[gather_idx]            # [n_parts, capacity]
+            b = jnp.where(valid, b, jnp.asarray(fill, v.dtype))
+        else:
+            b = v[perm[gather_idx]]                    # [n_parts, capacity, ...]
+            mask = valid.reshape(valid.shape + (1,) * (v.ndim - 1))
+            b = jnp.where(mask, b, jnp.asarray(fill, v.dtype))
+        bucketed.append(b)
     return tuple(bucketed), counts
 
 

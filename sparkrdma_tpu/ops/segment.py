@@ -1,0 +1,54 @@
+"""Device-side keyed reductions: the combiner step as an XLA program.
+
+The reference's aggregation runs on the CPU during the read path
+(RdmaShuffleReader.scala:82-97, Spark's Aggregator); on TPU the
+post-exchange combine is a device program: sort the received keys, find
+segment boundaries, segment-sum the values — all static shapes with
+sentinel padding.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def reduce_by_key_local(
+    keys: jax.Array, vals: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Reduce (sum) values by key over one device's elements.
+
+    Invalid slots must be PRE-MASKED by the caller: key == dtype max
+    (the sentinel) and value == 0.  Valid entries may sit anywhere (they
+    need not form a prefix — post-exchange buckets are row-scattered).
+
+    Returns:
+      (unique_keys, sums, n_unique): [n] arrays where the first n_unique
+      slots hold each distinct real key and the sum of its values; the
+      rest is sentinel (key dtype max, zero sums).
+    """
+    n = keys.shape[0]
+    sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
+    # TPU-critical: scatter-free.  Sort pairs, then extract per-run totals
+    # as differences of the value prefix-sum at run ends; compact the run
+    # ends to the front with a second (cheap) sort instead of a scatter.
+    ks, vs = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+    csum = jnp.cumsum(vs)
+    is_last = jnp.concatenate(
+        [ks[1:] != ks[:-1], jnp.ones(1, bool)]
+    )  # last element of each run
+    real_last = is_last & (ks != sentinel)
+    sel_key = jnp.where(real_last, ks, sentinel)
+    sel_end = jnp.where(real_last, csum, jnp.zeros((), csum.dtype))
+    uniq, ends = jax.lax.sort((sel_key, sel_end), num_keys=1, is_stable=True)
+    # runs are contiguous in ks, and uniq preserves key order, so each
+    # run's sum = its end-csum minus the previous run's end-csum
+    prev = jnp.concatenate([jnp.zeros(1, ends.dtype), ends[:-1]])
+    is_real = uniq != sentinel
+    sums = jnp.where(is_real, ends - prev, jnp.zeros((), vals.dtype)).astype(
+        vals.dtype
+    )
+    n_unique = jnp.sum(is_real).astype(jnp.int32)
+    return uniq, sums, n_unique

@@ -1,0 +1,76 @@
+"""Device-native model pipelines (TeraSort, WordCount) on the 8-device
+CPU mesh — the flagship workloads (SURVEY.md §6 benchmarks)."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.models import TeraSorter, WordCounter
+from sparkrdma_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def test_terasort_uniform(mesh, devices):
+    sorter = TeraSorter(mesh)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 31, size=100_000, dtype=np.int32)
+    vals = rng.integers(0, 1 << 31, size=100_000, dtype=np.int32)
+    sk, sv = sorter.sort(keys, vals)
+    order = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(sk, keys[order])
+    np.testing.assert_array_equal(np.sort(sv), np.sort(vals))
+    # key-value alignment preserved through the exchange
+    kv = dict()
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        kv.setdefault(k, []).append(v)
+    for k, v in zip(sk[:100].tolist(), sv[:100].tolist()):
+        assert v in kv[k]
+
+
+def test_terasort_skewed_overflow_retry(mesh, devices):
+    sorter = TeraSorter(mesh, capacity_factor=1.05)
+    rng = np.random.default_rng(1)
+    # 60% of keys in a tiny range → one device's bucket overflows at
+    # factor 1.05 and the host must retry with doubled capacity
+    a = rng.integers(0, 100, size=60_000, dtype=np.int32)
+    b = rng.integers(0, 1 << 30, size=40_000, dtype=np.int32)
+    keys = np.concatenate([a, b])
+    rng.shuffle(keys)
+    sk, _ = sorter.sort(keys, keys)
+    np.testing.assert_array_equal(sk, np.sort(keys))
+
+
+def test_terasort_ragged_length_and_empty(mesh, devices):
+    sorter = TeraSorter(mesh)
+    keys = np.array([5, 3, 9], dtype=np.int32)  # not divisible by 8
+    sk, sv = sorter.sort(keys, keys * 10)
+    np.testing.assert_array_equal(sk, [3, 5, 9])
+    np.testing.assert_array_equal(sv, [30, 50, 90])
+    ek, ev = sorter.sort(np.array([], dtype=np.int32))
+    assert ek.size == 0 and ev.size == 0
+
+
+def test_wordcount(mesh, devices):
+    wc = WordCounter(mesh)
+    rng = np.random.default_rng(2)
+    keys = rng.integers(0, 1000, size=50_000, dtype=np.int32)
+    got = wc.count(keys)
+    expect = {int(k): int(c) for k, c in zip(*np.unique(keys, return_counts=True))}
+    assert got == expect
+
+
+def test_wordcount_weighted_values(mesh, devices):
+    wc = WordCounter(mesh)
+    keys = np.array([1, 2, 1, 3, 2, 1], dtype=np.int32)
+    vals = np.array([10, 20, 30, 40, 50, 60], dtype=np.int32)
+    assert wc.count(keys, vals) == {1: 100, 2: 70, 3: 40}
+
+
+def test_wordcount_single_hot_key(mesh, devices):
+    # extreme skew: every record hits one key on one device
+    wc = WordCounter(mesh, capacity_factor=1.1)
+    keys = np.full(10_000, 77, dtype=np.int32)
+    assert wc.count(keys) == {77: 10_000}

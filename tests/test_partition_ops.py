@@ -89,3 +89,30 @@ def test_partition_ops_are_jittable():
     bk, counts = pipeline(keys)
     assert bk.shape == (4, 64)
     assert int(counts.sum()) == 100
+
+
+def test_partition_multidim_values():
+    # reviewer finding: [n, d] value arrays must bucket alongside keys
+    rng = np.random.default_rng(3)
+    n = 500
+    keys = jnp.asarray(rng.integers(0, 1 << 20, size=n, dtype=np.int32))
+    emb = jnp.asarray(rng.integers(0, 100, size=(n, 4), dtype=np.int32))
+    ids = hash_partition_ids(keys, 4)
+    (bk, be), counts = partition_to_buckets(ids, (keys, emb), 4, 256)
+    assert be.shape == (4, 256, 4)
+    np_ids = np.asarray(ids)
+    for p in range(4):
+        c = int(counts[p])
+        np.testing.assert_array_equal(
+            np.asarray(be[p][:c]), np.asarray(emb)[np_ids == p]
+        )
+
+
+def test_partition_empty_input():
+    # reviewer finding: empty local shards must produce all-fill buckets
+    (bk,), counts = partition_to_buckets(
+        jnp.zeros((0,), jnp.int32), (jnp.zeros((0,), jnp.int32),), 4, 8
+    )
+    assert bk.shape == (4, 8)
+    assert int(np.asarray(counts).sum()) == 0
+    assert int(np.asarray(bk).min()) == np.iinfo(np.int32).max
