@@ -114,7 +114,21 @@ class TpuShuffleConf:
 
     @property
     def sw_flow_control(self) -> bool:
+        """Receiver-credit flow control on the control plane (reference:
+        credit reports via RDMA_WRITE_WITH_IMM, RdmaChannel.java:508-520)."""
         return self._bool("swFlowControl", True)
+
+    @property
+    def trace(self) -> bool:
+        """Enable span tracing (chrome://tracing JSON via Tracer.dump)."""
+        return self._bool("trace", False)
+
+    @property
+    def lazy_staging(self) -> bool:
+        """ODP analog (reference: useOdp, RdmaShuffleConf.scala:68-83):
+        keep committed map output in host memory and stage to HBM on
+        demand at exchange time, instead of eagerly at commit."""
+        return self._bool("lazyStaging", False)
 
     # -- memory / arenas (reference: maxBufferAllocationSize, ODP) ----------
     @property

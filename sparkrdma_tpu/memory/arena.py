@@ -27,14 +27,28 @@ from sparkrdma_tpu.utils.types import BlockLocation
 
 
 class DeviceSegment:
-    """One registered HBM segment (a uint8 device array)."""
+    """One registered HBM segment (a uint8 device array).
 
-    def __init__(self, mkey: int, array, shuffle_id: Optional[int] = None):
+    ``keepalive`` pins an underlying host buffer (e.g. a pooled staging
+    buffer PJRT may have zero-copy aliased) until the segment is
+    released; its ``free()`` is called exactly once on release."""
+
+    def __init__(self, mkey: int, array, shuffle_id: Optional[int] = None,
+                 keepalive=None):
         self.mkey = mkey
         self.array = array  # jax.Array uint8[nbytes] (or np.ndarray on host)
         self.nbytes = int(array.shape[0])
         self.shuffle_id = shuffle_id
+        self.keepalive = keepalive
         self.created_at = time.monotonic()
+
+    def _release_keepalive(self) -> None:
+        ka, self.keepalive = self.keepalive, None
+        if ka is not None:
+            try:
+                ka.free()
+            except Exception:
+                pass
 
     def read(self, offset: int, length: int) -> bytes:
         end = offset + length
@@ -59,7 +73,8 @@ class ArenaManager(BlockStore):
         self._registered_ever = 0
         self._released_ever = 0
 
-    def register(self, array, shuffle_id: Optional[int] = None) -> DeviceSegment:
+    def register(self, array, shuffle_id: Optional[int] = None,
+                 keepalive=None) -> DeviceSegment:
         """Register a 1-D uint8 array as a readable segment."""
         if array.ndim != 1 or str(array.dtype) != "uint8":
             raise ValueError(
@@ -74,7 +89,7 @@ class ArenaManager(BlockStore):
                 )
             mkey = self._next_mkey
             self._next_mkey += 1
-            seg = DeviceSegment(mkey, array, shuffle_id)
+            seg = DeviceSegment(mkey, array, shuffle_id, keepalive=keepalive)
             self._segments[mkey] = seg
             self._total_bytes += nbytes
             self._registered_ever += 1
@@ -90,6 +105,8 @@ class ArenaManager(BlockStore):
             if seg is not None:
                 self._total_bytes -= seg.nbytes
                 self._released_ever += 1
+        if seg is not None:
+            seg._release_keepalive()
 
     def release_shuffle(self, shuffle_id: int) -> int:
         """Release all segments belonging to one shuffle (unregister path,
@@ -97,11 +114,13 @@ class ArenaManager(BlockStore):
         with self._lock:
             doomed = [k for k, s in self._segments.items()
                       if s.shuffle_id == shuffle_id]
-            for k in doomed:
-                seg = self._segments.pop(k)
+            segs = [self._segments.pop(k) for k in doomed]
+            for seg in segs:
                 self._total_bytes -= seg.nbytes
                 self._released_ever += 1
-        return len(doomed)
+        for seg in segs:
+            seg._release_keepalive()
+        return len(segs)
 
     # -- BlockStore ---------------------------------------------------------
     def read_block(self, location: BlockLocation) -> bytes:
@@ -127,5 +146,8 @@ class ArenaManager(BlockStore):
 
     def stop(self) -> None:
         with self._lock:
+            segs = list(self._segments.values())
             self._segments.clear()
             self._total_bytes = 0
+        for seg in segs:
+            seg._release_keepalive()

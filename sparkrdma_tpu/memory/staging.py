@@ -139,6 +139,25 @@ class StagingPool:
                 "total_allocs": self._total_allocs,
             }
 
+    def prealloc(self, total_bytes: int, block_size: int) -> int:
+        """Warm the pool with ``total_bytes`` worth of ``block_size``
+        blocks (reference: executor-side async preallocation of
+        maxAggBlock buffers, RdmaBufferManager.java:112-120).  Returns
+        the number of blocks preallocated."""
+        if total_bytes <= 0 or block_size <= 0:
+            return 0
+        n = max(1, total_bytes // block_size)
+        bufs = []
+        try:
+            for _ in range(n):
+                bufs.append(self.alloc(block_size))
+        except MemoryError:
+            pass  # budget hit: keep what we got
+        count = len(bufs)
+        for b in bufs:
+            b.free()
+        return count
+
     def trim(self, target_idle_bytes: int = 0) -> None:
         if self.is_native:
             _NATIVE.staging_pool_trim(

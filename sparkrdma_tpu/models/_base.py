@@ -1,0 +1,55 @@
+"""Shared host-driver machinery for the SPMD model pipelines.
+
+Capacity sizing and the overflow-retry loop are policy, shared by every
+capacity-bucketed exchange model (sort, count, …): buckets are padded to
+a static capacity; true counts travel with the exchange; if any bucket's
+true count exceeded capacity the host re-runs the step with doubled
+capacity (the SPMD inversion of the reference's maxAggBlock fetch cap,
+SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+
+MAX_OVERFLOW_RETRIES = 6
+
+
+class ExchangeModel:
+    """Base for host-facing drivers of capacity-bucketed SPMD steps."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, capacity_factor: float = 1.3):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_devices = len(list(self.mesh.devices.flat))
+        self.capacity_factor = capacity_factor
+        self.sharding = NamedSharding(self.mesh, P(EXCHANGE_AXIS))
+
+    def _capacity(self, n_local: int, factor: Optional[float] = None) -> int:
+        """Per-bucket capacity: n_local/D scaled by the skew factor,
+        rounded up to a sublane-friendly multiple of 8."""
+        factor = self.capacity_factor if factor is None else factor
+        cap = int(math.ceil(n_local / self.n_devices * factor))
+        return max(8, (cap + 7) // 8 * 8)
+
+    def _run_with_overflow_retry(
+        self, n_total: int, run: Callable[[int], Tuple]
+    ):
+        """Call ``run(capacity)`` → (outputs, max_fill); re-run with
+        doubled factor while any bucket overflowed."""
+        factor = self.capacity_factor
+        for _attempt in range(MAX_OVERFLOW_RETRIES):
+            cap = self._capacity(n_total // self.n_devices, factor)
+            outputs, max_fill = run(cap)
+            if int(np.max(np.asarray(max_fill))) <= cap:
+                return outputs
+            factor *= 2  # key skew overflowed a bucket: retry bigger
+        raise RuntimeError(
+            f"bucket overflow persisted after {MAX_OVERFLOW_RETRIES} retries"
+        )

@@ -5,13 +5,20 @@ The reference's headline benchmark is HiBench TeraSort 175 GB — a
 (README.md:7-19).  Here the whole job is ONE jitted SPMD program per
 step:
 
-    sample → splitters → range partition → all_to_all → local sort
+    local sort → quantile sample → splitters → contiguous destination
+    windows → all_to_all → merge received sorted runs
 
-Each device samples its keys, the sample is all-gathered to derive
-global equal-frequency splitters, records are capacity-bucketed per
-destination (sparkrdma_tpu.ops.partition), exchanged with a single
-``all_to_all`` riding ICI, and sorted locally — the concatenation of the
-devices' outputs (minus sentinel padding) is the global sort.
+Each device sorts its local pairs first (so the sample is an exact local
+quantile sketch and destination windows are contiguous — bucketing is
+pure sequential gathers, zero scatters), the sample is all-gathered to
+derive global equal-frequency splitters, windows are exchanged with a
+single ``all_to_all`` riding ICI, and the received runs are merged.
+The concatenation of the devices' outputs (trimmed by the true counts)
+is the global sort.
+
+Validity is tracked as an explicit 0/1 column ordered as a secondary
+sort key, so padding always sorts strictly after real records — real
+keys equal to the dtype max are NOT confused with padding.
 
 Skew handling: buckets are capacity-padded (static shapes); true counts
 travel with the exchange, and overflow (count > capacity) is detected on
@@ -22,28 +29,38 @@ of the reference's maxAggBlock fetch cap (SURVEY.md §7 hard parts).
 from __future__ import annotations
 
 import functools
-import math
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
+from sparkrdma_tpu.models._base import ExchangeModel
 from sparkrdma_tpu.ops.partition import make_range_splitters
-from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
 
-def _local_sort_step(keys, vals, n_devices, capacity, sample_size):
-    """Per-device body (runs under shard_map).  keys/vals: [n_local].
+def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
+    """Per-device body (runs under shard_map).  keys/vals: [n_local];
+    ``valid`` is int32 0/1 or None (= everything valid, skips the column).
 
-    TPU-tuned shape: sort the LOCAL pairs first, so (a) the sample is an
-    exact local quantile sketch and (b) each destination's records form
-    one contiguous window of the sorted run — bucketing is then pure
-    sequential gathers with zero scatters and no second keyed sort.
+    Invalid (padding) slots sort after every real slot of the same key
+    via the secondary sort key, and are excluded from counts.
     """
     n_local = keys.shape[0]
-    k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+    if valid is None:
+        # fast path: every input slot is real
+        k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+        n_real = jnp.int32(n_local)
+    else:
+        # the (key, invalid) two-key sort puts every invalid slot at the
+        # global tail (invalid ⊂ sentinel-key group, ordered last within
+        # it), so validity per destination window is always a SUFFIX —
+        # a per-window valid count replaces a whole per-element column
+        inv = jnp.int32(1) - valid
+        k, _, v = jax.lax.sort((keys, inv, vals), num_keys=2, is_stable=True)
+        n_real = jnp.sum(valid).astype(jnp.int32)
     # exact local quantiles (k is sorted): positions i*n/S
     sample = k[(jnp.arange(sample_size) * n_local) // sample_size]
     all_samples = jax.lax.all_gather(sample, EXCHANGE_AXIS)  # [D, S]
@@ -54,25 +71,35 @@ def _local_sort_step(keys, vals, n_devices, capacity, sample_size):
         jnp.searchsorted(k, splitters, side="right").astype(jnp.int32),
         jnp.full((1,), n_local, jnp.int32),
     ])
-    counts = edges[1:] - edges[:-1]                       # true counts [D]
+    counts = edges[1:] - edges[:-1]                       # shipped counts [D]
+    starts = edges[:-1]
+    # valid records in window [start, end): everything before the global
+    # invalid tail at position n_real
+    valid_counts = jnp.clip(
+        jnp.minimum(edges[1:], n_real) - starts, 0, capacity
+    )
     slot = jnp.arange(capacity, dtype=jnp.int32)
-    idx = jnp.clip(edges[:-1][:, None] + slot[None, :], 0, n_local - 1)
-    valid = slot[None, :] < jnp.minimum(counts, capacity)[:, None]
+    idx = jnp.clip(starts[:, None] + slot[None, :], 0, n_local - 1)
+    window_valid = slot[None, :] < jnp.minimum(counts, capacity)[:, None]
     sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
-    bk = jnp.where(valid, k[idx], sentinel)               # [D, cap]
-    bv = jnp.where(valid, v[idx], jnp.zeros((), v.dtype))
+    bk = jnp.where(window_valid, k[idx], sentinel)        # [D, cap]
+    bv = jnp.where(window_valid, v[idx], jnp.zeros((), v.dtype))
     # exchange: device d keeps row d of every source
     rk = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
     rv = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-    rcounts = jax.lax.all_to_all(
-        jnp.minimum(counts, capacity).reshape(n_devices, 1), EXCHANGE_AXIS,
+    rvalid = jax.lax.all_to_all(
+        valid_counts.reshape(n_devices, 1), EXCHANGE_AXIS,
         split_axis=0, concat_axis=0,
     ).reshape(n_devices)
-    # merge the D received sorted runs; sentinel padding sorts to the tail
-    sorted_k, sorted_v = jax.lax.sort(
-        (rk.reshape(-1), rv.reshape(-1)), num_keys=1, is_stable=True
+    n_valid = jnp.sum(rvalid).astype(jnp.int32)
+    # reconstruct per-slot validity from the suffix property, then merge
+    # the D received runs with validity as tiebreak so padding (incl.
+    # pads whose key equals a real max-valued key) sorts strictly last
+    riv = (slot[None, :] >= rvalid[:, None]).astype(jnp.int32).reshape(-1)
+    sorted_k, sorted_iv, sorted_v = jax.lax.sort(
+        (rk.reshape(-1), riv, rv.reshape(-1)),
+        num_keys=2, is_stable=True,
     )
-    n_valid = jnp.sum(rcounts).astype(jnp.int32)
     # overflow indicator: true pre-clamp counts, maxed over destinations
     overflow = jnp.max(counts).astype(jnp.int32)
     return sorted_k, sorted_v, n_valid, overflow
@@ -80,31 +107,48 @@ def _local_sort_step(keys, vals, n_devices, capacity, sample_size):
 
 @functools.lru_cache(maxsize=16)
 def make_sort_step(
-    mesh: Mesh, n_local: int, capacity: int, sample_size: int = 1024
+    mesh: Mesh, n_local: int, capacity: int, sample_size: int = 1024,
+    with_validity: bool = True,
 ):
     """Build the jitted distributed-sort step for a fixed local size.
 
-    Returns fn(keys, vals) over GLOBAL arrays [D * n_local] sharded on
-    the mesh axis, producing per-device sorted runs
+    With ``with_validity`` the step is fn(keys, vals, valid) where
+    ``valid`` int32 0/1 marks real records; without, fn(keys, vals)
+    treats every slot as real (the no-padding fast path).  Arrays are
+    GLOBAL [D * n_local] sharded on the mesh axis; outputs are
+    per-device sorted runs
     (keys' [D, D*capacity], vals', valid counts [D], max bucket fill [D]).
     """
     D = len(list(mesh.devices.flat))
+    from jax.sharding import PartitionSpec as P
+
     spec = P(EXCHANGE_AXIS)
 
-    def body(k, v):  # local [n_local]
-        sk, sv, n_valid, overflow = _local_sort_step(
-            k, v, D, capacity, sample_size
-        )
-        return sk, sv, n_valid[None], overflow[None]
+    if with_validity:
+        def body(k, v, valid):  # local [n_local]
+            sk, sv, n_valid, overflow = _local_sort_step(
+                k, v, valid, D, capacity, sample_size
+            )
+            return sk, sv, n_valid[None], overflow[None]
+
+        in_specs = (spec, spec, spec)
+    else:
+        def body(k, v):  # local [n_local]
+            sk, sv, n_valid, overflow = _local_sort_step(
+                k, v, None, D, capacity, sample_size
+            )
+            return sk, sv, n_valid[None], overflow[None]
+
+        in_specs = (spec, spec)
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec),
+        body, mesh=mesh, in_specs=in_specs,
         out_specs=(spec, spec, spec, spec),
     )
     return jax.jit(mapped)
 
 
-class TeraSorter:
+class TeraSorter(ExchangeModel):
     """Host-facing driver for the distributed sort (the sortByKey job).
 
     ``sort(keys, vals)`` pads to the mesh, runs the SPMD step, re-runs
@@ -118,18 +162,12 @@ class TeraSorter:
         capacity_factor: float = 1.3,
         sample_size: int = 1024,
     ):
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_devices = len(list(self.mesh.devices.flat))
-        self.capacity_factor = capacity_factor
+        super().__init__(mesh, capacity_factor)
         self.sample_size = sample_size
-        self.sharding = NamedSharding(self.mesh, P(EXCHANGE_AXIS))
-
-    def _capacity(self, n_local: int, factor: float) -> int:
-        cap = int(math.ceil(n_local / self.n_devices * factor))
-        return max(8, (cap + 7) // 8 * 8)  # sublane-friendly
 
     def sort_device(
-        self, keys: jax.Array, vals: jax.Array, capacity: Optional[int] = None
+        self, keys: jax.Array, vals: jax.Array,
+        valid: Optional[jax.Array] = None, capacity: Optional[int] = None,
     ):
         """One SPMD sort step on device-resident global arrays whose
         length is a multiple of D.  Returns device results unfetched
@@ -138,13 +176,17 @@ class TeraSorter:
         if n % self.n_devices:
             raise ValueError(f"length {n} not divisible by D={self.n_devices}")
         n_local = n // self.n_devices
-        cap = capacity or self._capacity(n_local, self.capacity_factor)
+        cap = capacity or self._capacity(n_local)
         step = make_sort_step(
-            self.mesh, n_local, cap, min(self.sample_size, max(1, n_local))
+            self.mesh, n_local, cap, min(self.sample_size, max(1, n_local)),
+            with_validity=valid is not None,
         )
         keys = jax.device_put(keys, self.sharding)
         vals = jax.device_put(vals, self.sharding)
-        return step(keys, vals), cap
+        if valid is None:
+            return step(keys, vals), cap
+        valid = jax.device_put(valid, self.sharding)
+        return step(keys, vals, valid), cap
 
     def sort(self, keys, vals=None) -> Tuple[np.ndarray, np.ndarray]:
         """Full host-facing sortByKey: returns (sorted_keys, sorted_vals)."""
@@ -157,32 +199,33 @@ class TeraSorter:
         n = keys.shape[0]
         if n == 0:
             return keys.copy(), vals.copy()
-        # pad to a multiple of D with sentinels that sort last and are
-        # trimmed via the valid counts
-        sentinel = np.array(np.iinfo(keys.dtype).max, keys.dtype)
+        # pad to a multiple of D; padding is tracked by the validity
+        # column (NOT by key value), so max-valued real keys are safe
         D = self.n_devices
         n_pad = (-n) % D
+        sentinel = np.array(np.iinfo(keys.dtype).max, keys.dtype)
         if n_pad:
             keys = np.concatenate([keys, np.full(n_pad, sentinel, keys.dtype)])
             vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
-        factor = self.capacity_factor
-        for _attempt in range(6):
-            (sk, sv, n_valid, max_fill), cap = self.sort_device(
-                jnp.asarray(keys), jnp.asarray(vals),
-                capacity=self._capacity(keys.shape[0] // D, factor),
-            )
-            if int(jnp.max(max_fill)) <= cap:
-                break
-            factor *= 2  # skewed keys overflowed a bucket: re-run bigger
+            valid = np.ones(n + n_pad, np.int32)
+            valid[n:] = 0
+            jval = jnp.asarray(valid)
         else:
-            raise RuntimeError("bucket overflow persisted after 6 retries")
+            jval = None  # fast path: no padding column needed
+        jk, jv = jnp.asarray(keys), jnp.asarray(vals)
+
+        def run(cap):
+            (sk, sv, n_valid, max_fill), _ = self.sort_device(
+                jk, jv, jval, capacity=cap
+            )
+            return (sk, sv, n_valid), max_fill
+
+        sk, sv, n_valid = self._run_with_overflow_retry(n + n_pad, run)
         # stitch: per-device sorted runs, trimmed to their valid counts
+        # (padding always sorts to each run's tail via the validity key)
         sk_h = np.asarray(sk).reshape(D, -1)
         sv_h = np.asarray(sv).reshape(D, -1)
         nv = np.asarray(n_valid).reshape(-1)
         out_k = np.concatenate([sk_h[d, : nv[d]] for d in range(D)])
         out_v = np.concatenate([sv_h[d, : nv[d]] for d in range(D)])
-        # drop host padding sentinels (they sorted into the final run)
-        if n_pad:
-            out_k, out_v = out_k[:n], out_v[:n]
         return out_k, out_v

@@ -5,83 +5,93 @@ WordCount, README.md:17) as one SPMD program: hash-partition keys,
 all_to_all, then a device-side segment reduction
 (sparkrdma_tpu.ops.segment) — every key's total ends up on exactly one
 device, the contract a reduceByKey shuffle provides.
+
+Validity is an explicit 0/1 column (not a key sentinel), so real keys
+equal to the dtype max are counted correctly.
 """
 
 from __future__ import annotations
 
 import functools
-import math
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
+from sparkrdma_tpu.models._base import ExchangeModel
 from sparkrdma_tpu.ops.partition import hash_partition_ids, partition_to_buckets
 from sparkrdma_tpu.ops.segment import reduce_by_key_local
-from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
 
 @functools.lru_cache(maxsize=16)
 def make_count_step(mesh: Mesh, n_local: int, capacity: int):
-    """Jitted reduceByKey(+) step over global [D*n_local] key/value
+    """Jitted reduceByKey(+) step over global [D*n_local] key/value/valid
     arrays sharded on the mesh axis."""
     D = len(list(mesh.devices.flat))
     spec = P(EXCHANGE_AXIS)
 
-    def body(k, v):  # local [n_local]
+    def body(k, v, valid):  # local [n_local]
         ids = hash_partition_ids(k, D)
-        (bk, bv), counts = partition_to_buckets(ids, (k, v), D, capacity)
+        # route invalid (padding) slots to this device's own bucket so
+        # they can't displace real records elsewhere; they carry valid=0
+        my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
+        ids = jnp.where(valid > 0, ids, my)
+        (bk, bv, bm), counts = partition_to_buckets(
+            ids, (k, v, valid), D, capacity,
+            fill_values=(
+                jnp.array(jnp.iinfo(k.dtype).max, k.dtype),
+                jnp.zeros((), v.dtype),
+                jnp.zeros((), jnp.int32),
+            ),
+        )
         rk = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
         rv = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-        sent = jnp.minimum(counts, capacity)
-        rcounts = jax.lax.all_to_all(
-            sent.reshape(D, 1), EXCHANGE_AXIS, split_axis=0, concat_axis=0
-        ).reshape(D)
-        # compact received buckets: sort valid-first, then reduce
+        rm = jax.lax.all_to_all(bm, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
         flat_k = rk.reshape(-1)
         flat_v = rv.reshape(-1)
-        slot = jnp.arange(capacity)
-        valid_mask = (slot[None, :] < rcounts[:, None]).reshape(-1)
+        flat_m = rm.reshape(-1)
+        # pre-mask for the reduction contract: invalid slots (bucket pads
+        # and input padding) get the grouping key + zero value
         sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
-        flat_k = jnp.where(valid_mask, flat_k, sentinel)
-        flat_v = jnp.where(valid_mask, flat_v, jnp.zeros((), v.dtype))
-        uniq, sums, n_unique = reduce_by_key_local(flat_k, flat_v)
+        flat_k = jnp.where(flat_m > 0, flat_k, sentinel)
+        flat_v = jnp.where(flat_m > 0, flat_v, jnp.zeros((), v.dtype))
+        uniq, sums, cnts, n_unique = reduce_by_key_local(flat_k, flat_v, flat_m)
+        # true counts of VALID records per destination (for overflow):
+        # invalid slots were routed to self, so they don't inflate others
         overflow = jnp.max(counts).astype(jnp.int32)
-        return uniq, sums, n_unique[None], overflow[None]
+        return uniq, sums, cnts, n_unique[None], overflow[None]
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec),
-        out_specs=(spec, spec, spec, spec),
+        body, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec, spec, spec),
     )
     return jax.jit(mapped)
 
 
-class WordCounter:
+class WordCounter(ExchangeModel):
     """Host-facing reduceByKey(+): returns {key: total}."""
 
     def __init__(self, mesh: Optional[Mesh] = None, capacity_factor: float = 2.0):
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_devices = len(list(self.mesh.devices.flat))
-        self.capacity_factor = capacity_factor
-        self.sharding = NamedSharding(self.mesh, P(EXCHANGE_AXIS))
-
-    def _capacity(self, n_local: int, factor: float) -> int:
-        cap = int(math.ceil(n_local / self.n_devices * factor))
-        return max(8, (cap + 7) // 8 * 8)
+        super().__init__(mesh, capacity_factor)
 
     def count_device(self, keys: jax.Array, vals: jax.Array,
+                     valid: Optional[jax.Array] = None,
                      capacity: Optional[int] = None):
         n = keys.shape[0]
         if n % self.n_devices:
             raise ValueError(f"length {n} not divisible by D={self.n_devices}")
         n_local = n // self.n_devices
-        cap = capacity or self._capacity(n_local, self.capacity_factor)
+        cap = capacity or self._capacity(n_local)
         step = make_count_step(self.mesh, n_local, cap)
         keys = jax.device_put(keys, self.sharding)
         vals = jax.device_put(vals, self.sharding)
-        return step(keys, vals), cap
+        if valid is None:
+            valid = jnp.ones(n, jnp.int32)
+        valid = jax.device_put(valid, self.sharding)
+        return step(keys, vals, valid), cap
 
     def count(self, keys, vals=None) -> Dict[int, int]:
         keys = np.asarray(keys)
@@ -92,30 +102,28 @@ class WordCounter:
         if n == 0:
             return {}
         D = self.n_devices
-        sentinel = np.array(np.iinfo(keys.dtype).max, keys.dtype)
         n_pad = (-n) % D
+        valid = np.ones(n + n_pad, np.int32)
         if n_pad:
-            # pad with sentinel keys + zero values: they reduce into the
-            # sentinel slot, which we drop below
-            keys = np.concatenate([keys, np.full(n_pad, sentinel, keys.dtype)])
+            keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])
             vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
-        factor = self.capacity_factor
-        for _attempt in range(6):
-            (uniq, sums, n_unique, max_fill), cap = self.count_device(
-                jnp.asarray(keys), jnp.asarray(vals),
-                capacity=self._capacity(keys.shape[0] // D, factor),
+            valid[n:] = 0
+        jk, jv, jval = jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid)
+
+        def run(cap):
+            (uniq, sums, cnts, n_unique, max_fill), _ = self.count_device(
+                jk, jv, jval, capacity=cap
             )
-            if int(jnp.max(max_fill)) <= cap:
-                break
-            factor *= 2
-        else:
-            raise RuntimeError("bucket overflow persisted after 6 retries")
+            return (uniq, sums, cnts, n_unique), max_fill
+
+        uniq, sums, cnts, n_unique = self._run_with_overflow_retry(
+            n + n_pad, run
+        )
         uniq_h = np.asarray(uniq).reshape(D, -1)
         sums_h = np.asarray(sums).reshape(D, -1)
         nu = np.asarray(n_unique).reshape(-1)
         out: Dict[int, int] = {}
         for d in range(D):
             for k, s in zip(uniq_h[d, : nu[d]], sums_h[d, : nu[d]]):
-                if k != sentinel:
-                    out[int(k)] = int(s)
+                out[int(k)] = int(s)
         return out

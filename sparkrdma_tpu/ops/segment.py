@@ -16,39 +16,59 @@ import jax.numpy as jnp
 
 
 def reduce_by_key_local(
-    keys: jax.Array, vals: jax.Array
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    keys: jax.Array, vals: jax.Array, valid: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Reduce (sum) values by key over one device's elements.
 
-    Invalid slots must be PRE-MASKED by the caller: key == dtype max
-    (the sentinel) and value == 0.  Valid entries may sit anywhere (they
-    need not form a prefix — post-exchange buckets are row-scattered).
+    ``valid`` is an int32 0/1 indicator per slot.  Invalid slots must be
+    pre-masked to (key = dtype max, value = 0, valid = 0) so they all
+    group into the single final run; REAL keys equal to the dtype max
+    are still counted correctly because validity is tracked explicitly
+    (unlike a sentinel-only scheme).  Valid entries may sit anywhere
+    (post-exchange buckets are row-scattered).
 
     Returns:
-      (unique_keys, sums, n_unique): [n] arrays where the first n_unique
-      slots hold each distinct real key and the sum of its values; the
-      rest is sentinel (key dtype max, zero sums).
+      (unique_keys, sums, counts, n_unique): [n] arrays where the first
+      n_unique slots hold each distinct real key, the sum of its values,
+      and how many valid elements it had; the rest is padding (key dtype
+      max, zeros).
     """
     n = keys.shape[0]
     sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
-    # TPU-critical: scatter-free.  Sort pairs, then extract per-run totals
-    # as differences of the value prefix-sum at run ends; compact the run
+    # TPU-critical: scatter-free.  Sort triples, then extract per-run
+    # totals as differences of prefix sums at run ends; compact the run
     # ends to the front with a second (cheap) sort instead of a scatter.
-    ks, vs = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
-    csum = jnp.cumsum(vs)
-    is_last = jnp.concatenate(
-        [ks[1:] != ks[:-1], jnp.ones(1, bool)]
-    )  # last element of each run
-    real_last = is_last & (ks != sentinel)
-    sel_key = jnp.where(real_last, ks, sentinel)
-    sel_end = jnp.where(real_last, csum, jnp.zeros((), csum.dtype))
-    uniq, ends = jax.lax.sort((sel_key, sel_end), num_keys=1, is_stable=True)
-    # runs are contiguous in ks, and uniq preserves key order, so each
-    # run's sum = its end-csum minus the previous run's end-csum
-    prev = jnp.concatenate([jnp.zeros(1, ends.dtype), ends[:-1]])
-    is_real = uniq != sentinel
-    sums = jnp.where(is_real, ends - prev, jnp.zeros((), vals.dtype)).astype(
-        vals.dtype
+    m = valid.astype(jnp.int32)
+    # push invalid slots to the very end so they merge into (at most) the
+    # tail of the final run and never split a real run
+    ks, ms, vs = jax.lax.sort(
+        (keys, jnp.int32(1) - m, vals), num_keys=2, is_stable=True
     )
-    n_unique = jnp.sum(is_real).astype(jnp.int32)
-    return uniq, sums, n_unique
+    ms = jnp.int32(1) - ms
+    csum_v = jnp.cumsum(vs)
+    csum_m = jnp.cumsum(ms)
+    is_last = jnp.concatenate([ks[1:] != ks[:-1], jnp.ones(1, bool)])
+    # compact run-end rows to the front, in key order: non-last rows get
+    # (sentinel key, tiebreak 1) so they sort after every run-end row,
+    # including a run-end row whose real key IS the sentinel (tiebreak 0)
+    sel_key = jnp.where(is_last, ks, sentinel)
+    tiebreak = jnp.where(is_last, jnp.int32(0), jnp.int32(1))
+    sel_v = jnp.where(is_last, csum_v, jnp.zeros((), csum_v.dtype))
+    sel_m = jnp.where(is_last, csum_m, jnp.zeros((), csum_m.dtype))
+    uniq, _, ends_v, ends_m = jax.lax.sort(
+        (sel_key, tiebreak, sel_v, sel_m), num_keys=2, is_stable=True
+    )
+    n_runs = jnp.sum(is_last.astype(jnp.int32))
+    slot = jnp.arange(n, dtype=jnp.int32)
+    in_runs = slot < n_runs
+    prev_v = jnp.concatenate([jnp.zeros(1, ends_v.dtype), ends_v[:-1]])
+    prev_m = jnp.concatenate([jnp.zeros(1, ends_m.dtype), ends_m[:-1]])
+    counts = jnp.where(in_runs, ends_m - prev_m, 0).astype(jnp.int32)
+    real = counts > 0
+    sums = jnp.where(real, ends_v - prev_v, 0).astype(vals.dtype)
+    uniq = jnp.where(real, uniq, sentinel)
+    # valid runs form a prefix: every non-final run holds ≥1 valid slot
+    # (invalid slots all carry the same arbitrary key content only in the
+    # final run thanks to the validity tiebreak in the first sort)
+    n_unique = jnp.sum(real.astype(jnp.int32))
+    return uniq, sums, counts, n_unique

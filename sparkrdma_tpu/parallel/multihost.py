@@ -1,0 +1,77 @@
+"""Multi-host (multi-slice / DCN) support.
+
+The reference scales across nodes with one RdmaNode per JVM and a
+full mesh of RC connections (SURVEY.md §1 deployment topology).  The
+TPU-native equivalent is JAX's multi-controller runtime: one process
+per host, ``jax.distributed.initialize`` for rendezvous (the
+hello/announce analog at the runtime layer), and a global mesh whose
+collectives ride ICI within a slice and DCN across slices — XLA picks
+the transport per hop, exactly the RoCE/IB duality DiSNI gave the
+reference.
+
+What this module provides:
+
+- :func:`initialize` — rendezvous wrapper (driver coordinator analog).
+- :func:`global_mesh` — a mesh over every device in the job.
+- :func:`host_local_indices` — which rows of a leading-axis-sharded
+  global array live on this host; the TileExchange already consumes
+  per-host shards via ``addressable_shards``, so host code only ever
+  touches its local slice (the "executor owns its blocks" invariant).
+
+Single-host jobs never need to call anything here.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-controller job (idempotent).
+
+    With no arguments JAX autodetects the environment (TPU pods publish
+    topology via metadata).  Mirrors the reference's driver hello path:
+    every process must call this before building the global mesh.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError:
+        # single-process run or already initialized: both fine
+        pass
+
+
+def global_mesh(axis_name: str = EXCHANGE_AXIS) -> Mesh:
+    """1-D exchange mesh over EVERY device in the job (all hosts)."""
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def host_local_indices(mesh: Mesh) -> List[int]:
+    """Mesh-axis positions whose device is addressable from this
+    process — the rows of a leading-axis-sharded array this host owns."""
+    local = set(d.id for d in jax.local_devices())
+    return [
+        i for i, dev in enumerate(mesh.devices.flat) if dev.id in local
+    ]
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.memory.arena import ArenaManager
+from sparkrdma_tpu.memory.staging import StagingPool
+from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
     FetchMapStatusMsg,
@@ -146,9 +148,22 @@ class TpuShuffleManager:
             )
         )
 
+        if conf.trace:
+            get_tracer().enabled = True
         self.arena = ArenaManager(conf.max_buffer_allocation_size)
+        self.staging_pool = StagingPool(conf.max_buffer_allocation_size)
+        if not is_driver and conf.max_agg_prealloc > 0:
+            # warm the pool off the critical path (reference: async
+            # preallocation, RdmaBufferManager.java:112-120)
+            threading.Thread(
+                target=self.staging_pool.prealloc,
+                args=(conf.max_agg_prealloc, conf.max_agg_block),
+                daemon=True,
+            ).start()
         self.resolver = ShuffleBlockResolver(
-            self.arena, self.node, stage_to_device=stage_to_device
+            self.arena, self.node,
+            stage_to_device=stage_to_device and not conf.lazy_staging,
+            staging_pool=self.staging_pool,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)
@@ -477,9 +492,11 @@ class TpuShuffleManager:
         self._stopped = True
         if self.stats is not None:
             self.stats.print_stats()
+        logger.info("staging pool at stop: %s", self.staging_pool.stats())
         if self._fetch_pool is not None:
             self._fetch_pool.shutdown(wait=False)
         self.resolver.stop()
         self.node.stop()
         self.network.unregister(self.node)
         self.arena.stop()
+        self.staging_pool.close()

@@ -30,6 +30,7 @@ from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.transport.channel import ChannelType, FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
 from sparkrdma_tpu.utils.serde import Record
+from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.utils.types import BlockLocation, ShuffleManagerId
 
 logger = logging.getLogger(__name__)
@@ -242,6 +243,10 @@ class ShuffleReader:
                 self._bytes_in_flight -= fetch.total_bytes
             if self.manager.stats is not None:
                 self.manager.stats.update(fetch.host.host, latency)
+            get_tracer().instant(
+                "shuffle.fetch.complete", host=fetch.host.host,
+                bytes=fetch.total_bytes, latency_ms=round(latency, 2),
+            )
             self._results.put(
                 _Result(blocks=blocks, host=fetch.host, latency_ms=latency)
             )

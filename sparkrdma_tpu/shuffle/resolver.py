@@ -41,10 +41,11 @@ class ShuffleBlockResolver:
     """Executor-local registry of committed map outputs."""
 
     def __init__(self, arena: ArenaManager, node: Optional[Node] = None,
-                 stage_to_device: bool = True):
+                 stage_to_device: bool = True, staging_pool=None):
         self.arena = arena
         self.node = node
         self.stage_to_device = stage_to_device
+        self.staging_pool = staging_pool  # pooled host buffers for concat
         self._shuffles: Dict[int, _ShuffleData] = {}
         self._lock = threading.Lock()
 
@@ -69,7 +70,14 @@ class ShuffleBlockResolver:
         num_partitions = len(partition_bytes)
         sd = self._get_or_create(shuffle_id, num_partitions)
         total = sum(len(b) for b in partition_bytes)
-        buf = np.empty(max(total, 1), dtype=np.uint8)
+        staging_buf = None
+        if self.staging_pool is not None and total > 0:
+            # serialize through the pooled, page-aligned native buffer —
+            # the registered-staging path (RdmaBuffer analog)
+            staging_buf = self.staging_pool.alloc(total)
+            buf = staging_buf.view
+        else:
+            buf = np.empty(max(total, 1), dtype=np.uint8)
         offsets: List[Tuple[int, int]] = []
         off = 0
         for b in partition_bytes:
@@ -78,13 +86,25 @@ class ShuffleBlockResolver:
                 buf[off : off + n] = np.frombuffer(b, np.uint8)
             offsets.append((off, n))
             off += n
-        if self.stage_to_device:
-            import jax.numpy as jnp
+        try:
+            if self.stage_to_device:
+                import jax.numpy as jnp
 
-            array = jnp.asarray(buf[:max(total, 1)])
-        else:
-            array = buf[:max(total, 1)]
-        seg = self.arena.register(array, shuffle_id=shuffle_id)
+                array = jnp.asarray(buf[: max(total, 1)])
+            else:
+                array = np.asarray(buf[: max(total, 1)])
+            # PJRT may zero-copy alias page-aligned host buffers: the
+            # staging buffer must live until the segment is released, not
+            # be returned to the pool while the device array can still
+            # read through it
+            seg = self.arena.register(
+                array, shuffle_id=shuffle_id, keepalive=staging_buf
+            )
+        except BaseException:
+            # register never took ownership: return the buffer ourselves
+            if staging_buf is not None:
+                staging_buf.free()
+            raise
         if self.node is not None:
             self.node.register_block_store(seg.mkey, self.arena)
         mto = MapTaskOutput(num_partitions)

@@ -19,6 +19,7 @@ from typing import Any, Callable, Iterable, List, Optional
 from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.utils.serde import Record
+from sparkrdma_tpu.utils.trace import get_tracer
 
 
 class WriteMetrics:
@@ -72,6 +73,14 @@ class ShuffleWriter:
         self._stopped = True
         if not success:
             return None
+        tracer = get_tracer()
+        with tracer.span(
+            "shuffle.write.commit",
+            shuffle=self.handle.shuffle_id, map=self.map_id,
+        ):
+            return self._commit()
+
+    def _commit(self) -> MapTaskOutput:
         t0 = time.monotonic()
         serializer = self.manager.serializer
         if self._combined is not None:

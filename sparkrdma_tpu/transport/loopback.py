@@ -39,7 +39,12 @@ _PAIRED = {
 
 
 class LoopbackChannel(Channel):
-    """One direction of an in-process channel pair."""
+    """One direction of an in-process channel pair.
+
+    RPC channels implement receiver-credit software flow control when
+    the node's conf enables it (reference: sender consumes one credit
+    per SEND, receiver piggybacks credit reports once half the recv
+    queue is consumed, RdmaChannel.java:56-59,508-520,690-703)."""
 
     def __init__(
         self,
@@ -54,29 +59,112 @@ class LoopbackChannel(Channel):
         self.remote = remote
         self.network = network
         self.peer_channel: Optional["LoopbackChannel"] = None
+        conf = local.conf
+        self._fc_enabled = conf.sw_flow_control and channel_type in (
+            ChannelType.RPC_REQUESTOR, ChannelType.RPC_RESPONDER,
+            ChannelType.RPC_WRAPPER,
+        )
+        self._credits = conf.recv_queue_depth
+        self._credit_lock = threading.Lock()
+        self._credit_waiting: List = []  # (frames, listener) blocked on credits
+        self._consumed_since_report = 0
+        self._report_threshold = max(1, conf.recv_queue_depth // 2)
+
+    # -- credit machinery (transport-internal, like WRITE_WITH_IMM) ---------
+    def _on_credit_report(self, n: int) -> None:
+        """Credits became available (peer report or failed-delivery
+        restore); drain blocked sends.  Pop-and-take happens atomically
+        under _credit_lock so a report racing a failed take can never
+        strand a queued send; failed deliveries restore their credits and
+        loop so later queued sends still fail promptly."""
+        while True:
+            drained = []
+            with self._credit_lock:
+                self._credits += n
+                n = 0
+                while (
+                    self._credit_waiting
+                    and self._credits >= len(self._credit_waiting[0][0])
+                ):
+                    frames, listener = self._credit_waiting.pop(0)
+                    self._credits -= len(frames)
+                    drained.append((frames, listener))
+            if not drained:
+                return
+            restore = 0
+            for frames, listener in drained:
+                if not self._deliver_frames(frames, listener):
+                    restore += len(frames)
+            if restore == 0:
+                return
+            n = restore
+
+    def _frame_consumed(self) -> None:
+        """Receiver side: one recv slot freed after dispatch; report
+        credits back in batches."""
+        with self._credit_lock:
+            self._consumed_since_report += 1
+            if self._consumed_since_report < self._report_threshold:
+                return
+            n, self._consumed_since_report = self._consumed_since_report, 0
+        peer = self.peer_channel
+        if peer is not None:
+            peer._on_credit_report(n)
 
     # -- posting ------------------------------------------------------------
     def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:
         def deliver():
-            try:
-                if self.network.is_partitioned(self.local.address, self.remote.address):
-                    raise TransportError(
-                        f"network partition to {self.remote.address}"
-                    )
-                if self.state != ChannelState.CONNECTED:
-                    raise TransportError("channel not connected")
-                target = self.peer_channel if self.peer_channel is not None else self
-                for frame in frames:
-                    self.remote.dispatch_frame(target, bytes(frame))
-            except BaseException as e:
-                self._error(e)
-                self._fail(listener, e)
-            else:
-                self._complete(listener, None)
-            finally:
+            # fail fast BEFORE consuming credits: a dead channel must not
+            # burn credits it can never get reported back
+            err = self._check_deliverable()
+            if err is not None:
+                self._error(err)
+                self._fail(listener, err)
                 self._release_budget()
+                return
+            if self._fc_enabled:
+                with self._credit_lock:
+                    if self._credits >= len(frames):
+                        self._credits -= len(frames)
+                    else:
+                        self._credit_waiting.append((frames, listener))
+                        return  # budget held until credits arrive
+            if not self._deliver_frames(frames, listener) and self._fc_enabled:
+                self._on_credit_report(len(frames))  # restore + re-drain
 
         self.local.submit(deliver)
+
+    def _check_deliverable(self) -> Optional[TransportError]:
+        if self.network.is_partitioned(self.local.address, self.remote.address):
+            return TransportError(f"network partition to {self.remote.address}")
+        if self.state != ChannelState.CONNECTED:
+            return TransportError("channel not connected")
+        return None
+
+    def _deliver_frames(
+        self, frames: List[bytes], listener: CompletionListener
+    ) -> bool:
+        """Returns True when the frames were handed to the peer; on False
+        the listener has been failed and (for flow-controlled channels)
+        the caller must restore the consumed credits."""
+        try:
+            err = self._check_deliverable()
+            if err is not None:
+                raise err
+            target = self.peer_channel if self.peer_channel is not None else self
+            for frame in frames:
+                self.remote.dispatch_frame(
+                    target, bytes(frame), on_consumed=target._frame_consumed
+                )
+        except BaseException as e:
+            self._error(e)
+            self._fail(listener, e)
+            self._release_budget()
+            return False
+        else:
+            self._complete(listener, None)
+            self._release_budget()
+            return True
 
     def _post_read(self, locations, listener: CompletionListener) -> None:
         def deliver():
@@ -98,6 +186,13 @@ class LoopbackChannel(Channel):
                 self._release_budget()
 
         self.local.submit(deliver)
+
+    def stop(self) -> None:
+        # credit-waiting listeners are tracked in _outstanding, which
+        # super().stop() fails exactly once — just drop the queue
+        with self._credit_lock:
+            self._credit_waiting.clear()
+        super().stop()
 
     # -- failure injection --------------------------------------------------
     def inject_error(self) -> None:

@@ -73,22 +73,37 @@ class Node:
     def set_receive_listener(self, listener: ReceiveListener) -> None:
         self._receive_listener = listener
 
-    def dispatch_frame(self, channel: Channel, frame: bytes) -> None:
-        """Deliver one inbound control-plane frame on the dispatcher."""
-        if self._stopped.is_set():
-            return
+    def dispatch_frame(self, channel: Channel, frame: bytes,
+                       on_consumed=None) -> None:
+        """Deliver one inbound control-plane frame on the dispatcher.
+        ``on_consumed`` fires once the frame's recv slot is free (credit
+        accounting) — including on drop paths, so senders never starve."""
         listener = self._receive_listener
-        if listener is None:
-            logger.warning("%s: dropping frame, no receive listener", self)
+        if self._stopped.is_set() or listener is None:
+            if listener is None and not self._stopped.is_set():
+                logger.warning("%s: dropping frame, no receive listener", self)
+            if on_consumed is not None:
+                try:
+                    on_consumed()
+                except BaseException:
+                    pass
             return
-        self._dispatcher.submit(self._safe_dispatch, listener, channel, frame)
+        self._dispatcher.submit(
+            self._safe_dispatch, listener, channel, frame, on_consumed
+        )
 
     @staticmethod
-    def _safe_dispatch(listener, channel, frame) -> None:
+    def _safe_dispatch(listener, channel, frame, on_consumed=None) -> None:
         try:
             listener(channel, frame)
         except BaseException:
             logger.exception("receive listener raised")
+        finally:
+            if on_consumed is not None:
+                try:
+                    on_consumed()
+                except BaseException:
+                    pass
 
     def submit(self, fn, *args):
         """Run fn on the dispatcher (async completion delivery)."""

@@ -134,3 +134,39 @@ def test_arena_out_of_bounds_read(devices):
     seg = mgr.register(jnp.zeros(64, dtype=jnp.uint8))
     with pytest.raises(TransportError):
         mgr.read_block(BlockLocation(60, 8, seg.mkey))
+
+
+def test_staging_prealloc_warms_pool():
+    p = StagingPool(max_bytes=16 << 20)
+    n = p.prealloc(4 << 20, 1 << 20)
+    assert n == 4
+    s = p.stats()
+    assert s["idle"] >= 4 << 20 and s["in_use"] == 0
+    # subsequent allocs reuse warm blocks (owned stays flat)
+    owned = s["owned"]
+    b = p.alloc(1 << 20)
+    assert p.stats()["owned"] == owned
+    b.free()
+    p.close()
+
+
+def test_segment_keepalive_released_with_segment(devices):
+    import jax.numpy as jnp
+
+    class FakeBuf:
+        freed = 0
+
+        def free(self):
+            FakeBuf.freed += 1
+
+    mgr = ArenaManager()
+    seg = mgr.register(jnp.zeros(64, dtype=jnp.uint8), shuffle_id=1,
+                       keepalive=FakeBuf())
+    assert FakeBuf.freed == 0
+    mgr.release(seg.mkey)
+    assert FakeBuf.freed == 1
+    # release by shuffle and stop also free keepalives exactly once
+    s2 = mgr.register(jnp.zeros(64, dtype=jnp.uint8), shuffle_id=2,
+                      keepalive=FakeBuf())
+    mgr.release_shuffle(2)
+    assert FakeBuf.freed == 2

@@ -74,3 +74,19 @@ def test_wordcount_single_hot_key(mesh, devices):
     wc = WordCounter(mesh, capacity_factor=1.1)
     keys = np.full(10_000, 77, dtype=np.int32)
     assert wc.count(keys) == {77: 10_000}
+
+
+def test_max_value_keys_not_confused_with_padding(mesh, devices):
+    # reviewer finding: keys equal to iinfo.max must survive both models
+    sentinel = np.iinfo(np.int32).max
+    wc = WordCounter(mesh)
+    k = np.array([sentinel, sentinel, 5], dtype=np.int32)  # ragged: pads added
+    assert wc.count(k) == {sentinel: 2, 5: 1}
+
+    sorter = TeraSorter(mesh)
+    keys = np.array([sentinel, 1, sentinel, 3, 2], dtype=np.int32)
+    vals = np.array([10, 11, 12, 13, 14], dtype=np.int32)
+    sk, sv = sorter.sort(keys, vals)
+    np.testing.assert_array_equal(sk, [1, 2, 3, sentinel, sentinel])
+    assert sv[0] == 11 and sv[1] == 14 and sv[2] == 13
+    assert sorted(sv[3:]) == [10, 12]  # max-key values kept, not pad zeros
