@@ -124,6 +124,11 @@ class TpuShuffleConf:
         return self._bool("trace", False)
 
     @property
+    def trace_path(self) -> str:
+        """Where manager.stop() dumps the collected trace."""
+        return str(self.get("tracePath", "sparkrdma_tpu_trace.json"))
+
+    @property
     def lazy_staging(self) -> bool:
         """ODP analog (reference: useOdp, RdmaShuffleConf.scala:68-83):
         keep committed map output in host memory and stage to HBM on

@@ -54,11 +54,18 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
         k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
         n_real = jnp.int32(n_local)
     else:
-        # the (key, invalid) two-key sort puts every invalid slot at the
-        # global tail (invalid ⊂ sentinel-key group, ordered last within
-        # it), so validity per destination window is always a SUFFIX —
-        # a per-window valid count replaces a whole per-element column
+        # force invalid slots onto the dtype-max key, then the
+        # (key, invalid) two-key sort puts every invalid slot at the
+        # global tail (max-key group, ordered after real max-keyed
+        # records within it), so validity per destination window is
+        # always a SUFFIX — a per-window valid count replaces a whole
+        # per-element column.  The rewrite makes the suffix property
+        # hold for ARBITRARY caller-supplied (keys, valid), not just
+        # inputs whose invalid slots already carry the sentinel.
         inv = jnp.int32(1) - valid
+        keys = jnp.where(
+            valid > 0, keys, jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
+        )
         k, _, v = jax.lax.sort((keys, inv, vals), num_keys=2, is_stable=True)
         n_real = jnp.sum(valid).astype(jnp.int32)
     # exact local quantiles (k is sorted): positions i*n/S

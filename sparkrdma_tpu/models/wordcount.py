@@ -58,15 +58,15 @@ def make_count_step(mesh: Mesh, n_local: int, capacity: int):
         sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
         flat_k = jnp.where(flat_m > 0, flat_k, sentinel)
         flat_v = jnp.where(flat_m > 0, flat_v, jnp.zeros((), v.dtype))
-        uniq, sums, cnts, n_unique = reduce_by_key_local(flat_k, flat_v, flat_m)
+        uniq, sums, _cnts, n_unique = reduce_by_key_local(flat_k, flat_v, flat_m)
         # true counts of VALID records per destination (for overflow):
         # invalid slots were routed to self, so they don't inflate others
         overflow = jnp.max(counts).astype(jnp.int32)
-        return uniq, sums, cnts, n_unique[None], overflow[None]
+        return uniq, sums, n_unique[None], overflow[None]
 
     mapped = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=(spec, spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
     )
     return jax.jit(mapped)
 
@@ -111,12 +111,12 @@ class WordCounter(ExchangeModel):
         jk, jv, jval = jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid)
 
         def run(cap):
-            (uniq, sums, cnts, n_unique, max_fill), _ = self.count_device(
+            (uniq, sums, n_unique, max_fill), _ = self.count_device(
                 jk, jv, jval, capacity=cap
             )
-            return (uniq, sums, cnts, n_unique), max_fill
+            return (uniq, sums, n_unique), max_fill
 
-        uniq, sums, cnts, n_unique = self._run_with_overflow_retry(
+        uniq, sums, n_unique = self._run_with_overflow_retry(
             n + n_pad, run
         )
         uniq_h = np.asarray(uniq).reshape(D, -1)

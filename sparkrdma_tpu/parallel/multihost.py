@@ -41,10 +41,12 @@ def initialize(
 
     With no arguments JAX autodetects the environment (TPU pods publish
     topology via metadata).  Mirrors the reference's driver hello path:
-    every process must call this before building the global mesh.
+    every process must call this before building the global mesh — in
+    particular BEFORE anything touches a backend (jax.devices() etc.),
+    which is why this guard must not query process_count() itself.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    if _distributed_client() is not None:
+        return  # rendezvous already done
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -54,9 +56,26 @@ def initialize(
         kwargs["process_id"] = process_id
     try:
         jax.distributed.initialize(**kwargs)
-    except RuntimeError:
-        # single-process run or already initialized: both fine
-        pass
+    except (RuntimeError, ValueError):
+        # The degenerate cases are fine: a no-arg call on a plain single
+        # host (autodetection finds no cluster) or a second initialize.
+        # Explicit-argument failures (bad coordinator address, rendezvous
+        # timeout) must surface — swallowing them would silently run N
+        # independent single-host jobs.
+        if kwargs and _distributed_client() is None:
+            raise
+
+
+def _distributed_client():
+    """The live rendezvous client, or None if initialize never ran.
+    Checked via jax's distributed global state so the probe does NOT
+    initialize a backend the way jax.process_count() would."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except Exception:
+        return None
 
 
 def global_mesh(axis_name: str = EXCHANGE_AXIS) -> Mesh:

@@ -104,26 +104,39 @@ class RingExchange:
         ``init_fn(local_shard) -> acc`` builds the accumulator;
         ``consume(acc, src_index, shard) -> acc`` folds one hop.  Runs
         as one jitted scan — the ring-attention-shaped schedule.
+
+        The jitted program is cached on (mesh, shape, dtype, init_fn,
+        consume) — callables compare by identity, so pass the SAME
+        function objects across calls to reuse the compilation.
         """
-        D = self.n_devices
-        spec = P(EXCHANGE_AXIS)
-
-        def body(x):
-            shard = x[0]
-            my = jax.lax.axis_index(EXCHANGE_AXIS)
-
-            def step(carry, j):
-                acc, cur = carry
-                src = (my - j) % D
-                acc = consume(acc, src, cur)
-                return (acc, ring_shift(cur)), None
-
-            (acc, _), _ = jax.lax.scan(
-                step, (init_fn(shard), shard), jnp.arange(D)
-            )
-            return jax.tree.map(lambda a: a[None], acc)
-
-        mapped = jax.shard_map(
-            body, mesh=self.mesh, in_specs=spec, out_specs=spec
+        fn = _ring_reduce_fn(
+            self.mesh, tuple(x.shape[1:]), str(x.dtype), init_fn, consume
         )
-        return jax.jit(mapped)(jax.device_put(x, self.sharding))
+        return fn(jax.device_put(x, self.sharding))
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_reduce_fn(mesh: Mesh, shard_shape, dtype_str: str,
+                    init_fn: Callable, consume: Callable):
+    """Cached jitted ring_reduce program (mirrors _ring_scan_fn; without
+    this every call would pay a fresh XLA compile)."""
+    D = len(list(mesh.devices.flat))
+    spec = P(EXCHANGE_AXIS)
+
+    def body(x):
+        shard = x[0]
+        my = jax.lax.axis_index(EXCHANGE_AXIS)
+
+        def step(carry, j):
+            acc, cur = carry
+            src = (my - j) % D
+            acc = consume(acc, src, cur)
+            return (acc, ring_shift(cur)), None
+
+        (acc, _), _ = jax.lax.scan(
+            step, (init_fn(shard), shard), jnp.arange(D)
+        )
+        return jax.tree.map(lambda a: a[None], acc)
+
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
+    return jax.jit(mapped)

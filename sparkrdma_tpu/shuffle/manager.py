@@ -492,6 +492,14 @@ class TpuShuffleManager:
         self._stopped = True
         if self.stats is not None:
             self.stats.print_stats()
+        if self.conf.trace:
+            tracer = get_tracer()
+            try:
+                tracer.dump(self.conf.trace_path)
+            except OSError:
+                logger.exception("trace dump to %s failed", self.conf.trace_path)
+            tracer.enabled = False
+            tracer.clear()
         logger.info("staging pool at stop: %s", self.staging_pool.stats())
         if self._fetch_pool is not None:
             self._fetch_pool.shutdown(wait=False)

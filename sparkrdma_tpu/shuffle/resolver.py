@@ -74,8 +74,14 @@ class ShuffleBlockResolver:
         if self.staging_pool is not None and total > 0:
             # serialize through the pooled, page-aligned native buffer —
             # the registered-staging path (RdmaBuffer analog)
-            staging_buf = self.staging_pool.alloc(total)
-            buf = staging_buf.view
+            try:
+                staging_buf = self.staging_pool.alloc(total)
+                buf = staging_buf.view
+            except MemoryError:
+                # pool budget exhausted (keepalives pin buffers for the
+                # shuffle's lifetime): fall back to a plain host buffer
+                # rather than failing the commit
+                buf = np.empty(max(total, 1), dtype=np.uint8)
         else:
             buf = np.empty(max(total, 1), dtype=np.uint8)
         offsets: List[Tuple[int, int]] = []

@@ -20,12 +20,24 @@ from typing import Dict, List, Optional
 
 
 class Tracer:
-    def __init__(self, enabled: bool = False, process_name: str = "sparkrdma_tpu"):
+    def __init__(self, enabled: bool = False, process_name: str = "sparkrdma_tpu",
+                 max_events: int = 1 << 20):
         self.enabled = enabled
         self.process_name = process_name
+        self.max_events = max_events
+        self.dropped = 0
         self._events: List[Dict] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+
+    def _append(self, event: Dict) -> None:
+        """Bounded append: beyond max_events new events are counted but
+        dropped, so an always-on trace can't grow without limit."""
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(event)
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -40,31 +52,28 @@ class Tracer:
             yield
         finally:
             dur = self._now_us() - ts
-            with self._lock:
-                self._events.append({
-                    "name": name, "ph": "X", "ts": ts, "dur": dur,
-                    "pid": 0, "tid": threading.get_ident() % 100000,
-                    "args": args or {},
-                })
-
-    def instant(self, name: str, **args) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            self._events.append({
-                "name": name, "ph": "i", "ts": self._now_us(), "s": "t",
+            self._append({
+                "name": name, "ph": "X", "ts": ts, "dur": dur,
                 "pid": 0, "tid": threading.get_ident() % 100000,
                 "args": args or {},
             })
 
+    def instant(self, name: str, **args) -> None:
+        if not self.enabled:
+            return
+        self._append({
+            "name": name, "ph": "i", "ts": self._now_us(), "s": "t",
+            "pid": 0, "tid": threading.get_ident() % 100000,
+            "args": args or {},
+        })
+
     def counter(self, name: str, **values) -> None:
         if not self.enabled:
             return
-        with self._lock:
-            self._events.append({
-                "name": name, "ph": "C", "ts": self._now_us(),
-                "pid": 0, "args": values,
-            })
+        self._append({
+            "name": name, "ph": "C", "ts": self._now_us(),
+            "pid": 0, "args": values,
+        })
 
     @property
     def events(self) -> List[Dict]:
@@ -77,7 +86,10 @@ class Tracer:
             events = list(self._events)
         doc = {
             "traceEvents": events,
-            "metadata": {"process_name": self.process_name},
+            "metadata": {
+                "process_name": self.process_name,
+                "dropped_events": self.dropped,
+            },
         }
         with open(path, "w") as f:
             json.dump(doc, f)

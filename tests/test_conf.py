@@ -62,3 +62,13 @@ def test_device_list_parsing():
     assert TpuShuffleConf().parse_device_list(4) == [0, 1, 2, 3]
     bad = TpuShuffleConf({"spark.shuffle.tpu.deviceList": "x-y"})
     assert bad.parse_device_list(3) == [0, 1, 2]
+
+
+def test_tracer_bounded_events():
+    from sparkrdma_tpu.utils.trace import Tracer
+
+    t = Tracer(enabled=True, max_events=10)
+    for i in range(25):
+        t.instant("e", i=i)
+    assert len(t.events) == 10
+    assert t.dropped == 15

@@ -90,3 +90,29 @@ def test_max_value_keys_not_confused_with_padding(mesh, devices):
     np.testing.assert_array_equal(sk, [1, 2, 3, sentinel, sentinel])
     assert sv[0] == 11 and sv[1] == 14 and sv[2] == 13
     assert sorted(sv[3:]) == [10, 12]  # max-key values kept, not pad zeros
+
+
+def test_sort_device_arbitrary_valid_column(mesh, devices):
+    """sort_device must honor a valid column whose invalid slots carry
+    ARBITRARY keys (not pre-set to the dtype max): invalid records are
+    dropped, all real records survive."""
+    import jax.numpy as jnp
+
+    sorter = TeraSorter(mesh)
+    rng = np.random.default_rng(7)
+    n = 8 * 1024
+    keys = rng.integers(0, 1 << 31, size=n, dtype=np.int32)
+    vals = rng.integers(0, 1 << 31, size=n, dtype=np.int32)
+    valid = (rng.random(n) < 0.7).astype(np.int32)
+    (sk, sv, n_valid, _), cap = sorter.sort_device(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid)
+    )
+    D = sorter.n_devices
+    sk_h = np.asarray(sk).reshape(D, -1)
+    sv_h = np.asarray(sv).reshape(D, -1)
+    nv = np.asarray(n_valid).reshape(-1)
+    out_k = np.concatenate([sk_h[d, : nv[d]] for d in range(D)])
+    out_v = np.concatenate([sv_h[d, : nv[d]] for d in range(D)])
+    real = valid > 0
+    np.testing.assert_array_equal(out_k, np.sort(keys[real], kind="stable"))
+    np.testing.assert_array_equal(np.sort(out_v), np.sort(vals[real]))

@@ -75,3 +75,18 @@ def test_ring_attention_validation(mesh, devices):
     q = jnp.zeros((100, 8), jnp.float32)  # 100 not divisible by 8
     with pytest.raises(ValueError):
         ring_attention(q, q, q, mesh=mesh)
+
+
+def test_ring_reduce_caches_compilation(mesh, devices):
+    """Same (mesh, shape, dtype, fns) → the jitted program is reused."""
+    from sparkrdma_tpu.parallel.ring import RingExchange, _ring_reduce_fn
+
+    ring = RingExchange(mesh)
+    init_fn = lambda shard: jnp.zeros_like(shard)  # noqa: E731
+    consume = lambda acc, src, cur: acc + cur  # noqa: E731
+    x = jnp.arange(8 * 4, dtype=jnp.int32).reshape(8, 4)
+    a = ring.ring_reduce(x, init_fn, consume)
+    before = _ring_reduce_fn.cache_info().hits
+    b = ring.ring_reduce(x + 1, init_fn, consume)
+    assert _ring_reduce_fn.cache_info().hits == before + 1
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b) - 8)
