@@ -32,7 +32,7 @@ BASELINE_GBPS = 12.5
 
 N_RECORDS = 1 << 24  # 16.7M records x 8B (int32 key + int32 val) = 134 MB
 WARMUP = 2
-ITERS = 5
+ITERS = 20
 
 
 def main():
@@ -50,20 +50,28 @@ def main():
 
     def run_once():
         (sk, sv, n_valid, _), _cap = sorter.sort_device(keys, vals)
-        # fetch a real result: on the axon platform block_until_ready can
-        # return before the computation drains, so a device_get is the
-        # only trustworthy fence
-        np.asarray(jax.device_get(n_valid))
         return sk, n_valid
+
+    def fence(x):
+        # on the axon platform block_until_ready can return before the
+        # computation drains, so a device_get is the only trustworthy
+        # fence; device execution is in-order, so fetching the LAST
+        # dispatch's output fences every prior one too
+        np.asarray(jax.device_get(x))
 
     for _ in range(WARMUP):
         sk, n_valid = run_once()
+    fence(n_valid)
     # sanity: every record accounted for
     assert int(jnp.sum(n_valid)) == N_RECORDS, "records lost in exchange"
 
+    # dispatch all iterations asynchronously and fence once: the host
+    # round trip (~10s of ms through the device tunnel) would otherwise
+    # dominate and measure latency, not shuffle throughput
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        run_once()
+        _, n_valid = run_once()
+    fence(n_valid)
     dt = (time.perf_counter() - t0) / ITERS
 
     bytes_per_iter = N_RECORDS * 8  # key + value

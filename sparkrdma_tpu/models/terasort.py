@@ -49,6 +49,29 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
     via the secondary sort key, and are excluded from counts.
     """
     n_local = keys.shape[0]
+    if n_devices == 1:
+        # degenerate mesh: a distributed sort on one device IS the local
+        # sort — skip sampling, windowing, the all_to_all, and the merge
+        # re-sort entirely (they would re-sort the same data)
+        sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
+        if valid is None:
+            k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+            n_real = jnp.int32(n_local)
+        else:
+            inv = jnp.int32(1) - valid
+            keys = jnp.where(valid > 0, keys, sentinel)
+            k, _, v = jax.lax.sort(
+                (keys, inv, vals), num_keys=2, is_stable=True
+            )
+            n_real = jnp.sum(valid).astype(jnp.int32)
+        pad = capacity - n_local
+        if pad < 0:
+            k, v = k[:capacity], v[:capacity]
+        else:
+            k = jnp.concatenate([k, jnp.full((pad,), sentinel, k.dtype)])
+            v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+        n_valid = jnp.minimum(n_real, jnp.int32(capacity))
+        return k, v, n_valid, jnp.int32(n_local)
     if valid is None:
         # fast path: every input slot is real
         k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
@@ -86,11 +109,30 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
         jnp.minimum(edges[1:], n_real) - starts, 0, capacity
     )
     slot = jnp.arange(capacity, dtype=jnp.int32)
-    idx = jnp.clip(starts[:, None] + slot[None, :], 0, n_local - 1)
     window_valid = slot[None, :] < jnp.minimum(counts, capacity)[:, None]
     sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
-    bk = jnp.where(window_valid, k[idx], sentinel)        # [D, cap]
-    bv = jnp.where(window_valid, v[idx], jnp.zeros((), v.dtype))
+    # windows are CONTIGUOUS runs of the locally-sorted arrays, so copy
+    # them with dynamic_slice (sequential HBM reads) rather than k[idx]
+    # fancy indexing — the latter lowers to a general gather, which on
+    # TPU costs ~30× the bandwidth-bound copy for these shapes
+    kp = jnp.concatenate([k, jnp.full((capacity,), sentinel, k.dtype)])
+    vp = jnp.concatenate([v, jnp.zeros((capacity,), v.dtype)])
+
+    def fill(p, bufs):
+        fk, fv = bufs
+        wk = jax.lax.dynamic_slice(kp, (starts[p],), (capacity,))
+        wv = jax.lax.dynamic_slice(vp, (starts[p],), (capacity,))
+        fk = jax.lax.dynamic_update_slice(fk, wk[None], (p, 0))
+        fv = jax.lax.dynamic_update_slice(fv, wv[None], (p, 0))
+        return fk, fv
+
+    # pvary: the loop carry must be device-varying like the filled
+    # windows, or shard_map rejects the replicated zeros init
+    bk0 = jax.lax.pvary(jnp.zeros((n_devices, capacity), k.dtype), EXCHANGE_AXIS)
+    bv0 = jax.lax.pvary(jnp.zeros((n_devices, capacity), v.dtype), EXCHANGE_AXIS)
+    bk, bv = jax.lax.fori_loop(0, n_devices, fill, (bk0, bv0))
+    bk = jnp.where(window_valid, bk, sentinel)            # [D, cap]
+    bv = jnp.where(window_valid, bv, jnp.zeros((), v.dtype))
     # exchange: device d keeps row d of every source
     rk = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
     rv = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
