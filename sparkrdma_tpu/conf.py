@@ -129,6 +129,16 @@ class TpuShuffleConf:
         return str(self.get("tracePath", "sparkrdma_tpu_trace.json"))
 
     @property
+    def compress(self) -> bool:
+        """Compress serialized shuffle blocks (reference: Spark codec
+        stream wrapping, RdmaShuffleReader.scala:51-58)."""
+        return self._bool("compress", False)
+
+    @property
+    def compress_codec(self) -> str:
+        return str(self.get("compressCodec", "zlib"))
+
+    @property
     def lazy_staging(self) -> bool:
         """ODP analog (reference: useOdp, RdmaShuffleConf.scala:68-83):
         keep committed map output in host memory and stage to HBM on

@@ -47,7 +47,11 @@ from sparkrdma_tpu.shuffle.writer import ShuffleWriter
 from sparkrdma_tpu.stats import ShuffleReaderStats
 from sparkrdma_tpu.transport.channel import Channel, ChannelType, FnCompletionListener
 from sparkrdma_tpu.transport.node import Node
-from sparkrdma_tpu.utils.serde import PickleSerializer, Serializer
+from sparkrdma_tpu.utils.serde import (
+    CompressedSerializer,
+    PickleSerializer,
+    Serializer,
+)
 from sparkrdma_tpu.utils.types import (
     BlockLocation,
     BlockManagerId,
@@ -130,7 +134,12 @@ class TpuShuffleManager:
         self.is_driver = is_driver
         self.network = network
         self.executor_id = executor_id
-        self.serializer = serializer or PickleSerializer()
+        if serializer is not None:
+            self.serializer = serializer
+        elif conf.compress:
+            self.serializer = CompressedSerializer(codec=conf.compress_codec)
+        else:
+            self.serializer = PickleSerializer()
         self.stats = ShuffleReaderStats(conf) if conf.collect_shuffle_reader_stats else None
 
         if is_driver:

@@ -18,8 +18,10 @@ from sparkrdma_tpu.transport.channel import (
 )
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.transport.loopback import LoopbackNetwork
+from sparkrdma_tpu.transport.tcp import TcpNetwork
 
 __all__ = [
+    "TcpNetwork",
     "Channel",
     "ChannelState",
     "ChannelType",

@@ -1,0 +1,339 @@
+"""TCP transport: the real multi-process control/data plane backend.
+
+Same connector interface as :class:`LoopbackNetwork`, over real sockets,
+so driver and executors can live in separate processes or hosts.  On a
+TPU pod the BULK shuffle plane rides ICI collectives (the TileExchange);
+this backend carries what remains host-side — the control plane (the
+five RPC message types) and the block-fetch path for executors outside
+the mesh (spill-over, debugging, CPU-only deployments).
+
+Mapping to the reference (RdmaNode.java / RdmaChannel.java):
+
+- connect() plays the RDMA CM handshake: a 9-byte hello carrying the
+  channel type and the caller's listening port, acked by the acceptor
+  (CONNECT_REQUEST/ESTABLISHED, RdmaNode.java:114-214).
+- OP_RPC frames are the two-sided SEND/RECV class; TCP supplies
+  ordering and (via its window) flow control, so the software credit
+  scheme of the loopback backend is not re-implemented here.
+- OP_READ_REQ/RESP is the one-sided READ class: the acceptor serves
+  registered-memory reads directly on the connection's reader thread —
+  the application's receive listener is never involved, preserving the
+  "remote CPU does not run app code to serve reads" split (the NIC's
+  role in RdmaChannel.java:441-474; here a dedicated service thread).
+
+Framing: every message is ``1B opcode + 4B LE length + payload``.
+Read requests carry ``8B req_id + 4B count + count × (8B address,
+4B length, 4B mkey)``; responses carry ``8B req_id + 1B status`` then
+either ``count × (4B len + bytes)`` or an error string.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from sparkrdma_tpu.transport.channel import (
+    Channel,
+    ChannelState,
+    ChannelType,
+    CompletionListener,
+    TransportError,
+)
+from sparkrdma_tpu.transport.node import Address, Node
+from sparkrdma_tpu.utils.types import BlockLocation
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = b"STPU"
+_HDR = struct.Struct("<BI")          # opcode, payload length
+_HELLO = struct.Struct("<4sBHH")     # magic, channel type, src port, pad
+_REQ_HDR = struct.Struct("<QI")      # req_id, location count
+_LOC = struct.Struct("<QII")         # address, length, mkey
+_RESP_HDR = struct.Struct("<QB")     # req_id, status
+_LEN = struct.Struct("<I")
+
+OP_RPC = 1
+OP_READ_REQ = 2
+OP_READ_RESP = 3
+
+_TYPE_BY_INDEX = list(ChannelType)
+
+# what the acceptor's side of each connection is called
+_PAIRED = {
+    ChannelType.RPC_REQUESTOR: ChannelType.RPC_RESPONDER,
+    ChannelType.RPC_WRAPPER: ChannelType.RPC_WRAPPER,
+    ChannelType.READ_REQUESTOR: ChannelType.READ_RESPONDER,
+}
+
+_MAX_FRAME = 1 << 30
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed by peer")
+        buf += chunk
+    return bytes(buf)
+
+
+class TcpChannel(Channel):
+    """One TCP connection; either endpoint can carry RPC frames, the
+    acceptor side additionally serves block reads."""
+
+    def __init__(self, channel_type: ChannelType, node: Node,
+                 peer: Address, sock: socket.socket):
+        super().__init__(channel_type, node.conf.send_queue_depth)
+        self.node = node
+        self.peer = peer
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._next_req = 1
+        self._reads: Dict[int, Tuple[int, CompletionListener]] = {}
+        self._reads_lock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start_reader(self) -> None:
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"tcp-{self.peer[0]}:{self.peer[1]}",
+        )
+        self._reader.start()
+
+    def stop(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        err = TransportError("channel stopped")
+        with self._reads_lock:
+            reads = list(self._reads.values())
+            self._reads.clear()
+        for _, listener in reads:
+            self._safe_fail(listener, err)
+        super().stop()
+
+    # -- sending ------------------------------------------------------------
+    def _send_msg(self, opcode: int, payload: bytes) -> None:
+        with self._send_lock:
+            self._sock.sendall(_HDR.pack(opcode, len(payload)) + payload)
+
+    def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:
+        def run():
+            try:
+                for frame in frames:
+                    self._send_msg(OP_RPC, frame)
+            except BaseException as e:
+                self._error(e)
+                self._fail(listener, e)
+            else:
+                self._complete(listener, None)
+            finally:
+                self._release_budget()
+
+        self.node.submit(run)
+
+    def _post_read(self, locations: List[BlockLocation],
+                   listener: CompletionListener) -> None:
+        with self._reads_lock:
+            req_id = self._next_req
+            self._next_req += 1
+            self._reads[req_id] = (len(locations), listener)
+        payload = bytearray(_REQ_HDR.pack(req_id, len(locations)))
+        for loc in locations:
+            payload += _LOC.pack(loc.address, loc.length, loc.mkey)
+
+        def run():
+            try:
+                self._send_msg(OP_READ_REQ, bytes(payload))
+            except BaseException as e:
+                with self._reads_lock:
+                    self._reads.pop(req_id, None)
+                self._error(e)
+                self._fail(listener, e)
+                self._release_budget()
+            # budget released when the response (or teardown) arrives
+
+        self.node.submit(run)
+
+    # -- receiving ----------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                opcode, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
+                if length > _MAX_FRAME:
+                    raise TransportError(f"oversized frame: {length}B")
+                payload = _recv_exact(self._sock, length) if length else b""
+                if opcode == OP_RPC:
+                    self.node.dispatch_frame(self, payload)
+                elif opcode == OP_READ_REQ:
+                    self._serve_read(payload)
+                elif opcode == OP_READ_RESP:
+                    self._finish_read(payload)
+                else:
+                    raise TransportError(f"unknown opcode {opcode}")
+        except BaseException as e:
+            if self.state not in (ChannelState.STOPPED,):
+                self._error(e)
+                self._fail_outstanding(e)
+
+    def _fail_outstanding(self, err: BaseException) -> None:
+        with self._reads_lock:
+            reads = list(self._reads.values())
+            self._reads.clear()
+        for _, listener in reads:
+            self._fail(listener, err)
+            self._release_budget()
+
+    def _serve_read(self, payload: bytes) -> None:
+        """The one-sided READ service: answered here on the reader
+        thread from the node's registered block stores — never via the
+        application receive listener."""
+        req_id, count = _REQ_HDR.unpack_from(payload, 0)
+        try:
+            locs = []
+            off = _REQ_HDR.size
+            for _ in range(count):
+                addr, length, mkey = _LOC.unpack_from(payload, off)
+                off += _LOC.size
+                locs.append(BlockLocation(addr, length, mkey))
+            blocks = [self.node.read_local_block(loc) for loc in locs]
+            body = bytearray(_RESP_HDR.pack(req_id, 0))
+            for b in blocks:
+                body += _LEN.pack(len(b))
+                body += b
+        except BaseException as e:
+            body = bytearray(_RESP_HDR.pack(req_id, 1))
+            body += str(e).encode("utf-8", "replace")
+        try:
+            self._send_msg(OP_READ_RESP, bytes(body))
+        except BaseException:
+            logger.warning("read response to %s failed", self.peer)
+
+    def _finish_read(self, payload: bytes) -> None:
+        req_id, status = _RESP_HDR.unpack_from(payload, 0)
+        with self._reads_lock:
+            entry = self._reads.pop(req_id, None)
+        if entry is None:
+            return  # raced with teardown
+        count, listener = entry
+        try:
+            if status != 0:
+                raise TransportError(
+                    payload[_RESP_HDR.size:].decode("utf-8", "replace")
+                )
+            blocks, off = [], _RESP_HDR.size
+            for _ in range(count):
+                (n,) = _LEN.unpack_from(payload, off)
+                off += _LEN.size
+                blocks.append(payload[off: off + n])
+                off += n
+        except BaseException as e:
+            self._fail(listener, e)
+        else:
+            self._complete(listener, blocks)
+        finally:
+            self._release_budget()
+
+    def reply_channel(self) -> Channel:
+        """Replies ride the same socket."""
+        return self
+
+
+class TcpNetwork:
+    """Listener + connector over real sockets (one instance per process)."""
+
+    def __init__(self, listen_backlog: int = 128):
+        self.listen_backlog = listen_backlog
+        self._listeners: Dict[Address, Tuple[socket.socket, threading.Thread, Node]] = {}
+        self._lock = threading.Lock()
+
+    # -- membership ---------------------------------------------------------
+    def register(self, node: Node) -> None:
+        host, port = node.address
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind((host, port))
+        except OSError as e:
+            srv.close()
+            raise TransportError(f"bind failed at {host}:{port}: {e}") from e
+        srv.listen(self.listen_backlog)
+        t = threading.Thread(
+            target=self._accept_loop, args=(srv, node), daemon=True,
+            name=f"tcp-accept-{host}:{port}",
+        )
+        with self._lock:
+            self._listeners[node.address] = (srv, t, node)
+        t.start()
+
+    def unregister(self, node: Node) -> None:
+        with self._lock:
+            entry = self._listeners.pop(node.address, None)
+        if entry is not None:
+            srv, _t, _n = entry
+            try:
+                srv.close()
+            except OSError:
+                pass
+
+    # -- acceptor (the CM listener thread analog) ---------------------------
+    def _accept_loop(self, srv: socket.socket, node: Node) -> None:
+        while True:
+            try:
+                sock, addr = srv.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                magic, type_idx, src_port, _ = _HELLO.unpack(
+                    _recv_exact(sock, _HELLO.size)
+                )
+                if magic != _MAGIC or type_idx >= len(_TYPE_BY_INDEX):
+                    raise TransportError(f"bad hello from {addr}")
+                req_type = _TYPE_BY_INDEX[type_idx]
+                sock.sendall(b"\x01")  # ack (ESTABLISHED)
+            except BaseException:
+                logger.warning("handshake with %s failed", addr, exc_info=True)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer = (addr[0], src_port)
+            ch = TcpChannel(_PAIRED.get(req_type, req_type), node, peer, sock)
+            ch._set_state(ChannelState.CONNECTED)
+            node.register_passive_channel(ch)
+            ch.start_reader()
+
+    # -- connector (passed to Node.get_channel) -----------------------------
+    def connect(self, src: Node, peer: Address,
+                channel_type: ChannelType) -> Channel:
+        timeout_s = src.conf.connect_timeout_ms / 1000.0
+        try:
+            sock = socket.create_connection(peer, timeout=timeout_s)
+            sock.settimeout(timeout_s)
+            sock.sendall(_HELLO.pack(
+                _MAGIC, _TYPE_BY_INDEX.index(channel_type),
+                src.address[1], 0,
+            ))
+            ack = _recv_exact(sock, 1)
+            if ack != b"\x01":
+                raise TransportError(f"handshake rejected by {peer}")
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError as e:
+            raise TransportError(f"connect to {peer} failed: {e}") from e
+        ch = TcpChannel(channel_type, src, peer, sock)
+        ch._set_state(ChannelState.CONNECTED)
+        ch.start_reader()
+        return ch

@@ -64,3 +64,54 @@ class PickleSerializer(Serializer):
             for rec in pickle.loads(view[off : off + n]):
                 yield rec
             off += n
+
+
+class CompressedSerializer(Serializer):
+    """Compression wrapper over any serializer — the analog of the
+    reference's read-side stream wrapping for codec support
+    (``wrapStream`` reflection, RdmaShuffleReader.scala:51-58,117-127),
+    applied symmetrically on write.  Codecs: ``zlib`` (default) and
+    ``lzma``; payloads below ``min_size`` are stored raw (1-byte codec
+    tag 0) since small-block compression costs more than it saves.
+    """
+
+    _RAW, _ZLIB, _LZMA = 0, 1, 2
+
+    def __init__(self, inner: Serializer = None, codec: str = "zlib",
+                 level: int = 1, min_size: int = 256):
+        self.inner = inner or PickleSerializer()
+        if codec not in ("zlib", "lzma"):
+            raise ValueError(f"unknown codec: {codec!r}")
+        self.codec = codec
+        self.level = level
+        self.min_size = min_size
+
+    def serialize(self, records: Iterable[Record]) -> bytes:
+        raw = self.inner.serialize(records)
+        if len(raw) < self.min_size:
+            return bytes([self._RAW]) + raw
+        if self.codec == "zlib":
+            import zlib
+
+            return bytes([self._ZLIB]) + zlib.compress(raw, self.level)
+        import lzma
+
+        return bytes([self._LZMA]) + lzma.compress(raw)
+
+    def deserialize(self, data: bytes) -> Iterator[Record]:
+        if not data:
+            return
+        tag, body = data[0], bytes(memoryview(data)[1:])
+        if tag == self._RAW:
+            raw = body
+        elif tag == self._ZLIB:
+            import zlib
+
+            raw = zlib.decompress(body)
+        elif tag == self._LZMA:
+            import lzma
+
+            raw = lzma.decompress(body)
+        else:
+            raise ValueError(f"unknown codec tag {tag}")
+        yield from self.inner.deserialize(raw)

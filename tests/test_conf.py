@@ -72,3 +72,28 @@ def test_tracer_bounded_events():
         t.instant("e", i=i)
     assert len(t.events) == 10
     assert t.dropped == 15
+
+
+def test_compressed_serializer_roundtrip():
+    from sparkrdma_tpu.utils.serde import CompressedSerializer, PickleSerializer
+
+    recs = [(i, "value-%d" % i) for i in range(5000)]
+    for codec in ("zlib", "lzma"):
+        s = CompressedSerializer(PickleSerializer(), codec=codec)
+        data = s.serialize(recs)
+        assert list(s.deserialize(data)) == recs
+        # compressible payload actually shrinks
+        assert len(data) < len(PickleSerializer().serialize(recs))
+    # tiny payloads stored raw (tag 0)
+    s = CompressedSerializer(min_size=1 << 20)
+    data = s.serialize([(1, 2)])
+    assert data[0] == 0
+    assert list(s.deserialize(data)) == [(1, 2)]
+
+
+def test_manager_compress_conf_picks_codec():
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.utils.serde import CompressedSerializer
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.compress": "true"})
+    assert conf.compress and conf.compress_codec == "zlib"
