@@ -1,0 +1,300 @@
+"""Job-level user API: the local[*] driver experience over the stack.
+
+The reference is a plugin inside Spark — its users write
+``rdd.reduceByKey`` / ``sortByKey`` and Spark's scheduler drives
+registerShuffle / getWriter / getReader (SURVEY.md §3).  This module is
+the standalone equivalent of that top layer so the framework is usable
+without Spark: a :class:`TpuShuffleContext` owning one driver and N
+executor managers (threads in-process by default, real processes over
+:class:`TcpNetwork`), and a :class:`Dataset` with the classic wide and
+narrow operations, every wide op running through the full
+write → publish → resolve → fetch → read shuffle path.
+
+    ctx = TpuShuffleContext(num_executors=3)
+    ds = ctx.parallelize(range(10000), num_slices=6)
+    counts = ds.map(lambda x: (x % 100, 1)).reduce_by_key(lambda a, b: a + b)
+    out = counts.collect()
+    ctx.stop()
+
+Device-native workloads (TeraSorter / WordCounter, the MXU/ICI path)
+are exposed as ``ctx.device_sort`` / ``ctx.device_count`` — the same
+split the reference has between its record plane and the NIC bulk
+plane.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.shuffle.manager import Aggregator, TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import (
+    HashPartitioner,
+    Partitioner,
+    RangePartitioner,
+)
+from sparkrdma_tpu.transport import LoopbackNetwork
+
+
+class TpuShuffleContext:
+    """Driver + executor managers + a task pool per executor."""
+
+    def __init__(
+        self,
+        num_executors: int = 2,
+        conf: Optional[TpuShuffleConf] = None,
+        network=None,
+        base_port: int = 39000,
+        tasks_per_executor: int = 4,
+        stage_to_device: bool = True,
+    ):
+        if num_executors <= 0:
+            raise ValueError("num_executors must be > 0")
+        self.conf = conf or TpuShuffleConf()
+        self.network = network if network is not None else LoopbackNetwork()
+        self.driver = TpuShuffleManager(
+            self.conf, is_driver=True, network=self.network,
+            port=self.conf.driver_port or base_port,
+            stage_to_device=stage_to_device,
+        )
+        self.executors = [
+            TpuShuffleManager(
+                self.conf, is_driver=False, network=self.network,
+                port=base_port + 100 + i * 10, executor_id=str(i),
+                stage_to_device=stage_to_device,
+            )
+            for i in range(num_executors)
+        ]
+        self._pools = [
+            ThreadPoolExecutor(
+                max_workers=tasks_per_executor,
+                thread_name_prefix=f"exec-{i}",
+            )
+            for i in range(num_executors)
+        ]
+        self._shuffle_ids = itertools.count()
+        self._stopped = False
+
+    # -- dataset creation ---------------------------------------------------
+    def parallelize(self, data: Iterable[Any],
+                    num_slices: Optional[int] = None) -> "Dataset":
+        items = list(data)
+        n = num_slices or len(self.executors) * 2
+        n = max(1, min(n, max(1, len(items))))
+        size = (len(items) + n - 1) // n
+        parts = [items[i * size : (i + 1) * size] for i in range(n)]
+        return Dataset(self, [p for p in parts])
+
+    # -- device-native workloads (the MXU/ICI plane) ------------------------
+    def device_sort(self, keys, vals=None, mesh=None):
+        """Global sortByKey on the device mesh (TeraSort path)."""
+        from sparkrdma_tpu.models.terasort import TeraSorter
+
+        return TeraSorter(mesh).sort(keys, vals)
+
+    def device_count(self, keys, vals=None, mesh=None) -> Dict[int, int]:
+        """reduceByKey(+) on the device mesh (WordCount path)."""
+        from sparkrdma_tpu.models.wordcount import WordCounter
+
+        return WordCounter(mesh).count(keys, vals)
+
+    # -- task running -------------------------------------------------------
+    def _run_tasks(self, tasks: Sequence[Tuple[int, Callable[[], Any]]]) -> List[Any]:
+        """Run (executor_index, thunk) tasks on their executors' pools."""
+        futs = [self._pools[e % len(self._pools)].submit(fn) for e, fn in tasks]
+        return [f.result() for f in futs]
+
+    # -- the wide operation: one full shuffle -------------------------------
+    def run_shuffle(
+        self,
+        partitions: List[List[Tuple[Any, Any]]],
+        partitioner: Partitioner,
+        aggregator: Optional[Aggregator] = None,
+        map_side_combine: bool = False,
+        key_ordering: bool = False,
+    ) -> List[List[Tuple[Any, Any]]]:
+        """Shuffle ``partitions`` (lists of (k, v)) into
+        ``partitioner.num_partitions`` output partitions through the full
+        data plane; the scheduler role of Spark's DAGScheduler."""
+        shuffle_id = next(self._shuffle_ids)
+        handle = self.driver.register_shuffle(
+            shuffle_id, len(partitions), partitioner,
+            aggregator=aggregator, map_side_combine=map_side_combine,
+            key_ordering=key_ordering,
+        )
+        E = len(self.executors)
+        maps_by_host: Dict[Any, List[int]] = defaultdict(list)
+        lock = threading.Lock()
+
+        def map_task(map_id: int, records: List[Tuple[Any, Any]]):
+            ex = self.executors[map_id % E]
+            w = ex.get_writer(handle, map_id)
+            w.write(records)
+            w.stop(True)
+            with lock:
+                maps_by_host[ex.local_smid].append(map_id)
+
+        self._run_tasks([
+            (m % E, (lambda m=m, recs=recs: map_task(m, recs)))
+            for m, recs in enumerate(partitions)
+        ])
+        mbh = dict(maps_by_host)
+
+        def reduce_task(pid: int) -> List[Tuple[Any, Any]]:
+            ex = self.executors[pid % E]
+            reader = ex.get_reader(handle, pid, pid + 1, mbh)
+            return list(reader.read())
+
+        out = self._run_tasks([
+            (p % E, (lambda p=p: reduce_task(p)))
+            for p in range(partitioner.num_partitions)
+        ])
+        self.driver.unregister_shuffle(shuffle_id)
+        for ex in self.executors:
+            ex.unregister_shuffle(shuffle_id)
+        return out
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for p in self._pools:
+            p.shutdown(wait=True)
+        for m in self.executors + [self.driver]:
+            m.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Dataset:
+    """Partitioned collection with Spark-shaped transformations.
+
+    Narrow ops (map/filter/flat_map/map_partitions) are applied lazily
+    and fused; wide ops run a real shuffle through the context."""
+
+    def __init__(self, ctx: TpuShuffleContext, partitions: List[List[Any]],
+                 transform: Optional[Callable[[List[Any]], List[Any]]] = None):
+        self.ctx = ctx
+        self._parts = partitions
+        self._transform = transform  # fused narrow stage, applied per partition
+
+    # -- narrow transformations (lazy, fused) --------------------------------
+    def _chain(self, f: Callable[[List[Any]], List[Any]]) -> "Dataset":
+        prev = self._transform
+        if prev is None:
+            fused = f
+        else:
+            def fused(part, prev=prev, f=f):
+                return f(prev(part))
+        return Dataset(self.ctx, self._parts, fused)
+
+    def map(self, f: Callable[[Any], Any]) -> "Dataset":
+        return self._chain(lambda part: [f(x) for x in part])
+
+    def filter(self, f: Callable[[Any], bool]) -> "Dataset":
+        return self._chain(lambda part: [x for x in part if f(x)])
+
+    def flat_map(self, f: Callable[[Any], Iterable[Any]]) -> "Dataset":
+        return self._chain(lambda part: [y for x in part for y in f(x)])
+
+    def map_partitions(self, f: Callable[[List[Any]], Iterable[Any]]) -> "Dataset":
+        return self._chain(lambda part: list(f(part)))
+
+    # -- materialization -----------------------------------------------------
+    def _materialize(self) -> List[List[Any]]:
+        if self._transform is None:
+            return self._parts
+        t = self._transform
+        E = len(self.ctx.executors)
+        out = self.ctx._run_tasks([
+            (i % E, (lambda p=p, t=t: t(list(p))))
+            for i, p in enumerate(self._parts)
+        ])
+        return out
+
+    def collect(self) -> List[Any]:
+        return [x for part in self._materialize() for x in part]
+
+    def count(self) -> int:
+        return sum(len(p) for p in self._materialize())
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    # -- wide transformations ------------------------------------------------
+    def _shuffled(self, partitioner, **kw) -> "Dataset":
+        parts = self._materialize()
+        out = self.ctx.run_shuffle(parts, partitioner, **kw)
+        return Dataset(self.ctx, out)
+
+    def partition_by(self, num_partitions: int) -> "Dataset":
+        return self._shuffled(HashPartitioner(num_partitions))
+
+    def reduce_by_key(self, f: Callable[[Any, Any], Any],
+                      num_partitions: Optional[int] = None) -> "Dataset":
+        agg = Aggregator(
+            create_combiner=lambda v: v, merge_value=f, merge_combiners=f
+        )
+        n = num_partitions or self.num_partitions
+        return self._shuffled(
+            HashPartitioner(n), aggregator=agg, map_side_combine=True
+        )
+
+    def group_by_key(self, num_partitions: Optional[int] = None) -> "Dataset":
+        agg = Aggregator(
+            create_combiner=lambda v: [v],
+            merge_value=lambda c, v: c + [v],
+            merge_combiners=lambda a, b: a + b,
+        )
+        n = num_partitions or self.num_partitions
+        return self._shuffled(
+            HashPartitioner(n), aggregator=agg, map_side_combine=True
+        )
+
+    def sort_by_key(self, num_partitions: Optional[int] = None,
+                    sample_size: int = 400, seed: int = 0) -> "Dataset":
+        """Range-partitioned global sort: concatenating the output
+        partitions in order yields the sorted data."""
+        parts = self._materialize()
+        keys = [k for part in parts for k, _ in part]
+        n = num_partitions or self.num_partitions
+        rng = random.Random(seed)
+        sample = (
+            rng.sample(keys, min(sample_size, len(keys))) if keys else []
+        )
+        ds = Dataset(self.ctx, parts)
+        return ds._shuffled(RangePartitioner(n, sample), key_ordering=True)
+
+    def join(self, other: "Dataset",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Inner equi-join: (k, v) ⋈ (k, w) → (k, (v, w)) — the exchange
+        shuffle of the reference's SQL workloads (BASELINE configs)."""
+        n = num_partitions or max(self.num_partitions, other.num_partitions)
+        tagged = Dataset(
+            self.ctx,
+            self.map(lambda kv: (kv[0], (0, kv[1])))._materialize()
+            + other.map(lambda kv: (kv[0], (1, kv[1])))._materialize(),
+        )
+        grouped = tagged.group_by_key(n)
+
+        def emit(part):
+            out = []
+            for k, tagged_vals in part:
+                left = [v for t, v in tagged_vals if t == 0]
+                right = [w for t, w in tagged_vals if t == 1]
+                for v in left:
+                    for w in right:
+                        out.append((k, (v, w)))
+            return out
+
+        return grouped.map_partitions(emit)

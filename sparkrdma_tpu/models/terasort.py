@@ -126,10 +126,14 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
         fv = jax.lax.dynamic_update_slice(fv, wv[None], (p, 0))
         return fk, fv
 
-    # pvary: the loop carry must be device-varying like the filled
-    # windows, or shard_map rejects the replicated zeros init
-    bk0 = jax.lax.pvary(jnp.zeros((n_devices, capacity), k.dtype), EXCHANGE_AXIS)
-    bv0 = jax.lax.pvary(jnp.zeros((n_devices, capacity), v.dtype), EXCHANGE_AXIS)
+    # pcast-to-varying: the loop carry must be device-varying like the
+    # filled windows, or shard_map rejects the replicated zeros init
+    bk0 = jax.lax.pcast(
+        jnp.zeros((n_devices, capacity), k.dtype), EXCHANGE_AXIS, to="varying"
+    )
+    bv0 = jax.lax.pcast(
+        jnp.zeros((n_devices, capacity), v.dtype), EXCHANGE_AXIS, to="varying"
+    )
     bk, bv = jax.lax.fori_loop(0, n_devices, fill, (bk0, bv0))
     bk = jnp.where(window_valid, bk, sentinel)            # [D, cap]
     bv = jnp.where(window_valid, bv, jnp.zeros((), v.dtype))

@@ -1,0 +1,81 @@
+"""Job-level API: the local[*] driver experience (BASELINE config 1
+groupByKey / reduceByKey / sortByKey jobs end-to-end)."""
+
+from collections import defaultdict
+
+import pytest
+
+from sparkrdma_tpu.api import TpuShuffleContext
+
+
+@pytest.fixture(scope="module")
+def ctx(devices):
+    c = TpuShuffleContext(num_executors=3, base_port=43000,
+                          stage_to_device=False)
+    yield c
+    c.stop()
+
+
+def test_narrow_ops_fused(ctx):
+    ds = ctx.parallelize(range(100), num_slices=5)
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 4 == 0).collect()
+    assert sorted(out) == [x * 2 for x in range(100) if (x * 2) % 4 == 0]
+    assert ds.flat_map(lambda x: [x, x]).count() == 200
+
+
+def test_reduce_by_key(ctx):
+    ds = ctx.parallelize(range(10_000), num_slices=8)
+    got = dict(
+        ds.map(lambda x: (x % 97, 1))
+        .reduce_by_key(lambda a, b: a + b, num_partitions=5)
+        .collect()
+    )
+    expected = defaultdict(int)
+    for x in range(10_000):
+        expected[x % 97] += 1
+    assert got == dict(expected)
+
+
+def test_group_by_key(ctx):
+    ds = ctx.parallelize([(i % 7, i) for i in range(500)], num_slices=6)
+    got = dict(ds.group_by_key(num_partitions=4).collect())
+    expected = defaultdict(list)
+    for i in range(500):
+        expected[i % 7].append(i)
+    assert set(got) == set(expected)
+    for k in expected:
+        assert sorted(got[k]) == expected[k]
+
+
+def test_sort_by_key_global_order(ctx):
+    import random
+
+    rng = random.Random(3)
+    keys = [rng.randrange(10**6) for _ in range(3000)]
+    ds = ctx.parallelize([(k, k + 1) for k in keys], num_slices=6)
+    out = ds.sort_by_key(num_partitions=5).collect()
+    assert [k for k, _ in out] == sorted(keys)
+    assert all(v == k + 1 for k, v in out)
+
+
+def test_join(ctx):
+    left = ctx.parallelize([(i % 10, f"L{i}") for i in range(50)], 4)
+    right = ctx.parallelize([(i % 10, f"R{i}") for i in range(20)], 3)
+    got = left.join(right, num_partitions=4).collect()
+    expected = []
+    for i in range(50):
+        for j in range(20):
+            if i % 10 == j % 10:
+                expected.append((i % 10, (f"L{i}", f"R{j}")))
+    assert sorted(got) == sorted(expected)
+
+
+def test_device_workloads_via_context(ctx):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 20, size=4096, dtype=np.int32)
+    sk, _ = ctx.device_sort(keys, keys)
+    assert (np.diff(sk) >= 0).all()
+    counts = ctx.device_count((keys % 13).astype(np.int32))
+    assert sum(counts.values()) == len(keys)
