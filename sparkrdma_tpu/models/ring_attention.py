@@ -23,57 +23,65 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.ops.attention import NEG_INF, block_attention
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
 from sparkrdma_tpu.parallel.ring import ring_shift
-
-NEG_INF = -1e30
 
 
 @functools.lru_cache(maxsize=16)
 def _ring_attention_fn(mesh: Mesh, s_local: int, d_head: int, causal: bool,
-                       dtype_str: str):
+                       dtype_str: str, impl: Optional[str]):
     D = len(list(mesh.devices.flat))
     spec = P(EXCHANGE_AXIS, None)
 
     def body(q_, k_, v_):  # local views: [s_local, d]
         my = jax.lax.axis_index(EXCHANGE_AXIS)
         scale = 1.0 / np.sqrt(d_head)
-        q_pos = my * s_local + jnp.arange(s_local)  # global query positions
 
         def step(carry, j):
             m, l, o, cur_k, cur_v = carry
             src = (my - j) % D
-            # scores on the MXU: [s_local, s_local]
-            s = (q_ @ cur_k.T) * scale
-            if causal:
-                k_pos = src * s_local + jnp.arange(s_local)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                s = jnp.where(mask, s, NEG_INF)
-            # online softmax: rescale running stats by the new max
-            m_new = jnp.maximum(m, s.max(axis=-1))
+            # hot op: blockwise flash partials, MXU via the Pallas
+            # kernel on TPU backends (ops/attention.py)
+            m_blk, l_blk, o_blk = block_attention(
+                q_, cur_k, cur_v,
+                q_offset=my * s_local, k_offset=src * s_local,
+                causal=causal, scale=scale, impl=impl,
+            )
+            # exact online-softmax fold: rows fully masked in this block
+            # carry m_blk = NEG_INF, so beta = 0 kills their partials
+            m_new = jnp.maximum(m, m_blk)
             alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new[:, None])
-            l_new = l * alpha + p.sum(axis=-1)
-            o_new = o * alpha[:, None] + p @ cur_v
+            beta = jnp.exp(m_blk - m_new)
+            l_new = l * alpha + l_blk * beta
+            o_new = o * alpha[:, None] + o_blk * beta[:, None]
             return (
                 m_new, l_new, o_new,
                 ring_shift(cur_k), ring_shift(cur_v),
             ), None
 
         # derive the initial stats from q_ so they carry the same varying
-        # mesh-axis type as the loop outputs (shard_map typing rule)
-        m0 = jnp.full_like(q_[:, 0], NEG_INF)
-        l0 = jnp.zeros_like(q_[:, 0])
-        o0 = jnp.zeros_like(q_)
+        # mesh-axis type as the loop outputs (shard_map typing rule);
+        # accumulate in float32 regardless of input dtype
+        q32 = q_.astype(jnp.float32)
+        m0 = jnp.full_like(q32[:, 0], NEG_INF)
+        l0 = jnp.zeros_like(q32[:, 0])
+        o0 = jnp.zeros_like(q32)
         (m, l, o, _, _), _ = jax.lax.scan(
             step, (m0, l0, o0, k_, v_), jnp.arange(D)
         )
         # guard fully-masked rows (l == 0 can only happen with causal=False
         # pathological inputs; causal row 0 always sees itself)
-        return o / jnp.maximum(l, 1e-30)[:, None]
+        out = o / jnp.maximum(l, 1e-30)[:, None]
+        return out.astype(q_.dtype)
 
+    # check_vma=False: interpret-mode pallas_call bodies mix varying and
+    # replicated values in ways the strict vma checker rejects (JAX
+    # suggests this workaround in the error itself); collectives inside
+    # are unaffected
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -84,11 +92,15 @@ def ring_attention(
     v: jax.Array,
     mesh: Optional[Mesh] = None,
     causal: bool = False,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Exact attention over sequences sharded on the mesh axis.
 
     q/k/v: [S, d_head] global arrays (S divisible by D).  Returns
     softmax(q kᵀ / √d) v, computed blockwise over the ring.
+
+    ``impl`` selects the per-block kernel: "pallas", "xla", or None =
+    auto (pallas on TPU backends).
     """
     mesh = mesh if mesh is not None else make_mesh()
     D = len(list(mesh.devices.flat))
@@ -97,7 +109,7 @@ def ring_attention(
         raise ValueError(f"sequence length {S} not divisible by D={D}")
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError("q, k, v must share [S, d_head]")
-    fn = _ring_attention_fn(mesh, S // D, d_head, causal, str(q.dtype))
+    fn = _ring_attention_fn(mesh, S // D, d_head, causal, str(q.dtype), impl)
     sharding = NamedSharding(mesh, P(EXCHANGE_AXIS, None))
     q = jax.device_put(q, sharding)
     k = jax.device_put(k, sharding)
