@@ -1,0 +1,199 @@
+"""Device-native equi-joins on the mesh: the SQL-exchange workloads.
+
+The reference's benchmark list ends with Spark SQL TPC-DS q64/q72 —
+"broadcast + exchange shuffle" joins (BASELINE.md configs).  These are
+the corresponding device-native models, for the star-schema shape those
+queries have: a large FACT table joined to a DIMENSION table whose join
+keys are unique.
+
+- :class:`HashJoiner` — the exchange-shuffle join: both sides are
+  hash-partitioned by key and moved with one ``all_to_all`` each, then
+  every device probes its co-partitioned pair locally (sort the
+  dimension side, ``searchsorted`` probe — no scatters).
+- :class:`BroadcastJoiner` — the broadcast join: the dimension side is
+  small, so it is replicated to every device (``in_specs=P(None)``, the
+  all-gather XLA inserts for a replicated operand) and only the fact
+  side is sharded; no exchange at all.
+
+Output is the matched triple per fact row plus a found mask; unmatched
+fact rows are dropped host-side (inner join).  Unique-key dimension
+sides make the output size statically equal to the fact side — the
+property that keeps the SPMD program shape-static (SURVEY.md §7
+"variable-length blocks" hard part does not arise).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.models._base import MAX_OVERFLOW_RETRIES, ExchangeModel
+from sparkrdma_tpu.ops.partition import hash_partition_ids, partition_to_buckets
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+
+def _probe(lk, l_valid, rk, rv, r_valid):
+    """Local probe: for each left key, find its (unique) right match.
+    Returns (rv_matched, found) aligned with lk."""
+    sentinel = jnp.array(jnp.iinfo(rk.dtype).max, rk.dtype)
+    rk_m = jnp.where(r_valid > 0, rk, sentinel)
+    srk, srv = jax.lax.sort((rk_m, rv), num_keys=1, is_stable=True)
+    n = srk.shape[0]
+    idx = jnp.clip(
+        jnp.searchsorted(srk, lk, side="left").astype(jnp.int32), 0, n - 1
+    )
+    hit_k = srk[idx]
+    found = ((hit_k == lk) & (l_valid > 0)).astype(jnp.int32)
+    return srv[idx], found
+
+
+@functools.lru_cache(maxsize=16)
+def make_hash_join_step(mesh: Mesh, n_left: int, n_right: int,
+                        cap_l: int, cap_r: int):
+    """Jitted exchange join step over global [D*n_left] fact and
+    [D*n_right] dimension columns sharded on the mesh axis."""
+    D = len(list(mesh.devices.flat))
+    spec = P(EXCHANGE_AXIS)
+
+    def body(lk, lv, l_valid, rk, rv, r_valid):  # local shards
+        my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
+
+        def exchange(k, v, valid, cap):
+            ids = hash_partition_ids(k, D)
+            ids = jnp.where(valid > 0, ids, my)  # padding stays home
+            (bk, bv, bm), counts = partition_to_buckets(
+                ids, (k, v, valid), D, cap,
+                fill_values=(
+                    jnp.array(jnp.iinfo(k.dtype).max, k.dtype),
+                    jnp.zeros((), v.dtype),
+                    jnp.zeros((), jnp.int32),
+                ),
+            )
+            ek = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+            ev = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+            em = jax.lax.all_to_all(bm, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+            return (
+                ek.reshape(-1), ev.reshape(-1), em.reshape(-1),
+                jnp.max(counts).astype(jnp.int32),
+            )
+
+        elk, elv, elm, fill_l = exchange(lk, lv, l_valid, cap_l)
+        erk, erv, erm, fill_r = exchange(rk, rv, r_valid, cap_r)
+        rv_m, found = _probe(elk, elm, erk, erv, erm)
+        return elk, elv, rv_m, found, fill_l[None], fill_r[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec,) * 6, out_specs=(spec,) * 6
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=16)
+def make_broadcast_join_step(mesh: Mesh, n_left: int, n_right_total: int):
+    """Jitted broadcast join: fact sharded, dimension replicated."""
+    spec = P(EXCHANGE_AXIS)
+
+    def body(lk, lv, l_valid, rk, rv, r_valid):  # rk/rv/r_valid: FULL table
+        rv_m, found = _probe(lk, l_valid, rk, rv, r_valid)
+        return lk, lv, rv_m, found
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, P(None), P(None), P(None)),
+        out_specs=(spec,) * 4,
+    )
+    return jax.jit(mapped)
+
+
+class HashJoiner(ExchangeModel):
+    """Exchange-shuffle inner join of (fact_keys, fact_vals) with a
+    unique-keyed (dim_keys, dim_vals)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 capacity_factor: float = 1.6):
+        super().__init__(mesh, capacity_factor)
+
+    def join(self, fact_keys, fact_vals, dim_keys, dim_vals
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Returns (keys, fact_vals, dim_vals) for every matching fact
+        row (input order not preserved)."""
+        lk, lv = _as_columns(fact_keys, fact_vals)
+        rk, rv = _as_columns(dim_keys, dim_vals)
+        D = self.n_devices
+        lk, lv, l_valid, nl = _pad_to(lk, lv, D)
+        rk, rv, r_valid, nr = _pad_to(rk, rv, D)
+
+        factor = self.capacity_factor
+        for _ in range(MAX_OVERFLOW_RETRIES):
+            cap_l = self._capacity(nl // D, factor)
+            cap_r = self._capacity(nr // D, factor)
+            step = make_hash_join_step(self.mesh, nl // D, nr // D,
+                                       cap_l, cap_r)
+            elk, elv, rv_m, found, fill_l, fill_r = step(
+                *(jax.device_put(x, self.sharding)
+                  for x in (lk, lv, l_valid, rk, rv, r_valid))
+            )
+            if (int(np.max(np.asarray(fill_l))) <= cap_l
+                    and int(np.max(np.asarray(fill_r))) <= cap_r):
+                mask = np.asarray(found) > 0
+                return (
+                    np.asarray(elk)[mask],
+                    np.asarray(elv)[mask],
+                    np.asarray(rv_m)[mask],
+                )
+            factor *= 2
+        raise RuntimeError(
+            f"join bucket overflow persisted after {MAX_OVERFLOW_RETRIES} "
+            "retries"
+        )
+
+
+class BroadcastJoiner(ExchangeModel):
+    """Broadcast inner join: dimension side replicated to every device."""
+
+    def join(self, fact_keys, fact_vals, dim_keys, dim_vals
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        lk, lv = _as_columns(fact_keys, fact_vals)
+        rk, rv = _as_columns(dim_keys, dim_vals)
+        D = self.n_devices
+        lk, lv, l_valid, nl = _pad_to(lk, lv, D)
+        r_valid = jnp.ones(rk.shape[0], jnp.int32)
+        step = make_broadcast_join_step(self.mesh, nl // D, rk.shape[0])
+        rep = NamedSharding(self.mesh, P(None))
+        elk, elv, rv_m, found = step(
+            jax.device_put(lk, self.sharding),
+            jax.device_put(lv, self.sharding),
+            jax.device_put(l_valid, self.sharding),
+            jax.device_put(jnp.asarray(rk), rep),
+            jax.device_put(jnp.asarray(rv), rep),
+            jax.device_put(r_valid, rep),
+        )
+        mask = np.asarray(found) > 0
+        return (
+            np.asarray(elk)[mask], np.asarray(elv)[mask],
+            np.asarray(rv_m)[mask],
+        )
+
+
+def _as_columns(keys, vals):
+    k = jnp.asarray(np.asarray(keys))
+    v = jnp.asarray(np.asarray(vals))
+    if k.shape != v.shape or k.ndim != 1:
+        raise ValueError("keys/vals must be equal-length 1-D arrays")
+    return k, v
+
+
+def _pad_to(k, v, d):
+    n = k.shape[0]
+    n_pad = (-n) % d
+    valid = np.ones(n + n_pad, np.int32)
+    if n_pad:
+        valid[n:] = 0
+        k = jnp.concatenate([k, jnp.zeros(n_pad, k.dtype)])
+        v = jnp.concatenate([v, jnp.zeros(n_pad, v.dtype)])
+    return k, v, jnp.asarray(valid), n + n_pad

@@ -115,24 +115,47 @@ def partition_to_buckets(
         sorted_ids, jnp.arange(n_parts + 1, dtype=jnp.int32)
     )  # [n_parts+1] bucket boundaries in the sorted order
     counts = (edges[1:] - edges[:-1]).astype(jnp.int32)
-    starts = edges[:-1]
+    starts = edges[:-1].astype(jnp.int32)
     slot = jnp.arange(capacity, dtype=jnp.int32)
     idx = starts[:, None] + slot[None, :]              # [n_parts, capacity]
     # overflow entries simply fall outside the capacity window
     valid = slot[None, :] < jnp.minimum(counts, capacity)[:, None]
-    gather_idx = jnp.clip(idx, 0, n - 1)
     bucketed = []
     flat_iter = iter(sorted_flat)
     for v, fill in zip(values, fill_values):
         if v.ndim == 1:
-            b = next(flat_iter)[gather_idx]            # [n_parts, capacity]
+            # buckets are CONTIGUOUS runs of the sorted order: copy them
+            # with dynamic_slice per bucket instead of fancy-indexed
+            # gather — the general TPU gather costs ~30x the
+            # bandwidth-bound copy (same fix as the TeraSort windows)
+            b = _window_copy(next(flat_iter), starts, n_parts, capacity)
             b = jnp.where(valid, b, jnp.asarray(fill, v.dtype))
         else:
+            gather_idx = jnp.clip(idx, 0, n - 1)
             b = v[perm[gather_idx]]                    # [n_parts, capacity, ...]
             mask = valid.reshape(valid.shape + (1,) * (v.ndim - 1))
             b = jnp.where(mask, b, jnp.asarray(fill, v.dtype))
         bucketed.append(b)
     return tuple(bucketed), counts
+
+
+def _window_copy(sorted_arr: jax.Array, starts: jax.Array,
+                 n_parts: int, capacity: int) -> jax.Array:
+    """Copy n_parts contiguous windows [starts[p], starts[p]+capacity)
+    of ``sorted_arr`` into a [n_parts, capacity] layout with sequential
+    dynamic_slice reads.  The tail is padded so slices never clamp; the
+    init buffer is broadcast from the data so it carries the same
+    device-varying type under shard_map."""
+    src = jnp.concatenate([
+        sorted_arr, jnp.zeros((capacity,), sorted_arr.dtype)
+    ])
+    init = jnp.broadcast_to(src[:1], (n_parts, capacity))
+
+    def fill_fn(p, buf):
+        w = jax.lax.dynamic_slice(src, (starts[p],), (capacity,))
+        return jax.lax.dynamic_update_slice(buf, w[None], (p, 0))
+
+    return jax.lax.fori_loop(0, n_parts, fill_fn, init)
 
 
 def _default_fill(dtype):

@@ -116,3 +116,45 @@ def test_sort_device_arbitrary_valid_column(mesh, devices):
     real = valid > 0
     np.testing.assert_array_equal(out_k, np.sort(keys[real], kind="stable"))
     np.testing.assert_array_equal(np.sort(out_v), np.sort(vals[real]))
+
+
+def _join_case(seed, n_fact, n_dim, key_space):
+    rng = np.random.default_rng(seed)
+    dim_keys = rng.choice(key_space, size=n_dim, replace=False).astype(np.int32)
+    dim_vals = rng.integers(0, 1 << 30, size=n_dim, dtype=np.int32)
+    fact_keys = rng.integers(0, key_space, size=n_fact, dtype=np.int32)
+    fact_vals = rng.integers(0, 1 << 30, size=n_fact, dtype=np.int32)
+    lookup = dict(zip(dim_keys.tolist(), dim_vals.tolist()))
+    expected = sorted(
+        (int(k), int(v), lookup[int(k)])
+        for k, v in zip(fact_keys, fact_vals) if int(k) in lookup
+    )
+    return fact_keys, fact_vals, dim_keys, dim_vals, expected
+
+
+@pytest.mark.parametrize("joiner_cls", ["hash", "broadcast"])
+def test_device_join(joiner_cls, mesh, devices):
+    from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+    fk, fv, dk, dv, expected = _join_case(5, 4000, 300, 1000)
+    j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
+    k, lv, rv = j.join(fk, fv, dk, dv)
+    got = sorted(zip(k.tolist(), lv.tolist(), rv.tolist()))
+    assert got == expected
+
+
+def test_hash_join_skewed_overflow_retry(mesh, devices):
+    from sparkrdma_tpu.models.join import HashJoiner
+
+    rng = np.random.default_rng(9)
+    # 70% of fact keys identical -> one device's bucket overflows
+    hot = np.full(7000, 42, np.int32)
+    cold = rng.integers(0, 500, size=3000, dtype=np.int32)
+    fk = np.concatenate([hot, cold])
+    fv = np.arange(10000, dtype=np.int32)
+    dk = np.arange(500, dtype=np.int32)
+    dv = dk * 3
+    j = HashJoiner(mesh, capacity_factor=1.1)
+    k, lv, rv = j.join(fk, fv, dk, dv)
+    assert len(k) == 10000  # every fact key exists in dim
+    assert (rv == k * 3).all()
