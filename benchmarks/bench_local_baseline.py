@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""BASELINE config 1: local[*] groupByKey through the record plane.
+
+The reference measures Spark local[*] with the stock SortShuffleManager
+as its CPU-only control (BASELINE.md config 1).  Here the same job —
+groupByKey over (key, payload) records — runs through our full record
+plane: write → publish → resolve → fetch → read over the loopback
+transport, with every executor in one process.  The metric is
+end-to-end shuffled payload bytes per second on the record (host) plane;
+``vs_baseline`` is vs the RoCE line rate the reference's NIC plane is
+bounded by (the record plane is NOT expected to reach it — that is the
+device plane's job, configs 3-5).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import ROCE_LINE_RATE_GBPS, emit
+
+from sparkrdma_tpu.api import TpuShuffleContext
+
+N_RECORDS = 200_000
+PAYLOAD = 64  # bytes per record
+N_KEYS = 512
+
+
+def main():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, N_KEYS, N_RECORDS)
+    payload = bytes(PAYLOAD)
+    records = [(int(k), payload) for k in keys]
+
+    with TpuShuffleContext(num_executors=4, stage_to_device=False) as ctx:
+        ds = ctx.parallelize(records, num_slices=8)
+        t0 = time.perf_counter()
+        out = ds.group_by_key(num_partitions=8).collect()
+        dt = time.perf_counter() - t0
+
+    assert len(out) == N_KEYS, f"expected {N_KEYS} groups, got {len(out)}"
+    assert sum(len(vs) for _, vs in out) == N_RECORDS
+    gbps = N_RECORDS * PAYLOAD / dt / 1e9
+    emit(
+        f"local[*] groupByKey record-plane throughput ({N_RECORDS} x "
+        f"{PAYLOAD}B records)",
+        gbps, "GB/s", gbps / ROCE_LINE_RATE_GBPS,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""BASELINE config 2: 2-executor reduceByKey over the loopback transport.
+
+The reference's second measurement config is a 2-executor
+RdmaShuffleManager run with the bypass serializer (BASELINE.md).  Here:
+two executor managers + a driver on the loopback network, reduceByKey
+with map-side combine, raw-bytes-free int payloads.  Reported as
+records/s through the full control+data plane.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import emit
+
+from sparkrdma_tpu.api import TpuShuffleContext
+
+N_RECORDS = 300_000
+N_KEYS = 1024
+
+
+def main():
+    rng = np.random.default_rng(1)
+    records = [(int(k), 1) for k in rng.integers(0, N_KEYS, N_RECORDS)]
+
+    with TpuShuffleContext(num_executors=2, stage_to_device=False) as ctx:
+        ds = ctx.parallelize(records, num_slices=4)
+        t0 = time.perf_counter()
+        out = ds.reduce_by_key(lambda a, b: a + b, num_partitions=4).collect()
+        dt = time.perf_counter() - t0
+
+    assert len(out) == N_KEYS
+    assert sum(v for _, v in out) == N_RECORDS
+    rps = N_RECORDS / dt
+    # no published reference number for this config (chart image only);
+    # baseline ratio is vs 1M records/s, a round figure for a 2-node
+    # Spark reduceByKey on the reference's hardware class
+    emit(
+        f"2-executor reduceByKey record throughput ({N_RECORDS} records, "
+        f"{N_KEYS} keys)",
+        rps / 1e6, "Mrecords/s", rps / 1e6,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""BASELINE config 3: TeraSort (sortByKey) on the device mesh.
+
+The reference's headline: HiBench TeraSort 175 GB over 100 GbE RoCE
+(README.md:7-19).  This is the same measurement as the repo-root
+``bench.py`` but parameterizable: sample → range-partition →
+all_to_all → merge as ONE XLA program, reported as sorted bytes per
+second per chip vs the reference's 12.5 GB/s NIC line rate.
+
+    python benchmarks/bench_terasort.py [log2_records]
+"""
+
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import ROCE_LINE_RATE_GBPS, emit, time_iters
+
+from sparkrdma_tpu.models.terasort import TeraSorter
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+
+def main():
+    log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    n = 1 << log2
+    mesh = make_mesh()
+    sorter = TeraSorter(mesh)
+    rng = np.random.default_rng(42)
+    keys = jax.device_put(
+        rng.integers(0, 1 << 31, n, dtype=np.int32), sorter.sharding
+    )
+    vals = jax.device_put(
+        rng.integers(0, 1 << 31, n, dtype=np.int32), sorter.sharding
+    )
+
+    def run():
+        (sk, sv, n_valid, _), _cap = sorter.sort_device(keys, vals)
+        return sk, n_valid
+
+    dt = time_iters(run, iters=20)
+    n_chips = len(list(mesh.devices.flat))
+    gbps_chip = n * 8 / dt / 1e9 / n_chips
+    emit(
+        f"terasort shuffle+sort throughput per chip ({n} records, "
+        f"{n_chips} chip(s))",
+        gbps_chip, "GB/s/chip", gbps_chip / ROCE_LINE_RATE_GBPS,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Shared helpers for the benchmark suite.
+
+Every benchmark prints one JSON line per metric, the same shape as the
+repo-root ``bench.py``:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` compares against the reference data plane's per-node
+ceiling — the 100 GbE RoCE line rate of 12.5 GB/s that bounds
+SparkRDMA's shuffle throughput (reference README.md:7-19) — unless a
+benchmark states its own baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+# 100 GbE RoCE line rate, the reference's per-node data-plane ceiling (GB/s)
+ROCE_LINE_RATE_GBPS = 12.5
+
+
+def fence(x) -> None:
+    """Trustworthy device fence: fetch (a tiny slice of) the last
+    dispatched output.  Device execution is in-order, so this fences
+    every prior dispatch too; plain block_until_ready can return early
+    on the tunneled single-chip platform."""
+    arr = jax.device_get(x)
+    np.asarray(arr)
+
+
+def time_iters(run: Callable[[], object], iters: int, warmup: int = 2) -> float:
+    """Mean seconds per iteration; dispatches asynchronously and fences
+    once so the host round trip is amortized out."""
+    out = None
+    for _ in range(warmup):
+        out = run()
+    fence(jax.tree.leaves(out)[-1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run()
+    fence(jax.tree.leaves(out)[-1])
+    return (time.perf_counter() - t0) / iters
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": round(float(value), 3),
+        "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 3),
+    }), flush=True)

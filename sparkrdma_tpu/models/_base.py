@@ -38,18 +38,30 @@ class ExchangeModel:
         cap = int(math.ceil(n_local / self.n_devices * factor))
         return max(8, (cap + 7) // 8 * 8)
 
-    def _run_with_overflow_retry(
-        self, n_total: int, run: Callable[[int], Tuple]
-    ):
-        """Call ``run(capacity)`` → (outputs, max_fill); re-run with
-        doubled factor while any bucket overflowed."""
+    def _retry_with_factor(self, run: Callable[[float], Tuple]):
+        """Call ``run(factor)`` → (outputs, overflowed: bool); re-run
+        with doubled skew factor while any bucket overflowed.  The
+        general form for models with more than one capacity (e.g. the
+        two-sided join)."""
         factor = self.capacity_factor
         for _attempt in range(MAX_OVERFLOW_RETRIES):
-            cap = self._capacity(n_total // self.n_devices, factor)
-            outputs, max_fill = run(cap)
-            if int(np.max(np.asarray(max_fill))) <= cap:
+            outputs, overflowed = run(factor)
+            if not overflowed:
                 return outputs
             factor *= 2  # key skew overflowed a bucket: retry bigger
         raise RuntimeError(
             f"bucket overflow persisted after {MAX_OVERFLOW_RETRIES} retries"
         )
+
+    def _run_with_overflow_retry(
+        self, n_total: int, run: Callable[[int], Tuple]
+    ):
+        """Call ``run(capacity)`` → (outputs, max_fill); re-run with
+        doubled factor while any bucket overflowed."""
+
+        def attempt(factor: float):
+            cap = self._capacity(n_total // self.n_devices, factor)
+            outputs, max_fill = run(cap)
+            return outputs, int(np.max(np.asarray(max_fill))) > cap
+
+        return self._retry_with_factor(attempt)

@@ -32,23 +32,35 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkrdma_tpu.models._base import MAX_OVERFLOW_RETRIES, ExchangeModel
-from sparkrdma_tpu.ops.partition import hash_partition_ids, partition_to_buckets
+from sparkrdma_tpu.models._base import ExchangeModel
+from sparkrdma_tpu.ops.exchange import hash_exchange
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
 
 def _probe(lk, l_valid, rk, rv, r_valid):
     """Local probe: for each left key, find its (unique) right match.
-    Returns (rv_matched, found) aligned with lk."""
+    Returns (rv_matched, found) aligned with lk.
+
+    Validity of the HIT slot is checked explicitly: invalid right slots
+    (bucket fill / padding) are forced onto the sentinel key and sorted
+    AFTER valid slots of the same key, so a real right key equal to the
+    dtype max still wins the side="left" probe, and a fact key equal to
+    the dtype max cannot match a padding slot."""
+    n = rk.shape[0]
+    if n == 0:
+        # empty dimension side: no fact row can match
+        return jnp.zeros(lk.shape[0], rv.dtype), jnp.zeros(lk.shape[0], jnp.int32)
     sentinel = jnp.array(jnp.iinfo(rk.dtype).max, rk.dtype)
     rk_m = jnp.where(r_valid > 0, rk, sentinel)
-    srk, srv = jax.lax.sort((rk_m, rv), num_keys=1, is_stable=True)
-    n = srk.shape[0]
+    r_inv = jnp.int32(1) - (r_valid > 0).astype(jnp.int32)
+    srk, sinv, srv = jax.lax.sort(
+        (rk_m, r_inv, rv), num_keys=2, is_stable=False
+    )
     idx = jnp.clip(
         jnp.searchsorted(srk, lk, side="left").astype(jnp.int32), 0, n - 1
     )
-    hit_k = srk[idx]
-    found = ((hit_k == lk) & (l_valid > 0)).astype(jnp.int32)
+    hit_valid = sinv[idx] == 0
+    found = ((srk[idx] == lk) & hit_valid & (l_valid > 0)).astype(jnp.int32)
     return srv[idx], found
 
 
@@ -61,29 +73,8 @@ def make_hash_join_step(mesh: Mesh, n_left: int, n_right: int,
     spec = P(EXCHANGE_AXIS)
 
     def body(lk, lv, l_valid, rk, rv, r_valid):  # local shards
-        my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
-
-        def exchange(k, v, valid, cap):
-            ids = hash_partition_ids(k, D)
-            ids = jnp.where(valid > 0, ids, my)  # padding stays home
-            (bk, bv, bm), counts = partition_to_buckets(
-                ids, (k, v, valid), D, cap,
-                fill_values=(
-                    jnp.array(jnp.iinfo(k.dtype).max, k.dtype),
-                    jnp.zeros((), v.dtype),
-                    jnp.zeros((), jnp.int32),
-                ),
-            )
-            ek = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-            ev = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-            em = jax.lax.all_to_all(bm, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-            return (
-                ek.reshape(-1), ev.reshape(-1), em.reshape(-1),
-                jnp.max(counts).astype(jnp.int32),
-            )
-
-        elk, elv, elm, fill_l = exchange(lk, lv, l_valid, cap_l)
-        erk, erv, erm, fill_r = exchange(rk, rv, r_valid, cap_r)
+        elk, elv, elm, fill_l = hash_exchange(lk, lv, l_valid, D, cap_l)
+        erk, erv, erm, fill_r = hash_exchange(rk, rv, r_valid, D, cap_r)
         rv_m, found = _probe(elk, elm, erk, erv, erm)
         return elk, elv, rv_m, found, fill_l[None], fill_r[None]
 
@@ -128,28 +119,30 @@ class HashJoiner(ExchangeModel):
         lk, lv, l_valid, nl = _pad_to(lk, lv, D)
         rk, rv, r_valid, nr = _pad_to(rk, rv, D)
 
-        factor = self.capacity_factor
-        for _ in range(MAX_OVERFLOW_RETRIES):
+        # place inputs once: only the capacities change between retries
+        placed = tuple(
+            jax.device_put(x, self.sharding)
+            for x in (lk, lv, l_valid, rk, rv, r_valid)
+        )
+
+        def attempt(factor: float):
             cap_l = self._capacity(nl // D, factor)
             cap_r = self._capacity(nr // D, factor)
             step = make_hash_join_step(self.mesh, nl // D, nr // D,
                                        cap_l, cap_r)
-            elk, elv, rv_m, found, fill_l, fill_r = step(
-                *(jax.device_put(x, self.sharding)
-                  for x in (lk, lv, l_valid, rk, rv, r_valid))
+            elk, elv, rv_m, found, fill_l, fill_r = step(*placed)
+            overflowed = (
+                int(np.max(np.asarray(fill_l))) > cap_l
+                or int(np.max(np.asarray(fill_r))) > cap_r
             )
-            if (int(np.max(np.asarray(fill_l))) <= cap_l
-                    and int(np.max(np.asarray(fill_r))) <= cap_r):
-                mask = np.asarray(found) > 0
-                return (
-                    np.asarray(elk)[mask],
-                    np.asarray(elv)[mask],
-                    np.asarray(rv_m)[mask],
-                )
-            factor *= 2
-        raise RuntimeError(
-            f"join bucket overflow persisted after {MAX_OVERFLOW_RETRIES} "
-            "retries"
+            return (elk, elv, rv_m, found), overflowed
+
+        elk, elv, rv_m, found = self._retry_with_factor(attempt)
+        mask = np.asarray(found) > 0
+        return (
+            np.asarray(elk)[mask],
+            np.asarray(elv)[mask],
+            np.asarray(rv_m)[mask],
         )
 
 

@@ -55,13 +55,13 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
         # re-sort entirely (they would re-sort the same data)
         sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
         if valid is None:
-            k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+            k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=False)
             n_real = jnp.int32(n_local)
         else:
             inv = jnp.int32(1) - valid
             keys = jnp.where(valid > 0, keys, sentinel)
             k, _, v = jax.lax.sort(
-                (keys, inv, vals), num_keys=2, is_stable=True
+                (keys, inv, vals), num_keys=2, is_stable=False
             )
             n_real = jnp.sum(valid).astype(jnp.int32)
         pad = capacity - n_local
@@ -74,7 +74,7 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
         return k, v, n_valid, jnp.int32(n_local)
     if valid is None:
         # fast path: every input slot is real
-        k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=True)
+        k, v = jax.lax.sort((keys, vals), num_keys=1, is_stable=False)
         n_real = jnp.int32(n_local)
     else:
         # force invalid slots onto the dtype-max key, then the
@@ -89,7 +89,7 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
         keys = jnp.where(
             valid > 0, keys, jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
         )
-        k, _, v = jax.lax.sort((keys, inv, vals), num_keys=2, is_stable=True)
+        k, _, v = jax.lax.sort((keys, inv, vals), num_keys=2, is_stable=False)
         n_real = jnp.sum(valid).astype(jnp.int32)
     # exact local quantiles (k is sorted): positions i*n/S
     sample = k[(jnp.arange(sample_size) * n_local) // sample_size]
@@ -151,7 +151,7 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
     riv = (slot[None, :] >= rvalid[:, None]).astype(jnp.int32).reshape(-1)
     sorted_k, sorted_iv, sorted_v = jax.lax.sort(
         (rk.reshape(-1), riv, rv.reshape(-1)),
-        num_keys=2, is_stable=True,
+        num_keys=2, is_stable=False,
     )
     # overflow indicator: true pre-clamp counts, maxed over destinations
     overflow = jnp.max(counts).astype(jnp.int32)

@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sparkrdma_tpu.models._base import ExchangeModel
-from sparkrdma_tpu.ops.partition import hash_partition_ids, partition_to_buckets
+from sparkrdma_tpu.ops.exchange import hash_exchange
 from sparkrdma_tpu.ops.segment import reduce_by_key_local
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
@@ -34,35 +34,16 @@ def make_count_step(mesh: Mesh, n_local: int, capacity: int):
     spec = P(EXCHANGE_AXIS)
 
     def body(k, v, valid):  # local [n_local]
-        ids = hash_partition_ids(k, D)
-        # route invalid (padding) slots to this device's own bucket so
-        # they can't displace real records elsewhere; they carry valid=0
-        my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
-        ids = jnp.where(valid > 0, ids, my)
-        (bk, bv, bm), counts = partition_to_buckets(
-            ids, (k, v, valid), D, capacity,
-            fill_values=(
-                jnp.array(jnp.iinfo(k.dtype).max, k.dtype),
-                jnp.zeros((), v.dtype),
-                jnp.zeros((), jnp.int32),
-            ),
+        flat_k, flat_v, flat_m, max_fill = hash_exchange(
+            k, v, valid, D, capacity
         )
-        rk = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-        rv = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-        rm = jax.lax.all_to_all(bm, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
-        flat_k = rk.reshape(-1)
-        flat_v = rv.reshape(-1)
-        flat_m = rm.reshape(-1)
         # pre-mask for the reduction contract: invalid slots (bucket pads
         # and input padding) get the grouping key + zero value
         sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
         flat_k = jnp.where(flat_m > 0, flat_k, sentinel)
         flat_v = jnp.where(flat_m > 0, flat_v, jnp.zeros((), v.dtype))
         uniq, sums, _cnts, n_unique = reduce_by_key_local(flat_k, flat_v, flat_m)
-        # true counts of VALID records per destination (for overflow):
-        # invalid slots were routed to self, so they don't inflate others
-        overflow = jnp.max(counts).astype(jnp.int32)
-        return uniq, sums, n_unique[None], overflow[None]
+        return uniq, sums, n_unique[None], max_fill[None]
 
     mapped = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec),

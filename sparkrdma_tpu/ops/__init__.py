@@ -1,5 +1,6 @@
 """Device-side ops: partitioning, hashing, segment reductions, sort helpers."""
 
+from sparkrdma_tpu.ops.exchange import hash_exchange
 from sparkrdma_tpu.ops.partition import (
     hash_partition_ids,
     make_range_splitters,
@@ -12,4 +13,5 @@ __all__ = [
     "range_partition_ids",
     "make_range_splitters",
     "partition_to_buckets",
+    "hash_exchange",
 ]

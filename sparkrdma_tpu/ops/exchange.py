@@ -1,0 +1,57 @@
+"""The shared shard-level hash-exchange body.
+
+One canonical implementation of "hash-partition my local records and
+move every bucket to its owner" — the device-side analog of the
+reference's map-side partition + shuffle transfer, used by every
+hash-partitioned exchange model (wordcount's reduceByKey, the hash
+join's both sides).  Must run inside ``shard_map`` over the mesh's
+exchange axis.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sparkrdma_tpu.ops.partition import hash_partition_ids, partition_to_buckets
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+
+def hash_exchange(
+    keys: jax.Array,
+    vals: jax.Array,
+    valid: jax.Array,
+    n_devices: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Hash-partition local (keys, vals, valid) columns into n_devices
+    buckets of ``capacity`` and all_to_all them to their owners.
+
+    Padding (valid == 0) is routed to this device's own bucket so it can
+    never displace real records elsewhere; bucket fill slots carry
+    (dtype-max key, 0 value, 0 valid).
+
+    Returns (keys', vals', valid', max_fill): flat [D * capacity] local
+    columns of everything this device now owns, plus the max TRUE bucket
+    fill (> capacity signals overflow — caller retries bigger).
+    """
+    my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
+    ids = hash_partition_ids(keys, n_devices)
+    ids = jnp.where(valid > 0, ids, my)
+    (bk, bv, bm), counts = partition_to_buckets(
+        ids, (keys, vals, valid), n_devices, capacity,
+        fill_values=(
+            jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype),
+            jnp.zeros((), vals.dtype),
+            jnp.zeros((), jnp.int32),
+        ),
+    )
+    ek = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    ev = jax.lax.all_to_all(bv, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    em = jax.lax.all_to_all(bm, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    return (
+        ek.reshape(-1), ev.reshape(-1), em.reshape(-1),
+        jnp.max(counts).astype(jnp.int32),
+    )

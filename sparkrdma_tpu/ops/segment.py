@@ -42,7 +42,7 @@ def reduce_by_key_local(
     # push invalid slots to the very end so they merge into (at most) the
     # tail of the final run and never split a real run
     ks, ms, vs = jax.lax.sort(
-        (keys, jnp.int32(1) - m, vals), num_keys=2, is_stable=True
+        (keys, jnp.int32(1) - m, vals), num_keys=2, is_stable=False
     )
     ms = jnp.int32(1) - ms
     csum_v = jnp.cumsum(vs)
@@ -56,7 +56,7 @@ def reduce_by_key_local(
     sel_v = jnp.where(is_last, csum_v, jnp.zeros((), csum_v.dtype))
     sel_m = jnp.where(is_last, csum_m, jnp.zeros((), csum_m.dtype))
     uniq, _, ends_v, ends_m = jax.lax.sort(
-        (sel_key, tiebreak, sel_v, sel_m), num_keys=2, is_stable=True
+        (sel_key, tiebreak, sel_v, sel_m), num_keys=2, is_stable=False
     )
     n_runs = jnp.sum(is_last.astype(jnp.int32))
     slot = jnp.arange(n, dtype=jnp.int32)

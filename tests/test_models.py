@@ -158,3 +158,48 @@ def test_hash_join_skewed_overflow_retry(mesh, devices):
     k, lv, rv = j.join(fk, fv, dk, dv)
     assert len(k) == 10000  # every fact key exists in dim
     assert (rv == k * 3).all()
+
+
+@pytest.mark.parametrize("joiner_cls", ["hash", "broadcast"])
+def test_join_dtype_max_fact_key(joiner_cls, mesh, devices):
+    # reviewer finding: a fact key equal to iinfo.max must not match a
+    # sentinel-masked padding/fill slot (validity of the hit is checked)
+    from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+    imax = np.iinfo(np.int32).max
+    fk = np.array([1, 2, imax, 5], np.int32)
+    fv = np.array([10, 20, 30, 50], np.int32)
+    dk = np.array([1, 2, 3], np.int32)
+    dv = np.array([100, 200, 300], np.int32)
+    j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
+    k, lv, rv = j.join(fk, fv, dk, dv)
+    got = sorted(zip(k.tolist(), lv.tolist(), rv.tolist()))
+    assert got == [(1, 10, 100), (2, 20, 200)]
+
+
+@pytest.mark.parametrize("joiner_cls", ["hash", "broadcast"])
+def test_join_dtype_max_dim_key_matches(joiner_cls, mesh, devices):
+    # a REAL dim key equal to iinfo.max must still be matchable
+    from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+    imax = np.iinfo(np.int32).max
+    fk = np.array([imax, 7], np.int32)
+    fv = np.array([1, 2], np.int32)
+    dk = np.array([imax, 7], np.int32)
+    dv = np.array([111, 77], np.int32)
+    j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
+    k, lv, rv = j.join(fk, fv, dk, dv)
+    got = sorted(zip(k.tolist(), lv.tolist(), rv.tolist()))
+    assert got == [(7, 2, 77), (imax, 1, 111)]
+
+
+@pytest.mark.parametrize("joiner_cls", ["hash", "broadcast"])
+def test_join_empty_dimension(joiner_cls, mesh, devices):
+    # reviewer finding: empty dimension side -> empty result, not a crash
+    from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+    fk = np.array([1, 2, 3, 4], np.int32)
+    fv = np.array([10, 20, 30, 40], np.int32)
+    j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
+    k, lv, rv = j.join(fk, fv, np.array([], np.int32), np.array([], np.int32))
+    assert len(k) == 0 and len(lv) == 0 and len(rv) == 0

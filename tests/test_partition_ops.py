@@ -60,10 +60,12 @@ def test_partition_to_buckets_roundtrip():
         got_v = np.asarray(bv[p][:c])
         exp_k = np_k[np_ids == p]
         exp_v = np_v[np_ids == p]
-        np.testing.assert_array_equal(np.sort(got_k), np.sort(exp_k))
-        # stable bucketing preserves arrival order within a partition
-        np.testing.assert_array_equal(got_k, exp_k)
-        np.testing.assert_array_equal(got_v, exp_v)
+        # within-bucket order is NOT guaranteed (unstable grouping sort,
+        # matching Spark shuffle semantics) — but (key, val) pairs must
+        # stay aligned: compare as multisets of pairs
+        got = sorted(zip(got_k.tolist(), got_v.tolist()))
+        exp = sorted(zip(exp_k.tolist(), exp_v.tolist()))
+        assert got == exp
     # padding sorts last
     assert int(bk[0][-1]) == np.iinfo(np.int32).max or int(counts[0]) == cap
 
@@ -73,7 +75,11 @@ def test_partition_overflow_detected_not_corrupted():
     keys = jnp.arange(100, dtype=jnp.int32)
     (bk,), counts = partition_to_buckets(ids, (keys,), 4, capacity=32)
     assert int(counts[0]) == 100  # true count signals overflow
-    np.testing.assert_array_equal(np.asarray(bk[0]), np.arange(32))  # first 32 kept
+    # capacity elements kept, all real and distinct (WHICH ones is
+    # unspecified under the unstable grouping sort — the caller retries
+    # with a larger capacity on overflow and discards this result)
+    kept = np.asarray(bk[0])
+    assert len(np.unique(kept)) == 32 and kept.min() >= 0 and kept.max() < 100
     # other buckets untouched (all padding)
     assert int(np.asarray(bk[1]).min()) == np.iinfo(np.int32).max
 
@@ -103,9 +109,19 @@ def test_partition_multidim_values():
     np_ids = np.asarray(ids)
     for p in range(4):
         c = int(counts[p])
-        np.testing.assert_array_equal(
-            np.asarray(be[p][:c]), np.asarray(emb)[np_ids == p]
+        # rows must travel with their keys (order within bucket is
+        # unspecified): compare (key, row) pairs as sorted tuples
+        got = sorted(
+            (int(k), tuple(r))
+            for k, r in zip(np.asarray(bk[p][:c]), np.asarray(be[p][:c]))
         )
+        exp = sorted(
+            (int(k), tuple(r))
+            for k, r in zip(
+                np.asarray(keys)[np_ids == p], np.asarray(emb)[np_ids == p]
+            )
+        )
+        assert got == exp
 
 
 def test_partition_empty_input():
