@@ -194,6 +194,13 @@ class TpuShuffleConf:
     def exchange_dtype(self) -> str:
         return str(self.get("exchangeDtype", "uint8"))
 
+    @property
+    def verify_exchange_integrity(self) -> bool:
+        """Opt-in end-to-end CRC of every (src, dst) exchanged stream
+        (ExchangeIntegrityError on mismatch).  Costs O(payload) host
+        time; healthy ICI links already carry hardware CRC."""
+        return self._bool("verifyExchangeIntegrity", False)
+
     # -- observability ------------------------------------------------------
     @property
     def collect_shuffle_reader_stats(self) -> bool:

@@ -1,16 +1,33 @@
-"""Ring attention: sequence-parallel attention over the exchange ring.
+"""Sequence-parallel attention: ring and Ulysses (all-to-all) schedules.
 
 The long-context capability SURVEY.md §5 marks as first-class for the
-rebuild: sequences sharded over the mesh axis, K/V blocks circulating
-one ``ppermute`` hop per step (sparkrdma_tpu.parallel.ring), each chip
-folding one block into a flash-style online-softmax accumulator
-(running max + denominator), so attention over a sequence of length S
-costs O(S/D) resident memory per chip and every FLOP lands on the MXU
-as a [s_loc, d] × [d, s_blk] matmul.
+rebuild, in both canonical forms:
 
-Computation is numerically identical to full softmax attention (the
-online rescaling is exact, not an approximation); causal masking uses
-global positions derived from each block's source index.
+- :func:`ring_attention` — sequences sharded over the mesh axis, K/V
+  blocks circulating one ``ppermute`` hop per step
+  (sparkrdma_tpu.parallel.ring), each chip folding one block into a
+  flash-style online-softmax accumulator (running max + denominator).
+  Attention over sequence length S costs O(S/D) resident memory per
+  chip; every FLOP lands on the MXU as [s_loc, d] × [d, s_blk] matmuls.
+  Communication: D-1 neighbor hops of the K/V shard (bandwidth-optimal
+  on a ring ICI topology, overlappable with compute).
+
+- :func:`ulysses_attention` — the all-to-all schedule: one
+  ``all_to_all`` converts sequence sharding into *head* sharding (each
+  chip gets H/D full-length heads), full flash attention runs locally
+  per head, and a second ``all_to_all`` restores sequence sharding.
+  Communication: 2 all_to_alls of the activations, independent of S in
+  round count — the better schedule when H ≥ D and the interconnect
+  favors few large collectives (the same trade the reference's grouped
+  fetches vs per-block reads make, RdmaShuffleFetcherIterator.scala:214-240).
+
+Both are numerically identical to full softmax attention (the online
+rescaling is exact, not an approximation); causal masking uses global
+positions derived from each block's source index.
+
+Shapes: q/k/v are [S, d] or [..., S, d] with any leading batch/head
+dims; the sequence axis is sharded over the mesh, leading dims are
+replicated work per chip (ring) or redistributed (Ulysses).
 """
 
 from __future__ import annotations
@@ -29,12 +46,12 @@ from sparkrdma_tpu.parallel.ring import ring_shift
 
 
 @functools.lru_cache(maxsize=16)
-def _ring_attention_fn(mesh: Mesh, s_local: int, d_head: int, causal: bool,
-                       dtype_str: str, impl: Optional[str]):
+def _ring_attention_fn(mesh: Mesh, n_seqs: int, s_local: int, d_head: int,
+                       causal: bool, dtype_str: str, impl: Optional[str]):
     D = len(list(mesh.devices.flat))
-    spec = P(EXCHANGE_AXIS, None)
+    spec = P(None, EXCHANGE_AXIS, None)
 
-    def body(q_, k_, v_):  # local views: [s_local, d]
+    def body(q_, k_, v_):  # local views: [n_seqs, s_local, d]
         my = jax.lax.axis_index(EXCHANGE_AXIS)
         scale = 1.0 / np.sqrt(d_head)
 
@@ -42,19 +59,22 @@ def _ring_attention_fn(mesh: Mesh, s_local: int, d_head: int, causal: bool,
             m, l, o, cur_k, cur_v = carry
             src = (my - j) % D
             # hot op: blockwise flash partials, MXU via the Pallas
-            # kernel on TPU backends (ops/attention.py)
-            m_blk, l_blk, o_blk = block_attention(
-                q_, cur_k, cur_v,
-                q_offset=my * s_local, k_offset=src * s_local,
-                causal=causal, scale=scale, impl=impl,
-            )
+            # kernel on TPU backends (ops/attention.py); vmapped over
+            # the batch·head axis (pallas_call vmaps to a grid dim)
+            m_blk, l_blk, o_blk = jax.vmap(
+                lambda qq, kk, vv: block_attention(
+                    qq, kk, vv,
+                    q_offset=my * s_local, k_offset=src * s_local,
+                    causal=causal, scale=scale, impl=impl,
+                )
+            )(q_, cur_k, cur_v)
             # exact online-softmax fold: rows fully masked in this block
             # carry m_blk = NEG_INF, so beta = 0 kills their partials
             m_new = jnp.maximum(m, m_blk)
             alpha = jnp.exp(m - m_new)
             beta = jnp.exp(m_blk - m_new)
             l_new = l * alpha + l_blk * beta
-            o_new = o * alpha[:, None] + o_blk * beta[:, None]
+            o_new = o * alpha[..., None] + o_blk * beta[..., None]
             return (
                 m_new, l_new, o_new,
                 ring_shift(cur_k), ring_shift(cur_v),
@@ -64,15 +84,15 @@ def _ring_attention_fn(mesh: Mesh, s_local: int, d_head: int, causal: bool,
         # mesh-axis type as the loop outputs (shard_map typing rule);
         # accumulate in float32 regardless of input dtype
         q32 = q_.astype(jnp.float32)
-        m0 = jnp.full_like(q32[:, 0], NEG_INF)
-        l0 = jnp.zeros_like(q32[:, 0])
+        m0 = jnp.full_like(q32[..., 0], NEG_INF)
+        l0 = jnp.zeros_like(q32[..., 0])
         o0 = jnp.zeros_like(q32)
         (m, l, o, _, _), _ = jax.lax.scan(
             step, (m0, l0, o0, k_, v_), jnp.arange(D)
         )
         # guard fully-masked rows (l == 0 can only happen with causal=False
         # pathological inputs; causal row 0 always sees itself)
-        out = o / jnp.maximum(l, 1e-30)[:, None]
+        out = o / jnp.maximum(l, 1e-30)[..., None]
         return out.astype(q_.dtype)
 
     # check_vma=False: interpret-mode pallas_call bodies mix varying and
@@ -86,6 +106,77 @@ def _ring_attention_fn(mesh: Mesh, s_local: int, d_head: int, causal: bool,
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=16)
+def _ulysses_attention_fn(mesh: Mesh, n_heads: int, s_local: int, d_head: int,
+                          causal: bool, dtype_str: str, impl: Optional[str]):
+    D = len(list(mesh.devices.flat))
+    spec = P(None, EXCHANGE_AXIS, None)
+    scale = 1.0 / np.sqrt(d_head)
+
+    def body(q_, k_, v_):  # local views: [H, s_local, d]
+        # seq-sharded → head-sharded: split the head axis D ways, send
+        # group g to device g, concatenate received chunks along the
+        # sequence axis → [H/D, S, d] full-length heads
+        def to_heads(x):
+            # tiled: divide the head axis by D, multiply the sequence
+            # axis by D (tiled=False would *replace* the split axis)
+            return jax.lax.all_to_all(
+                x, EXCHANGE_AXIS, split_axis=0, concat_axis=1, tiled=True
+            )
+
+        qh, kh, vh = to_heads(q_), to_heads(k_), to_heads(v_)
+        # full flash attention per local head (the Pallas kernel grids
+        # over K blocks with an online-softmax accumulator, so one call
+        # IS flash attention over the whole sequence)
+        m, l, o = jax.vmap(
+            lambda qq, kk, vv: block_attention(
+                qq, kk, vv, q_offset=0, k_offset=0,
+                causal=causal, scale=scale, impl=impl,
+            )
+        )(qh, kh, vh)
+        out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q_.dtype)
+        # head-sharded → seq-sharded: inverse all_to_all
+        return jax.lax.all_to_all(
+            out, EXCHANGE_AXIS, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def _canonicalize(q, k, v, D):
+    """Flatten leading dims to one batch·head axis: [..., S, d] → [N, S, d]."""
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError("q, k, v must share a shape")
+    if q.ndim < 2:
+        raise ValueError(f"need [..., S, d_head], got {q.shape}")
+    lead = q.shape[:-2]
+    S, d_head = q.shape[-2], q.shape[-1]
+    if S % D:
+        raise ValueError(f"sequence length {S} not divisible by D={D}")
+    q3 = q.reshape((-1, S, d_head))
+    k3 = k.reshape((-1, S, d_head))
+    v3 = v.reshape((-1, S, d_head))
+    return q3, k3, v3, lead, S, d_head
+
+
+def _dispatch(make_fn, q, k, v, mesh, causal, impl):
+    """Shared tail of both schedules: canonicalize, build the cached
+    jitted step, shard inputs on the sequence axis, restore shape."""
+    mesh = mesh if mesh is not None else make_mesh()
+    D = len(list(mesh.devices.flat))
+    q3, k3, v3, lead, S, d_head = _canonicalize(q, k, v, D)
+    fn = make_fn(
+        mesh, q3.shape[0], S // D, d_head, causal, str(q.dtype), impl
+    )
+    sharding = NamedSharding(mesh, P(None, EXCHANGE_AXIS, None))
+    out = fn(*(jax.device_put(x, sharding) for x in (q3, k3, v3)))
+    return out.reshape(lead + (S, d_head))
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -94,24 +185,39 @@ def ring_attention(
     causal: bool = False,
     impl: Optional[str] = None,
 ) -> jax.Array:
-    """Exact attention over sequences sharded on the mesh axis.
+    """Exact attention over sequences sharded on the mesh axis, K/V
+    circulating the ring.
 
-    q/k/v: [S, d_head] global arrays (S divisible by D).  Returns
-    softmax(q kᵀ / √d) v, computed blockwise over the ring.
+    q/k/v: [S, d_head] or [..., S, d_head] (leading batch/head dims).
+    Returns softmax(q kᵀ / √d) v with the same shape as q.
 
     ``impl`` selects the per-block kernel: "pallas", "xla", or None =
     auto (pallas on TPU backends).
     """
-    mesh = mesh if mesh is not None else make_mesh()
-    D = len(list(mesh.devices.flat))
-    S, d_head = q.shape
-    if S % D:
-        raise ValueError(f"sequence length {S} not divisible by D={D}")
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError("q, k, v must share [S, d_head]")
-    fn = _ring_attention_fn(mesh, S // D, d_head, causal, str(q.dtype), impl)
-    sharding = NamedSharding(mesh, P(EXCHANGE_AXIS, None))
-    q = jax.device_put(q, sharding)
-    k = jax.device_put(k, sharding)
-    v = jax.device_put(v, sharding)
-    return fn(q, k, v)
+    return _dispatch(_ring_attention_fn, q, k, v, mesh, causal, impl)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    causal: bool = False,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Exact attention via the Ulysses (all-to-all head-parallel)
+    schedule: requires a head axis whose size is divisible by D.
+
+    q/k/v: [..., H, S, d_head] (leading batch dims allowed; the axis
+    immediately before S is treated as heads).  Returns the same shape.
+    """
+    mesh_ = mesh if mesh is not None else make_mesh()
+    D = len(list(mesh_.devices.flat))
+    n_heads = int(np.prod(q.shape[:-2])) if q.ndim > 2 else 1
+    if n_heads % D:
+        raise ValueError(
+            f"batch·head product {n_heads} not divisible by D={D} "
+            "(the Ulysses schedule shards heads; use ring_attention "
+            "when heads < devices)"
+        )
+    return _dispatch(_ulysses_attention_fn, q, k, v, mesh_, causal, impl)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import math
+import zlib
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -36,6 +37,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+from sparkrdma_tpu.transport.channel import TransportError
+
+
+class ExchangeIntegrityError(TransportError):
+    """A received stream failed its end-to-end checksum.
+
+    The collective analog of a CQ completion with error status
+    (RdmaChannel.java:611-615): a chip/link fault inside a collective
+    corrupts silently instead of failing a channel.  Subclasses
+    :class:`TransportError` so any layer that converts transport
+    failures to stage-retryable fetch failures (the reader's
+    FetchFailedError bridge) handles corruption the same way
+    (SURVEY.md §7 failure-semantics hard part).  Opt in via the
+    ``verify_integrity`` constructor flag, or
+    ``spark.shuffle.tpu.verifyExchangeIntegrity`` through
+    :meth:`TileExchange.from_conf` — the comparison costs O(payload)
+    host time, and healthy ICI links have hardware CRC."""
+
+    def __init__(self, src: int, dst: int, expected: int, got: int):
+        super().__init__(
+            f"stream {src}->{dst} corrupt: crc32 {got:#010x} != "
+            f"expected {expected:#010x}"
+        )
+        self.src = src
+        self.dst = dst
 
 # tiles are padded to lane multiples so uint8 rows lay out cleanly
 TILE_ALIGN = 128
@@ -121,16 +147,31 @@ class TileExchange:
         mesh: Optional[Mesh] = None,
         tile_bytes: int = 4 << 20,
         max_rounds_in_flight: int = 2,
+        verify_integrity: bool = False,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.devices = list(self.mesh.devices.flat)
         self.n_devices = len(self.devices)
         self.tile_bytes = int(tile_bytes)
         self.max_rounds_in_flight = max(1, int(max_rounds_in_flight))
+        self.verify_integrity = verify_integrity
         # stats (reader-stats analog for the collective plane)
         self.rounds_executed = 0
         self.payload_bytes_moved = 0
         self.padded_bytes_moved = 0
+        self.integrity_failures = 0
+
+    @classmethod
+    def from_conf(cls, conf, mesh: Optional[Mesh] = None) -> "TileExchange":
+        """Build from a :class:`TpuShuffleConf`: wires
+        ``exchangeTileBytes``, ``exchangeMaxRoundsInFlight``, and
+        ``verifyExchangeIntegrity``."""
+        return cls(
+            mesh,
+            tile_bytes=conf.exchange_tile_bytes,
+            max_rounds_in_flight=conf.exchange_max_rounds_in_flight,
+            verify_integrity=conf.verify_exchange_integrity,
+        )
 
     # -- planning -----------------------------------------------------------
     def plan(self, lengths: np.ndarray) -> ExchangePlan:
@@ -161,11 +202,14 @@ class TileExchange:
         fn, sharding = _a2a_fn(self.mesh, D, plan.tile_bytes, True)
         inflight: deque = deque()
 
+        filled_dsts = set()  # destinations addressable on THIS host
+
         def collect(done):
             # pull each destination's local shard and append its per-src
             # tile slices (on a pod each host pulls only its own shard)
             for shard in done.addressable_shards:
                 d = shard.index[0].start if shard.index[0].start is not None else 0
+                filled_dsts.add(d)
                 local = np.asarray(shard.data)[0]  # [D, tile]
                 for s in range(D):
                     out[d][s] += local[s].tobytes()
@@ -189,10 +233,38 @@ class TileExchange:
         self.payload_bytes_moved += plan.payload_bytes
         self.padded_bytes_moved += plan.moved_bytes
         # trim pair streams to their true lengths (drop tile padding)
-        return [
+        result = [
             [bytes(out[d][s][: int(lengths[s, d])]) for s in range(D)]
             for d in range(D)
         ]
+        if self.verify_integrity:
+            self._verify(streams, result, filled_dsts)
+        return result
+
+    def _verify(self, streams, result, filled_dsts) -> None:
+        """End-to-end integrity: a chip/link fault inside a collective
+        corrupts silently (no per-channel CQ error to observe), so
+        received streams are compared against what the source enqueued
+        and mismatches surface as retryable transport failures.  Direct
+        comparison beats hashing both sides (early exit, no
+        collisions); CRCs are computed only for the error message.
+        Scope: pairs whose source AND destination are addressable from
+        this process — for a cross-host pair neither endpoint holds
+        both byte strings (verifying those would need the CRC to ride
+        the exchange)."""
+        local_srcs = {
+            i for i, dev in enumerate(self.devices)
+            if dev.process_index == jax.process_index()
+        }
+        for d in sorted(filled_dsts):
+            for s in sorted(local_srcs):
+                if result[d][s] != streams[s][d]:
+                    self.integrity_failures += 1
+                    raise ExchangeIntegrityError(
+                        s, d,
+                        zlib.crc32(streams[s][d]),
+                        zlib.crc32(result[d][s]),
+                    )
 
     # -- on-device exchange (arrays already in HBM) -------------------------
     def a2a(self, x: jax.Array, donate: bool = False) -> jax.Array:
@@ -216,4 +288,5 @@ class TileExchange:
             "rounds_executed": self.rounds_executed,
             "payload_bytes_moved": self.payload_bytes_moved,
             "padded_bytes_moved": self.padded_bytes_moved,
+            "integrity_failures": self.integrity_failures,
         }

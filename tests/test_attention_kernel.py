@@ -55,3 +55,27 @@ def test_ring_attention_exact(impl, causal, devices):
     expected = reference_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out), expected,
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ring_attention_bfloat16(impl, devices):
+    # bf16 inputs ride the MXU's native path (no f32 up-cast in the
+    # kernel); accumulate in f32, so accuracy stays bf16-input-bounded
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(2)
+    S, d = 8 * 32, 64
+    q = rng.standard_normal((S, d), dtype=np.float32)
+    k = rng.standard_normal((S, d), dtype=np.float32)
+    v = rng.standard_normal((S, d), dtype=np.float32)
+    qb = jnp.asarray(q, jnp.bfloat16)
+    kb = jnp.asarray(k, jnp.bfloat16)
+    vb = jnp.asarray(v, jnp.bfloat16)
+    out = ring_attention(qb, kb, vb, mesh=mesh, causal=True, impl=impl)
+    assert out.dtype == jnp.bfloat16
+    expected = reference_attention(
+        np.asarray(qb, np.float32), np.asarray(kb, np.float32),
+        np.asarray(vb, np.float32), causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), expected, rtol=0.06, atol=0.06
+    )

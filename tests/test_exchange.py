@@ -110,3 +110,58 @@ def test_a2a_device_resident(mesh, devices):
     x = rng.integers(0, 256, size=(D, D, 256), dtype=np.uint8)
     y = np.asarray(ex.a2a(jnp.asarray(x)))
     np.testing.assert_array_equal(y, x.swapaxes(0, 1))
+
+
+def test_exchange_integrity_ok_and_stats(mesh, devices):
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+
+    ex = TileExchange(mesh, tile_bytes=512, verify_integrity=True)
+    D = ex.n_devices
+    rng = np.random.default_rng(8)
+    streams = [
+        [rng.bytes(rng.integers(0, 2000)) for _ in range(D)]
+        for _ in range(D)
+    ]
+    out = ex.exchange_bytes(streams)
+    for d in range(D):
+        for s in range(D):
+            assert out[d][s] == streams[s][d]
+    assert ex.stats()["integrity_failures"] == 0
+
+
+def test_exchange_integrity_detects_corruption(mesh, devices):
+    from sparkrdma_tpu.parallel.exchange import (
+        ExchangeIntegrityError,
+        TileExchange,
+    )
+
+    ex = TileExchange(mesh, tile_bytes=256, verify_integrity=True)
+    D = ex.n_devices
+    streams = [[bytes([s * D + d]) * 100 for d in range(D)] for s in range(D)]
+    # what a healthy exchange delivers, then flip one byte in one stream
+    received = [[bytearray(streams[s][d]) for s in range(D)] for d in range(D)]
+    received[2][1][50] ^= 0xFF
+    corrupted = [[bytes(b) for b in row] for row in received]
+    with pytest.raises(ExchangeIntegrityError) as ei:
+        ex._verify(streams, corrupted, set(range(D)))
+    assert ex.stats()["integrity_failures"] == 1
+    assert "1->2" in str(ei.value) and "crc32" in str(ei.value)
+    assert ei.value.src == 1 and ei.value.dst == 2
+
+
+def test_exchange_from_conf(mesh, devices):
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.exchangeTileBytes": "128k",
+        "spark.shuffle.tpu.exchangeMaxRoundsInFlight": "4",
+        "spark.shuffle.tpu.verifyExchangeIntegrity": "true",
+    })
+    ex = TileExchange.from_conf(conf, mesh)
+    assert ex.tile_bytes == 128 << 10
+    assert ex.max_rounds_in_flight == 4
+    assert ex.verify_integrity is True
+    # and the conf default leaves verification off (opt-in knob)
+    ex2 = TileExchange.from_conf(TpuShuffleConf(), mesh)
+    assert ex2.verify_integrity is False

@@ -90,3 +90,89 @@ def test_ring_reduce_caches_compilation(mesh, devices):
     b = ring.ring_reduce(x + 1, init_fn, consume)
     assert _ring_reduce_fn.cache_info().hits == before + 1
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b) - 8)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_multihead(mesh, devices, causal):
+    # [B, H, S, d] leading dims: each (b, h) attends independently
+    rng = np.random.default_rng(2)
+    B, H, S, d = 2, 4, 64, 16
+    q = rng.standard_normal((B, H, S, d)).astype(np.float32)
+    k = rng.standard_normal((B, H, S, d)).astype(np.float32)
+    v = rng.standard_normal((B, H, S, d)).astype(np.float32)
+    out = np.asarray(
+        ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                       mesh=mesh, causal=causal)
+    )
+    assert out.shape == (B, H, S, d)
+    for b in range(B):
+        for h in range(H):
+            expect = reference_attention(q[b, h], k[b, h], v[b, h], causal)
+            np.testing.assert_allclose(
+                out[b, h], expect, rtol=2e-4, atol=2e-5
+            )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(mesh, devices, causal):
+    from sparkrdma_tpu.models.ring_attention import ulysses_attention
+
+    rng = np.random.default_rng(3)
+    H, S, d = 8, 64, 16  # H == D: one head per device after the a2a
+    q = rng.standard_normal((H, S, d)).astype(np.float32)
+    k = rng.standard_normal((H, S, d)).astype(np.float32)
+    v = rng.standard_normal((H, S, d)).astype(np.float32)
+    out = np.asarray(
+        ulysses_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          mesh=mesh, causal=causal)
+    )
+    assert out.shape == (H, S, d)
+    for h in range(H):
+        expect = reference_attention(q[h], k[h], v[h], causal)
+        np.testing.assert_allclose(out[h], expect, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_attention_batched_heads(mesh, devices):
+    # [B, H, S, d] with B*H divisible by D
+    from sparkrdma_tpu.models.ring_attention import ulysses_attention
+
+    rng = np.random.default_rng(4)
+    B, H, S, d = 2, 4, 64, 16
+    q = rng.standard_normal((B, H, S, d)).astype(np.float32)
+    k = rng.standard_normal((B, H, S, d)).astype(np.float32)
+    v = rng.standard_normal((B, H, S, d)).astype(np.float32)
+    out = np.asarray(
+        ulysses_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          mesh=mesh, causal=True)
+    )
+    for b in range(B):
+        for h in range(H):
+            expect = reference_attention(q[b, h], k[b, h], v[b, h], True)
+            np.testing.assert_allclose(
+                out[b, h], expect, rtol=2e-4, atol=2e-5
+            )
+
+
+def test_ulysses_attention_head_validation(mesh, devices):
+    from sparkrdma_tpu.models.ring_attention import ulysses_attention
+
+    q = jnp.zeros((3, 64, 8), jnp.float32)  # 3 heads not divisible by 8
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(q, q, q, mesh=mesh)
+
+
+def test_ring_ulysses_agree(mesh, devices):
+    from sparkrdma_tpu.models.ring_attention import ulysses_attention
+
+    rng = np.random.default_rng(5)
+    H, S, d = 8, 64, 16
+    q = rng.standard_normal((H, S, d)).astype(np.float32)
+    k = rng.standard_normal((H, S, d)).astype(np.float32)
+    v = rng.standard_normal((H, S, d)).astype(np.float32)
+    a = np.asarray(ring_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh, causal=True
+    ))
+    b = np.asarray(ulysses_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh, causal=True
+    ))
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
