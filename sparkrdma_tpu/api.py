@@ -103,6 +103,21 @@ class TpuShuffleContext:
 
         return WordCounter(mesh).count(keys, vals)
 
+    def device_aggregate(self, keys, vals, mesh=None):
+        """aggregateByKey (sum/count/min/max/mean) on the device mesh."""
+        from sparkrdma_tpu.models.aggregate import KeyedAggregator
+
+        return KeyedAggregator(mesh).aggregate(keys, vals)
+
+    def device_join(self, fact_keys, fact_vals, dim_keys, dim_vals,
+                    broadcast: bool = False, mesh=None):
+        """Inner equi-join on the device mesh: exchange (hash) or
+        broadcast schedule."""
+        from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+        joiner = (BroadcastJoiner if broadcast else HashJoiner)(mesh)
+        return joiner.join(fact_keys, fact_vals, dim_keys, dim_vals)
+
     # -- task running -------------------------------------------------------
     def _run_tasks(self, tasks: Sequence[Tuple[int, Callable[[], Any]]]) -> List[Any]:
         """Run (executor_index, thunk) tasks on their executors' pools."""

@@ -4,6 +4,7 @@ as single XLA programs over the exchange mesh — partition, all_to_all,
 and reduce/sort fused into one jitted SPMD step instead of a CPU
 serializer + NIC pull loop."""
 
+from sparkrdma_tpu.models.aggregate import KeyedAggregator, KeyStats
 from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
 from sparkrdma_tpu.models.ring_attention import ring_attention, ulysses_attention
 from sparkrdma_tpu.models.terasort import TeraSorter, make_sort_step
@@ -12,4 +13,5 @@ from sparkrdma_tpu.models.wordcount import WordCounter, make_count_step
 __all__ = [
     "TeraSorter", "make_sort_step", "WordCounter", "make_count_step",
     "HashJoiner", "BroadcastJoiner", "ring_attention", "ulysses_attention",
+    "KeyedAggregator", "KeyStats",
 ]

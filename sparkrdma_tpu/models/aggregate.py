@@ -1,0 +1,110 @@
+"""Device-native keyed aggregation: the combineByKey workload.
+
+Generalizes WordCount's reduceByKey(+) to the full aggregation family —
+sum, count, min, max, mean per key — as one SPMD program: hash exchange
+(ops/exchange.py) followed by the one-pass segment aggregation
+(ops/segment.py aggregate_by_key_local).  The device analog of Spark's
+Aggregator running during the read path
+(RdmaShuffleReader.scala:82-97); the record-plane equivalent lives in
+shuffle/reader.py (arbitrary Python combiners), this one trades
+generality for MXU/VPU-rate throughput on numeric columns.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkrdma_tpu.models._base import ExchangeModel
+from sparkrdma_tpu.ops.exchange import hash_exchange
+from sparkrdma_tpu.ops.segment import aggregate_by_key_local
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+
+class KeyStats(NamedTuple):
+    """Per-key aggregates (mean derived host-side: sum / count)."""
+
+    sum: int
+    count: int
+    min: int
+    max: int
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count
+
+
+@functools.lru_cache(maxsize=16)
+def make_aggregate_step(mesh: Mesh, n_local: int, capacity: int):
+    """Jitted aggregateByKey step over global [D*n_local] columns
+    sharded on the mesh axis."""
+    D = len(list(mesh.devices.flat))
+    spec = P(EXCHANGE_AXIS)
+
+    def body(k, v, valid):  # local [n_local]
+        flat_k, flat_v, flat_m, max_fill = hash_exchange(
+            k, v, valid, D, capacity
+        )
+        sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
+        flat_k = jnp.where(flat_m > 0, flat_k, sentinel)
+        flat_v = jnp.where(flat_m > 0, flat_v, jnp.zeros((), v.dtype))
+        uniq, sums, counts, mins, maxs, n_unique = aggregate_by_key_local(
+            flat_k, flat_v, flat_m
+        )
+        return uniq, sums, counts, mins, maxs, n_unique[None], max_fill[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec,) * 3, out_specs=(spec,) * 7
+    )
+    return jax.jit(mapped)
+
+
+class KeyedAggregator(ExchangeModel):
+    """Host-facing aggregateByKey: returns {key: KeyStats}."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, capacity_factor: float = 2.0):
+        super().__init__(mesh, capacity_factor)
+
+    def aggregate(self, keys, vals) -> Dict[int, KeyStats]:
+        keys = np.asarray(keys)
+        vals = np.asarray(vals)
+        if keys.shape != vals.shape or keys.ndim != 1:
+            raise ValueError("keys/vals must be equal-length 1-D arrays")
+        n = keys.shape[0]
+        if n == 0:
+            return {}
+        D = self.n_devices
+        n_pad = (-n) % D
+        valid = np.ones(n + n_pad, np.int32)
+        if n_pad:
+            keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])
+            vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
+            valid[n:] = 0
+        jk, jv, jval = jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid)
+
+        def run(cap):
+            step = make_aggregate_step(self.mesh, (n + n_pad) // D, cap)
+            uniq, sums, counts, mins, maxs, n_unique, max_fill = step(
+                *(jax.device_put(x, self.sharding) for x in (jk, jv, jval))
+            )
+            return (uniq, sums, counts, mins, maxs, n_unique), max_fill
+
+        uniq, sums, counts, mins, maxs, n_unique = (
+            self._run_with_overflow_retry(n + n_pad, run)
+        )
+        uniq_h = np.asarray(uniq).reshape(D, -1)
+        stats = [np.asarray(a).reshape(D, -1) for a in (sums, counts, mins, maxs)]
+        nu = np.asarray(n_unique).reshape(-1)
+        out: Dict[int, KeyStats] = {}
+        for d in range(D):
+            for i in range(nu[d]):
+                out[int(uniq_h[d, i])] = KeyStats(
+                    int(stats[0][d, i]), int(stats[1][d, i]),
+                    int(stats[2][d, i]), int(stats[3][d, i]),
+                )
+        return out

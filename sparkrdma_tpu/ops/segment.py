@@ -72,3 +72,61 @@ def reduce_by_key_local(
     # final run thanks to the validity tiebreak in the first sort)
     n_unique = jnp.sum(real.astype(jnp.int32))
     return uniq, sums, counts, n_unique
+
+
+def aggregate_by_key_local(
+    keys: jax.Array, vals: jax.Array, valid: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Full keyed aggregation over one device's elements: sum, count,
+    min, and max per distinct key in one pass (the device-side
+    combineByKey; Spark's Aggregator on the read path,
+    RdmaShuffleReader.scala:82-97).
+
+    Same masking contract as :func:`reduce_by_key_local` (invalid slots
+    pre-masked to key = dtype max, value = 0, valid = 0).
+
+    Returns (unique_keys, sums, counts, mins, maxs, n_unique); min/max
+    slots for padding runs carry zeros.
+    """
+    n = keys.shape[0]
+    sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
+    m = valid.astype(jnp.int32)
+    inv = jnp.int32(1) - m
+    # values join the SORT KEY (num_keys=3): within a run, valid slots
+    # come first ordered ascending by value, so a run's min is its first
+    # slot and its max is its (count_valid - 1)th — extracted by gather
+    # instead of a segmented scan (min/max have no invertible prefix
+    # trick like the sum's cumsum-difference)
+    ks, ms, vs = jax.lax.sort((keys, inv, vals), num_keys=3, is_stable=False)
+    ms = jnp.int32(1) - ms
+    csum_v = jnp.cumsum(vs)
+    csum_m = jnp.cumsum(ms)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    is_last = jnp.concatenate([ks[1:] != ks[:-1], jnp.ones(1, bool)])
+    sel_key = jnp.where(is_last, ks, sentinel)
+    tiebreak = jnp.where(is_last, jnp.int32(0), jnp.int32(1))
+    sel_v = jnp.where(is_last, csum_v, jnp.zeros((), csum_v.dtype))
+    sel_m = jnp.where(is_last, csum_m, jnp.zeros((), csum_m.dtype))
+    sel_idx = jnp.where(is_last, iota, jnp.int32(0))
+    uniq, _, ends_v, ends_m, ends_idx = jax.lax.sort(
+        (sel_key, tiebreak, sel_v, sel_m, sel_idx), num_keys=2,
+        is_stable=False,
+    )
+    n_runs = jnp.sum(is_last.astype(jnp.int32))
+    slot = jnp.arange(n, dtype=jnp.int32)
+    in_runs = slot < n_runs
+    prev_v = jnp.concatenate([jnp.zeros(1, ends_v.dtype), ends_v[:-1]])
+    prev_m = jnp.concatenate([jnp.zeros(1, ends_m.dtype), ends_m[:-1]])
+    prev_idx = jnp.concatenate([
+        jnp.full((1,), -1, ends_idx.dtype), ends_idx[:-1]
+    ])
+    counts = jnp.where(in_runs, ends_m - prev_m, 0).astype(jnp.int32)
+    real = counts > 0
+    sums = jnp.where(real, ends_v - prev_v, 0).astype(vals.dtype)
+    starts = jnp.clip(prev_idx + 1, 0, n - 1)
+    mins = jnp.where(real, vs[starts], 0).astype(vals.dtype)
+    last_valid = jnp.clip(starts + counts - 1, 0, n - 1)
+    maxs = jnp.where(real, vs[last_valid], 0).astype(vals.dtype)
+    uniq = jnp.where(real, uniq, sentinel)
+    n_unique = jnp.sum(real.astype(jnp.int32))
+    return uniq, sums, counts, mins, maxs, n_unique

@@ -62,6 +62,8 @@ class ExchangeIntegrityError(TransportError):
         )
         self.src = src
         self.dst = dst
+        self.expected = expected
+        self.got = got
 
 # tiles are padded to lane multiples so uint8 rows lay out cleanly
 TILE_ALIGN = 128

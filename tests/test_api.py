@@ -3,6 +3,7 @@ groupByKey / reduceByKey / sortByKey jobs end-to-end)."""
 
 from collections import defaultdict
 
+import numpy as np
 import pytest
 
 from sparkrdma_tpu.api import TpuShuffleContext
@@ -71,11 +72,30 @@ def test_join(ctx):
 
 
 def test_device_workloads_via_context(ctx):
-    import numpy as np
-
     rng = np.random.default_rng(0)
     keys = rng.integers(0, 1 << 20, size=4096, dtype=np.int32)
     sk, _ = ctx.device_sort(keys, keys)
     assert (np.diff(sk) >= 0).all()
     counts = ctx.device_count((keys % 13).astype(np.int32))
     assert sum(counts.values()) == len(keys)
+
+
+def test_device_aggregate_and_join_via_context(ctx, devices):
+    rng = np.random.default_rng(21)
+    keys = rng.integers(0, 40, 3000).astype(np.int32)
+    vals = rng.integers(-50, 50, 3000).astype(np.int32)
+    out = ctx.device_aggregate(keys, vals)
+    for k in np.unique(keys):
+        sel = vals[keys == k]
+        assert out[int(k)].sum == int(sel.sum())
+        assert out[int(k)].max == int(sel.max())
+
+    dk = np.arange(100, dtype=np.int32)
+    dv = dk * 2
+    fk = rng.integers(0, 200, 500).astype(np.int32)
+    fv = rng.integers(0, 9, 500).astype(np.int32)
+    for broadcast in (False, True):
+        jk, jfv, jdv = ctx.device_join(fk, fv, dk, dv, broadcast=broadcast)
+        m = fk < 100
+        assert len(jk) == m.sum()
+        assert (jdv == jk * 2).all()

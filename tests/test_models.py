@@ -203,3 +203,48 @@ def test_join_empty_dimension(joiner_cls, mesh, devices):
     j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
     k, lv, rv = j.join(fk, fv, np.array([], np.int32), np.array([], np.int32))
     assert len(k) == 0 and len(lv) == 0 and len(rv) == 0
+
+
+def test_keyed_aggregator_full_stats(mesh, devices):
+    from sparkrdma_tpu.models.aggregate import KeyedAggregator
+
+    rng = np.random.default_rng(12)
+    n = 20000
+    keys = rng.integers(0, 300, n).astype(np.int32)
+    vals = rng.integers(-1000, 1000, n).astype(np.int32)
+    agg = KeyedAggregator(mesh)
+    out = agg.aggregate(keys, vals)
+    assert set(out) == set(np.unique(keys).tolist())
+    for k in np.unique(keys):
+        sel = vals[keys == k]
+        st = out[int(k)]
+        assert st.sum == int(sel.sum())
+        assert st.count == len(sel)
+        assert st.min == int(sel.min())
+        assert st.max == int(sel.max())
+        assert abs(st.mean - sel.mean()) < 1e-9
+
+
+def test_keyed_aggregator_sentinel_key_and_padding(mesh, devices):
+    from sparkrdma_tpu.models.aggregate import KeyedAggregator
+
+    imax = np.iinfo(np.int32).max
+    # a real key equal to the sentinel, with a size forcing padding
+    keys = np.array([imax, 5, imax, 5, imax], np.int32)
+    vals = np.array([7, -2, 3, 4, -9], np.int32)
+    out = KeyedAggregator(mesh).aggregate(keys, vals)
+    assert out[imax] == (1, 3, -9, 7)
+    assert out[5] == (2, 2, -2, 4)
+
+
+def test_keyed_aggregator_skew_retry(mesh, devices):
+    from sparkrdma_tpu.models.aggregate import KeyedAggregator
+
+    rng = np.random.default_rng(13)
+    hot = np.full(9000, 17, np.int32)
+    cold = rng.integers(0, 50, 1000).astype(np.int32)
+    keys = np.concatenate([hot, cold])
+    vals = np.arange(10000, dtype=np.int32)
+    out = KeyedAggregator(mesh, capacity_factor=1.1).aggregate(keys, vals)
+    sel = vals[keys == 17]
+    assert out[17] == (int(sel.sum()), len(sel), int(sel.min()), int(sel.max()))
