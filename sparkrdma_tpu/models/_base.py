@@ -65,3 +65,46 @@ class ExchangeModel:
             return outputs, int(np.max(np.asarray(max_fill))) > cap
 
         return self._retry_with_factor(attempt)
+
+    def _run_padded_keyed(self, keys, vals, make_step):
+        """Shared host driver for keyed-exchange models (wordcount,
+        aggregate): pad columns to a multiple of D with a validity
+        column, place them on the mesh ONCE, run
+        ``make_step(mesh, n_local, capacity)`` under the overflow-retry
+        policy, and hand back per-device host rows.
+
+        The step must return ``(*row_arrays, n_unique[1], max_fill[1])``
+        per device.  Returns ``(rows, nu)``: each of ``rows`` reshaped
+        to [D, -1] on the host, ``nu`` the int32[D] valid-row counts.
+        """
+        import jax.numpy as jnp
+
+        keys = np.asarray(keys)
+        vals = np.asarray(vals)
+        if keys.shape != vals.shape or keys.ndim != 1:
+            raise ValueError("keys/vals must be equal-length 1-D arrays")
+        n = keys.shape[0]
+        if n == 0:
+            return None, None
+        D = self.n_devices
+        n_pad = (-n) % D
+        valid = np.ones(n + n_pad, np.int32)
+        if n_pad:
+            keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])
+            vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
+            valid[n:] = 0
+        # place once: only the capacity changes between overflow retries
+        placed = tuple(
+            jax.device_put(jnp.asarray(x), self.sharding)
+            for x in (keys, vals, valid)
+        )
+
+        def run(cap):
+            step = make_step(self.mesh, (n + n_pad) // D, cap)
+            *rows, n_unique, max_fill = step(*placed)
+            return (rows, n_unique), max_fill
+
+        rows, n_unique = self._run_with_overflow_retry(n + n_pad, run)
+        host_rows = [np.asarray(r).reshape(D, -1) for r in rows]
+        nu = np.asarray(n_unique).reshape(-1)
+        return host_rows, nu

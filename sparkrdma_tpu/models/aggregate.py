@@ -71,40 +71,26 @@ class KeyedAggregator(ExchangeModel):
         super().__init__(mesh, capacity_factor)
 
     def aggregate(self, keys, vals) -> Dict[int, KeyStats]:
+        """Sums accumulate in the value dtype and wrap on overflow (JVM
+        Int/Long parity).  For wide sums pass int64 values with
+        ``jax_enable_x64`` on; without it int64 inputs would silently
+        truncate, so that combination is rejected."""
         keys = np.asarray(keys)
         vals = np.asarray(vals)
-        if keys.shape != vals.shape or keys.ndim != 1:
-            raise ValueError("keys/vals must be equal-length 1-D arrays")
-        n = keys.shape[0]
-        if n == 0:
-            return {}
-        D = self.n_devices
-        n_pad = (-n) % D
-        valid = np.ones(n + n_pad, np.int32)
-        if n_pad:
-            keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])
-            vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
-            valid[n:] = 0
-        jk, jv, jval = jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid)
-
-        def run(cap):
-            step = make_aggregate_step(self.mesh, (n + n_pad) // D, cap)
-            uniq, sums, counts, mins, maxs, n_unique, max_fill = step(
-                *(jax.device_put(x, self.sharding) for x in (jk, jv, jval))
+        if vals.dtype == np.int64 and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "int64 values require jax_enable_x64 (without it JAX "
+                "silently truncates to int32, corrupting sums)"
             )
-            return (uniq, sums, counts, mins, maxs, n_unique), max_fill
-
-        uniq, sums, counts, mins, maxs, n_unique = (
-            self._run_with_overflow_retry(n + n_pad, run)
-        )
-        uniq_h = np.asarray(uniq).reshape(D, -1)
-        stats = [np.asarray(a).reshape(D, -1) for a in (sums, counts, mins, maxs)]
-        nu = np.asarray(n_unique).reshape(-1)
+        rows, nu = self._run_padded_keyed(keys, vals, make_aggregate_step)
+        if rows is None:
+            return {}
+        uniq_h, sums_h, counts_h, mins_h, maxs_h = rows
         out: Dict[int, KeyStats] = {}
-        for d in range(D):
+        for d in range(self.n_devices):
             for i in range(nu[d]):
                 out[int(uniq_h[d, i])] = KeyStats(
-                    int(stats[0][d, i]), int(stats[1][d, i]),
-                    int(stats[2][d, i]), int(stats[3][d, i]),
+                    int(sums_h[d, i]), int(counts_h[d, i]),
+                    int(mins_h[d, i]), int(maxs_h[d, i]),
                 )
         return out

@@ -75,36 +75,16 @@ class WordCounter(ExchangeModel):
         return step(keys, vals, valid), cap
 
     def count(self, keys, vals=None) -> Dict[int, int]:
+        """Totals wrap in the value dtype on overflow (JVM Int/Long
+        parity — Spark's reduceByKey(_+_) over Int wraps identically)."""
         keys = np.asarray(keys)
-        vals = (
-            np.ones_like(keys) if vals is None else np.asarray(vals)
-        )
-        n = keys.shape[0]
-        if n == 0:
+        vals = np.ones_like(keys) if vals is None else np.asarray(vals)
+        rows, nu = self._run_padded_keyed(keys, vals, make_count_step)
+        if rows is None:
             return {}
-        D = self.n_devices
-        n_pad = (-n) % D
-        valid = np.ones(n + n_pad, np.int32)
-        if n_pad:
-            keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])
-            vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
-            valid[n:] = 0
-        jk, jv, jval = jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid)
-
-        def run(cap):
-            (uniq, sums, n_unique, max_fill), _ = self.count_device(
-                jk, jv, jval, capacity=cap
-            )
-            return (uniq, sums, n_unique), max_fill
-
-        uniq, sums, n_unique = self._run_with_overflow_retry(
-            n + n_pad, run
-        )
-        uniq_h = np.asarray(uniq).reshape(D, -1)
-        sums_h = np.asarray(sums).reshape(D, -1)
-        nu = np.asarray(n_unique).reshape(-1)
+        uniq_h, sums_h = rows
         out: Dict[int, int] = {}
-        for d in range(D):
+        for d in range(self.n_devices):
             for k, s in zip(uniq_h[d, : nu[d]], sums_h[d, : nu[d]]):
                 out[int(k)] = int(s)
         return out

@@ -85,6 +85,11 @@ def aggregate_by_key_local(
     Same masking contract as :func:`reduce_by_key_local` (invalid slots
     pre-masked to key = dtype max, value = 0, valid = 0).
 
+    Sums accumulate in the value dtype and wrap on overflow — the JVM
+    Int/Long semantics Spark's reduceByKey(_+_) has.  (Widening to
+    int64 on TPU requires the global ``jax_enable_x64`` flag; callers
+    wanting wide sums pass int64 columns with that flag on.)
+
     Returns (unique_keys, sums, counts, mins, maxs, n_unique); min/max
     slots for padding runs carry zeros.
     """

@@ -1,0 +1,91 @@
+"""Worker for the 2-process multi-controller test (test_multihost.py).
+
+Run as: python multihost_worker.py <process_id> <coordinator_port>
+
+Validates the DCN-analog path on two CPU processes: rendezvous via
+``multihost.initialize``, a global 8-device mesh spanning both
+processes, a psum and a tiled all_to_all (the shuffle collective)
+crossing the process boundary.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from sparkrdma_tpu.parallel import multihost
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert multihost.is_multihost()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = multihost.global_mesh()
+    D = len(list(mesh.devices.flat))
+    assert D == 8, D
+    local = multihost.host_local_indices(mesh)
+    assert len(local) == 4, local
+    sharding = NamedSharding(mesh, P(EXCHANGE_AXIS))
+
+    # cross-process psum: every shard sees the global total
+    def body(x):
+        return jnp.full_like(x, jax.lax.psum(jnp.sum(x), EXCHANGE_AXIS))
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(EXCHANGE_AXIS),
+                              out_specs=P(EXCHANGE_AXIS)))
+    arr = jax.make_array_from_process_local_data(
+        sharding, np.ones(D * 4, np.int32) * (pid + 1), (D * 4,)
+    )
+    out = f(arr)
+    for s in out.addressable_shards:
+        got = int(np.asarray(s.data)[0])
+        assert got == 16 * 1 + 16 * 2, got
+
+    # cross-process all_to_all: the shuffle exchange collective.
+    # x[src, dst] = src * D + dst; after the exchange each device d
+    # holds row d of every source
+    def a2a(x):  # local [1, D]
+        y = jax.lax.all_to_all(
+            x, EXCHANGE_AXIS, split_axis=1, concat_axis=0, tiled=True
+        )
+        return y  # [D, 1]
+
+    g = jax.jit(jax.shard_map(
+        a2a, mesh=mesh, in_specs=P(EXCHANGE_AXIS, None),
+        out_specs=P(None, EXCHANGE_AXIS),
+    ))
+    mat = np.arange(D * D, dtype=np.int32).reshape(D, D)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(EXCHANGE_AXIS, None)),
+        mat[np.array(local)], (D, D),
+    )
+    got = g(garr)
+    for s in got.addressable_shards:
+        d = s.index[1].start
+        col = np.asarray(s.data).reshape(-1)
+        expect = mat[:, d]
+        assert (col == expect).all(), (d, col, expect)
+
+    print(f"proc {pid}: multihost collectives OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

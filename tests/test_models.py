@@ -248,3 +248,15 @@ def test_keyed_aggregator_skew_retry(mesh, devices):
     out = KeyedAggregator(mesh, capacity_factor=1.1).aggregate(keys, vals)
     sel = vals[keys == 17]
     assert out[17] == (int(sel.sum()), len(sel), int(sel.min()), int(sel.max()))
+
+
+def test_keyed_aggregator_rejects_silent_int64_truncation(mesh, devices):
+    from sparkrdma_tpu.models.aggregate import KeyedAggregator
+    import jax as _jax
+
+    if _jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled: int64 is exact, nothing to reject")
+    keys = np.zeros(8, np.int32)
+    vals = np.full(8, 2**40, np.int64)
+    with pytest.raises(ValueError, match="int64"):
+        KeyedAggregator(mesh).aggregate(keys, vals)
