@@ -145,6 +145,30 @@ class TpuShuffleConf:
         demand at exchange time, instead of eagerly at commit."""
         return self._bool("lazyStaging", False)
 
+    @property
+    def shuffle_spill_record_threshold(self) -> int:
+        """Writer spill trigger: when a map task holds this many
+        buffered records, serialize current buckets to a spill file and
+        release the memory (the role Spark's sort-shuffle spill plays
+        inside the writers the reference wraps,
+        RdmaWrapperShuffleWriter.scala:85-101).  0 disables spilling."""
+        return self._int_in_range("shuffleSpillRecordThreshold", 0, 0, 1 << 31)
+
+    @property
+    def spill_dir(self) -> str:
+        """Directory for writer spill files and file-backed commits."""
+        import tempfile
+
+        return str(self.get("spillDir", tempfile.gettempdir()))
+
+    @property
+    def file_backed_commit_bytes(self) -> int:
+        """Commit map outputs at or above this size to an mmapped file
+        segment instead of memory (the RdmaMappedFile path,
+        RdmaMappedFile.java:76-199) — the larger-than-arena escape
+        hatch.  0 disables (all commits stay in memory/HBM)."""
+        return self._bytes_in_range("fileBackedCommitBytes", 0, 0, 1 << 44)
+
     # -- memory / arenas (reference: maxBufferAllocationSize, ODP) ----------
     @property
     def max_buffer_allocation_size(self) -> int:

@@ -34,12 +34,13 @@ class DeviceSegment:
     released; its ``free()`` is called exactly once on release."""
 
     def __init__(self, mkey: int, array, shuffle_id: Optional[int] = None,
-                 keepalive=None):
+                 keepalive=None, budgeted: bool = True):
         self.mkey = mkey
         self.array = array  # jax.Array uint8[nbytes] (or np.ndarray on host)
         self.nbytes = int(array.shape[0])
         self.shuffle_id = shuffle_id
         self.keepalive = keepalive
+        self.budgeted = budgeted
         self.created_at = time.monotonic()
 
     def _release_keepalive(self) -> None:
@@ -69,29 +70,40 @@ class ArenaManager(BlockStore):
         self._lock = threading.Lock()
         self._next_mkey = 1  # 0 is reserved for BlockLocation.EMPTY
         self._total_bytes = 0
+        self._file_bytes = 0  # unbudgeted (file-backed mmap) segment bytes
         # stats
         self._registered_ever = 0
         self._released_ever = 0
 
     def register(self, array, shuffle_id: Optional[int] = None,
-                 keepalive=None) -> DeviceSegment:
-        """Register a 1-D uint8 array as a readable segment."""
+                 keepalive=None, budgeted: bool = True) -> DeviceSegment:
+        """Register a 1-D uint8 array as a readable segment.
+
+        ``budgeted=False`` registers without debiting the byte budget —
+        for file-backed (mmap) segments whose pages live in the OS
+        cache, not the arena's memory (their bytes are tracked in the
+        ``file_bytes`` stat instead)."""
         if array.ndim != 1 or str(array.dtype) != "uint8":
             raise ValueError(
                 f"segments must be 1-D uint8, got {array.shape} {array.dtype}"
             )
         nbytes = int(array.shape[0])
         with self._lock:
-            if self.max_bytes and self._total_bytes + nbytes > self.max_bytes:
+            if (budgeted and self.max_bytes
+                    and self._total_bytes + nbytes > self.max_bytes):
                 raise MemoryError(
                     f"arena budget exhausted: {self._total_bytes + nbytes}B > "
                     f"{self.max_bytes}B"
                 )
             mkey = self._next_mkey
             self._next_mkey += 1
-            seg = DeviceSegment(mkey, array, shuffle_id, keepalive=keepalive)
+            seg = DeviceSegment(mkey, array, shuffle_id, keepalive=keepalive,
+                                budgeted=budgeted)
             self._segments[mkey] = seg
-            self._total_bytes += nbytes
+            if budgeted:
+                self._total_bytes += nbytes
+            else:
+                self._file_bytes += nbytes
             self._registered_ever += 1
         return seg
 
@@ -103,7 +115,10 @@ class ArenaManager(BlockStore):
         with self._lock:
             seg = self._segments.pop(mkey, None)
             if seg is not None:
-                self._total_bytes -= seg.nbytes
+                if seg.budgeted:
+                    self._total_bytes -= seg.nbytes
+                else:
+                    self._file_bytes -= seg.nbytes
                 self._released_ever += 1
         if seg is not None:
             seg._release_keepalive()
@@ -116,7 +131,10 @@ class ArenaManager(BlockStore):
                       if s.shuffle_id == shuffle_id]
             segs = [self._segments.pop(k) for k in doomed]
             for seg in segs:
-                self._total_bytes -= seg.nbytes
+                if seg.budgeted:
+                    self._total_bytes -= seg.nbytes
+                else:
+                    self._file_bytes -= seg.nbytes
                 self._released_ever += 1
         for seg in segs:
             seg._release_keepalive()
@@ -140,6 +158,7 @@ class ArenaManager(BlockStore):
             return {
                 "segments": len(self._segments),
                 "total_bytes": self._total_bytes,
+                "file_bytes": self._file_bytes,
                 "registered_ever": self._registered_ever,
                 "released_ever": self._released_ever,
             }
@@ -149,5 +168,6 @@ class ArenaManager(BlockStore):
             segs = list(self._segments.values())
             self._segments.clear()
             self._total_bytes = 0
+            self._file_bytes = 0
         for seg in segs:
             seg._release_keepalive()

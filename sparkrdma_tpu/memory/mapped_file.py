@@ -1,0 +1,79 @@
+"""File-backed registered segments: the RdmaMappedFile analog.
+
+The reference commits each map task's shuffle file by mmapping it in
+4 KiB-aligned chunks and registering every chunk as an ibverbs MR, with
+``deleteOnExit`` + explicit dispose (RdmaMappedFile.java:76-199).  Here
+a committed byte stream can be written to disk and served through a
+read-only ``np.memmap`` registered in the arena: the OS page cache
+plays the registered-memory role, reads go straight from the mapping,
+and the file is unlinked when the segment is released (the
+deleteOnExit/dispose pair).
+
+This is the larger-than-memory commit path — HBM staging
+(resolver default) serves the hot exchange; file-backed segments hold
+shuffles whose working set exceeds the arena budget.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class MappedFile:
+    """One shuffle data file: write once, then serve reads via mmap.
+
+    ``chunks`` is any iterable of byte strings, written STREAMING so a
+    spilled map output never needs to be resident in RAM at commit
+    (each chunk is materialized alone).  Pass the instance as
+    ``keepalive`` to ``ArenaManager.register`` — ``free()`` is called
+    exactly once on segment release and unlinks the file."""
+
+    def __init__(self, chunks, directory: Optional[str] = None,
+                 prefix: str = "sparkrdma_tpu_shuffle_"):
+        if isinstance(chunks, (bytes, bytearray, memoryview)):
+            chunks = (chunks,)
+        directory = directory or tempfile.gettempdir()
+        os.makedirs(directory, exist_ok=True)
+        fd, self.path = tempfile.mkstemp(prefix=prefix, dir=directory)
+        try:
+            total = 0
+            with os.fdopen(fd, "wb") as f:
+                for chunk in chunks:
+                    f.write(chunk)
+                    total += len(chunk)
+            # read-only mapping: serves get_local_block / transport reads
+            # without a resident copy (page cache backs it)
+            self.array = np.memmap(self.path, dtype=np.uint8, mode="r",
+                                   shape=(max(total, 1),))
+        except BaseException:
+            self._unlink()
+            raise
+        self._freed = False
+
+    def _unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            logger.warning("could not unlink %s", self.path, exc_info=True)
+
+    def free(self) -> None:
+        """Dispose: drop the mapping and delete the file
+        (RdmaMappedFile.java:189-199)."""
+        if self._freed:
+            return
+        self._freed = True
+        mm = getattr(self.array, "_mmap", None)
+        self.array = None
+        if mm is not None:
+            try:
+                mm.close()
+            except (BufferError, OSError):
+                pass  # outstanding views keep the mapping alive until GC
+        self._unlink()

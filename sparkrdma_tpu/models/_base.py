@@ -83,6 +83,13 @@ class ExchangeModel:
         vals = np.asarray(vals)
         if keys.shape != vals.shape or keys.ndim != 1:
             raise ValueError("keys/vals must be equal-length 1-D arrays")
+        for name, col in (("keys", keys), ("vals", vals)):
+            if col.dtype == np.int64 and not jax.config.jax_enable_x64:
+                raise ValueError(
+                    f"int64 {name} require jax_enable_x64 (without it JAX "
+                    "silently truncates to int32 — colliding keys / "
+                    "corrupting sums)"
+                )
         n = keys.shape[0]
         if n == 0:
             return None, None

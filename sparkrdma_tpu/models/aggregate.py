@@ -75,13 +75,8 @@ class KeyedAggregator(ExchangeModel):
         Int/Long parity).  For wide sums pass int64 values with
         ``jax_enable_x64`` on; without it int64 inputs would silently
         truncate, so that combination is rejected."""
-        keys = np.asarray(keys)
-        vals = np.asarray(vals)
-        if vals.dtype == np.int64 and not jax.config.jax_enable_x64:
-            raise ValueError(
-                "int64 values require jax_enable_x64 (without it JAX "
-                "silently truncates to int32, corrupting sums)"
-            )
+        # int64-without-x64 inputs are rejected inside _run_padded_keyed
+        # (shared with every keyed model)
         rows, nu = self._run_padded_keyed(keys, vals, make_aggregate_step)
         if rows is None:
             return {}

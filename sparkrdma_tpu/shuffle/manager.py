@@ -173,6 +173,8 @@ class TpuShuffleManager:
             self.arena, self.node,
             stage_to_device=stage_to_device and not conf.lazy_staging,
             staging_pool=self.staging_pool,
+            file_backed_threshold=conf.file_backed_commit_bytes,
+            spill_dir=conf.spill_dir,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)

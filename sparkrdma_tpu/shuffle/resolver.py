@@ -26,6 +26,30 @@ from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
+class ChunkedPayload:
+    """Lazily-produced partition bytes for the commit path: total
+    length known up front, chunks materialized one at a time.  Lets a
+    spilled map output stream into the commit target (host buffer or
+    data file) without ever being fully resident in RAM."""
+
+    __slots__ = ("length", "chunks_fn")
+
+    def __init__(self, length: int, chunks_fn):
+        self.length = length
+        self.chunks_fn = chunks_fn  # () -> Iterator[bytes]
+
+
+def _payload_len(p) -> int:
+    return p.length if isinstance(p, ChunkedPayload) else len(p)
+
+
+def _payload_chunks(p):
+    if isinstance(p, ChunkedPayload):
+        yield from p.chunks_fn()
+    elif p:
+        yield p
+
+
 class _ShuffleData:
     """Per-shuffle write-side state on one executor (the
     RdmaWrapperShuffleData analog)."""
@@ -41,11 +65,17 @@ class ShuffleBlockResolver:
     """Executor-local registry of committed map outputs."""
 
     def __init__(self, arena: ArenaManager, node: Optional[Node] = None,
-                 stage_to_device: bool = True, staging_pool=None):
+                 stage_to_device: bool = True, staging_pool=None,
+                 file_backed_threshold: int = 0,
+                 spill_dir: Optional[str] = None):
         self.arena = arena
         self.node = node
         self.stage_to_device = stage_to_device
         self.staging_pool = staging_pool  # pooled host buffers for concat
+        # commits >= this many bytes go to an mmapped file segment (the
+        # RdmaMappedFile path); 0 keeps everything in memory/HBM
+        self.file_backed_threshold = file_backed_threshold
+        self.spill_dir = spill_dir
         self._shuffles: Dict[int, _ShuffleData] = {}
         self._lock = threading.Lock()
 
@@ -63,13 +93,19 @@ class ShuffleBlockResolver:
         self,
         shuffle_id: int,
         map_id: int,
-        partition_bytes: Sequence[bytes],
+        partition_bytes: Sequence,
     ) -> MapTaskOutput:
         """Stage one map task's serialized partitions into a registered
-        segment and build its location table."""
+        segment and build its location table.  Each partition payload is
+        ``bytes`` or a :class:`ChunkedPayload` (spill-merge commits
+        stream their chunks — nothing is pre-joined in RAM)."""
         num_partitions = len(partition_bytes)
         sd = self._get_or_create(shuffle_id, num_partitions)
-        total = sum(len(b) for b in partition_bytes)
+        total = sum(_payload_len(b) for b in partition_bytes)
+        if self.file_backed_threshold and total >= self.file_backed_threshold:
+            return self._commit_file_backed(
+                sd, shuffle_id, map_id, partition_bytes, total
+            )
         staging_buf = None
         if self.staging_pool is not None and total > 0:
             # serialize through the pooled, page-aligned native buffer —
@@ -87,11 +123,12 @@ class ShuffleBlockResolver:
         offsets: List[Tuple[int, int]] = []
         off = 0
         for b in partition_bytes:
-            n = len(b)
-            if n:
-                buf[off : off + n] = np.frombuffer(b, np.uint8)
+            n = _payload_len(b)
             offsets.append((off, n))
-            off += n
+            for chunk in _payload_chunks(b):
+                m = len(chunk)
+                buf[off : off + m] = np.frombuffer(chunk, np.uint8)
+                off += m
         try:
             if self.stage_to_device:
                 import jax.numpy as jnp
@@ -119,17 +156,60 @@ class ShuffleBlockResolver:
                 mto.put(pid, BlockLocation.EMPTY)
             else:
                 mto.put(pid, BlockLocation(o, n, seg.mkey))
+        # install, releasing any superseded segment from a task retry
+        self._install(sd, map_id, mto, seg)
+        return mto
+
+    def _commit_file_backed(
+        self, sd: "_ShuffleData", shuffle_id: int, map_id: int,
+        partition_bytes: Sequence, total: int,
+    ) -> MapTaskOutput:
+        """Large-output commit: stream the map task's partitions into
+        one data file and register its read-only mmap as the segment
+        (the RdmaMappedFile mmap+register path; file unlinked on
+        release).  Streamed chunk-by-chunk, and NOT debited against the
+        arena byte budget — the whole point is holding shuffles larger
+        than the in-memory arena, and the pages live in the OS cache."""
+        from sparkrdma_tpu.memory.mapped_file import MappedFile
+
+        mf = MappedFile(
+            (chunk for b in partition_bytes for chunk in _payload_chunks(b)),
+            directory=self.spill_dir,
+        )
+        try:
+            seg = self.arena.register(
+                mf.array, shuffle_id=shuffle_id, keepalive=mf,
+                budgeted=False,
+            )
+        except BaseException:
+            mf.free()
+            raise
+        if self.node is not None:
+            self.node.register_block_store(seg.mkey, self.arena)
+        mto = MapTaskOutput(len(partition_bytes))
+        off = 0
+        for pid, b in enumerate(partition_bytes):
+            n = _payload_len(b)
+            if n == 0:
+                mto.put(pid, BlockLocation.EMPTY)
+            else:
+                mto.put(pid, BlockLocation(off, n, seg.mkey))
+            off += n
+        self._install(sd, map_id, mto, seg)
+        return mto
+
+    def _install(self, sd: "_ShuffleData", map_id: int,
+                 mto: MapTaskOutput, seg: DeviceSegment) -> None:
+        """Publish (mto, seg) as map_id's output, releasing any
+        superseded segment from a task retry/speculation."""
         with self._lock:
             prior = sd.outputs.get(map_id)
             sd.outputs[map_id] = (mto, seg)
         if prior is not None:
-            # task retry / speculation re-committed this map: release the
-            # superseded segment so retries don't leak HBM
             _, old_seg = prior
             if self.node is not None:
                 self.node.unregister_block_store(old_seg.mkey)
             self.arena.release(old_seg.mkey)
-        return mto
 
     # -- read side (local short-circuit) ------------------------------------
     def get_local_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:

@@ -12,9 +12,11 @@ RdmaWrapperShuffleWriter.scala:115-149).
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 import time
-from typing import Any, Callable, Iterable, List, Optional
+from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
@@ -27,6 +29,8 @@ class WriteMetrics:
         self.records_written = 0
         self.bytes_written = 0
         self.write_time_ms = 0.0
+        self.spills = 0
+        self.bytes_spilled = 0
 
 
 class ShuffleWriter:
@@ -46,6 +50,14 @@ class ShuffleWriter:
             else None
         )
         self._stopped = False
+        # spill state (Spark sort-shuffle spill role; 0 = disabled)
+        self._spill_threshold = manager.conf.shuffle_spill_record_threshold
+        self._records_in_memory = 0
+        self._spill_file = None
+        # per partition: [(offset, length)] chunks in the spill file
+        self._spilled: List[List[Tuple[int, int]]] = [
+            [] for _ in range(handle.partitioner.num_partitions)
+        ]
 
     # -- write --------------------------------------------------------------
     def write(self, records: Iterable[Record]) -> None:
@@ -59,12 +71,83 @@ class ShuffleWriter:
                     d[k] = agg.merge_value(d[k], v)
                 else:
                     d[k] = agg.create_combiner(v)
+                    self._records_in_memory += 1
                 self.metrics.records_written += 1
+                if (self._spill_threshold
+                        and self._records_in_memory >= self._spill_threshold):
+                    self.spill()
         else:
             for rec in records:
                 self._buckets[part(rec[0])].append(rec)
+                self._records_in_memory += 1
                 self.metrics.records_written += 1
+                if (self._spill_threshold
+                        and self._records_in_memory >= self._spill_threshold):
+                    self.spill()
         self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
+
+    # -- spill --------------------------------------------------------------
+    def spill(self) -> None:
+        """Serialize buffered buckets to the spill file and release the
+        memory.  The serializer's framing is concatenation-safe, so the
+        commit merges spilled chunks with the final in-memory remainder
+        by plain byte concatenation; with map-side combine the reader's
+        merge_combiners folds duplicate keys across spilled chunks."""
+        if self._records_in_memory == 0:
+            return
+        serializer = self.manager.serializer
+        if self._spill_file is None:
+            spill_dir = self.manager.conf.spill_dir
+            os.makedirs(spill_dir, exist_ok=True)
+            fd, path = tempfile.mkstemp(
+                prefix=f"sparkrdma_tpu_spill_{self.handle.shuffle_id}_"
+                       f"{self.map_id}_",
+                dir=spill_dir,
+            )
+            self._spill_file = os.fdopen(fd, "w+b")
+            self._spill_path = path
+        f = self._spill_file
+        f.seek(0, os.SEEK_END)
+        sources = (
+            [d.items() if d else None for d in self._combined]
+            if self._combined is not None
+            else [b if b else None for b in self._buckets]
+        )
+        for pid, src in enumerate(sources):
+            if src is None:
+                continue
+            raw = serializer.serialize(src)
+            off = f.tell()
+            f.write(raw)
+            self._spilled[pid].append((off, len(raw)))
+            self.metrics.bytes_spilled += len(raw)
+        if self._combined is not None:
+            self._combined = [dict() for _ in self._combined]
+        else:
+            self._buckets = [[] for _ in self._buckets]
+        self._records_in_memory = 0
+        self.metrics.spills += 1
+
+    def _iter_partition_chunks(self, pid: int, final: bytes):
+        """Yield a partition's spilled chunks (read back one at a time)
+        followed by the final in-memory remainder — at most one spill
+        chunk is ever resident during the commit copy."""
+        for off, n in self._spilled[pid]:
+            self._spill_file.seek(off)
+            yield self._spill_file.read(n)
+        if final:
+            yield final
+
+    def _close_spill(self) -> None:
+        if self._spill_file is not None:
+            f, self._spill_file = self._spill_file, None
+            try:
+                f.close()
+            finally:
+                try:
+                    os.unlink(self._spill_path)
+                except OSError:
+                    pass
 
     # -- commit + publish ---------------------------------------------------
     def stop(self, success: bool = True) -> Optional[MapTaskOutput]:
@@ -72,27 +155,55 @@ class ShuffleWriter:
             return None
         self._stopped = True
         if not success:
+            self._close_spill()
             return None
         tracer = get_tracer()
-        with tracer.span(
-            "shuffle.write.commit",
-            shuffle=self.handle.shuffle_id, map=self.map_id,
-        ):
-            return self._commit()
+        try:
+            with tracer.span(
+                "shuffle.write.commit",
+                shuffle=self.handle.shuffle_id, map=self.map_id,
+            ):
+                return self._commit()
+        finally:
+            self._close_spill()
 
     def _commit(self) -> MapTaskOutput:
         t0 = time.monotonic()
         serializer = self.manager.serializer
         if self._combined is not None:
-            partition_bytes = [
+            finals = [
                 serializer.serialize(d.items()) if d else b""
                 for d in self._combined
             ]
         else:
-            partition_bytes = [
+            finals = [
                 serializer.serialize(b) if b else b"" for b in self._buckets
             ]
-        self.metrics.bytes_written = sum(len(b) for b in partition_bytes)
+        if self._spill_file is not None:
+            # merge = chunk concatenation (both serializers frame
+            # concatenation-safely), STREAMED through ChunkedPayload so
+            # the spilled output is never fully resident at commit
+            from sparkrdma_tpu.shuffle.resolver import ChunkedPayload
+
+            partition_bytes = []
+            for pid, final in enumerate(finals):
+                spilled_len = sum(n for _, n in self._spilled[pid])
+                total_len = spilled_len + len(final)
+                if total_len == 0:
+                    partition_bytes.append(b"")
+                else:
+                    partition_bytes.append(ChunkedPayload(
+                        total_len,
+                        lambda pid=pid, final=final:
+                            self._iter_partition_chunks(pid, final),
+                    ))
+        else:
+            partition_bytes = finals
+        from sparkrdma_tpu.shuffle.resolver import _payload_len
+
+        self.metrics.bytes_written = sum(
+            _payload_len(b) for b in partition_bytes
+        )
         mto = self.manager.resolver.commit_map_output(
             self.handle.shuffle_id, self.map_id, partition_bytes
         )

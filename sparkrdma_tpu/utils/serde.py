@@ -71,8 +71,13 @@ class CompressedSerializer(Serializer):
     reference's read-side stream wrapping for codec support
     (``wrapStream`` reflection, RdmaShuffleReader.scala:51-58,117-127),
     applied symmetrically on write.  Codecs: ``zlib`` (default) and
-    ``lzma``; payloads below ``min_size`` are stored raw (1-byte codec
-    tag 0) since small-block compression costs more than it saves.
+    ``lzma``; payloads below ``min_size`` are stored raw (codec tag 0)
+    since small-block compression costs more than it saves.
+
+    Framing is ``1B tag + 4B length + body`` per serialize() call, so
+    outputs are CONCATENATION-SAFE like the inner serializer's — the
+    writer's spill-merge and any block concatenation rely on this
+    (plain ``zlib.decompress`` would silently discard trailing frames).
     """
 
     _RAW, _ZLIB, _LZMA = 0, 1, 2
@@ -86,32 +91,67 @@ class CompressedSerializer(Serializer):
         self.level = level
         self.min_size = min_size
 
+    # one frame per this many records: bounds frame bodies far below the
+    # 4B length field's 4 GiB ceiling for sane record sizes
+    frame_records = 65536
+
     def serialize(self, records: Iterable[Record]) -> bytes:
-        raw = self.inner.serialize(records)
+        out = bytearray()
+        batch: List[Record] = []
+        for rec in records:
+            batch.append(rec)
+            if len(batch) >= self.frame_records:
+                out += self._frame(self.inner.serialize(batch))
+                batch = []
+        if batch or not out:
+            out += self._frame(self.inner.serialize(batch))
+        return bytes(out)
+
+    def _frame(self, raw: bytes) -> bytes:
         if len(raw) < self.min_size:
-            return bytes([self._RAW]) + raw
-        if self.codec == "zlib":
+            tag, body = self._RAW, raw
+        elif self.codec == "zlib":
             import zlib
 
-            return bytes([self._ZLIB]) + zlib.compress(raw, self.level)
-        import lzma
-
-        return bytes([self._LZMA]) + lzma.compress(raw)
-
-    def deserialize(self, data: bytes) -> Iterator[Record]:
-        if not data:
-            return
-        tag, body = data[0], bytes(memoryview(data)[1:])
-        if tag == self._RAW:
-            raw = body
-        elif tag == self._ZLIB:
-            import zlib
-
-            raw = zlib.decompress(body)
-        elif tag == self._LZMA:
+            tag, body = self._ZLIB, zlib.compress(raw, self.level)
+        else:
             import lzma
 
-            raw = lzma.decompress(body)
-        else:
-            raise ValueError(f"unknown codec tag {tag}")
-        yield from self.inner.deserialize(raw)
+            tag, body = self._LZMA, lzma.compress(raw)
+        if len(body) >= 1 << 32:
+            raise ValueError(
+                f"frame body of {len(body)}B exceeds the 4 GiB framing "
+                f"limit ({self.frame_records} records averaging "
+                ">64 KiB each) — lower frame_records for huge records"
+            )
+        return bytes([tag]) + _LEN.pack(len(body)) + body
+
+    def deserialize(self, data: bytes) -> Iterator[Record]:
+        view = memoryview(data)
+        off = 0
+        while off < len(view):
+            if off + 1 + _LEN.size > len(view):
+                raise ValueError(f"truncated frame header at offset {off}")
+            tag = view[off]
+            (n,) = _LEN.unpack_from(view, off + 1)
+            off += 1 + _LEN.size
+            if off + n > len(view):
+                raise ValueError(
+                    f"truncated frame: need {n}B at {off}, "
+                    f"have {len(view) - off}B"
+                )
+            body = bytes(view[off : off + n])
+            off += n
+            if tag == self._RAW:
+                raw = body
+            elif tag == self._ZLIB:
+                import zlib
+
+                raw = zlib.decompress(body)
+            elif tag == self._LZMA:
+                import lzma
+
+                raw = lzma.decompress(body)
+            else:
+                raise ValueError(f"unknown codec tag {tag}")
+            yield from self.inner.deserialize(raw)

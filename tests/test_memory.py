@@ -170,3 +170,25 @@ def test_segment_keepalive_released_with_segment(devices):
                       keepalive=FakeBuf())
     mgr.release_shuffle(2)
     assert FakeBuf.freed == 2
+
+
+def test_arena_unbudgeted_file_segment():
+    # reviewer finding: file-backed segments must not consume the arena
+    # byte budget (they live in the OS page cache, not HBM)
+    import numpy as np
+    from sparkrdma_tpu.memory.arena import ArenaManager
+
+    arena = ArenaManager(max_bytes=1024)
+    big = np.zeros(4096, np.uint8)
+    seg = arena.register(big, budgeted=False)
+    assert arena.total_bytes == 0
+    assert arena.stats()["file_bytes"] == 4096
+    # budgeted registration still enforced
+    arena.register(np.zeros(512, np.uint8))
+    try:
+        arena.register(np.zeros(1024, np.uint8))
+        assert False, "budget must still apply to budgeted segments"
+    except MemoryError:
+        pass
+    arena.release(seg.mkey)
+    assert arena.stats()["file_bytes"] == 0

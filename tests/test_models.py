@@ -260,3 +260,22 @@ def test_keyed_aggregator_rejects_silent_int64_truncation(mesh, devices):
     vals = np.full(8, 2**40, np.int64)
     with pytest.raises(ValueError, match="int64"):
         KeyedAggregator(mesh).aggregate(keys, vals)
+
+
+def test_wordcount_rejects_silent_int64_truncation(mesh, devices):
+    # reviewer finding: the guard must cover every keyed model and BOTH
+    # columns (int64 keys collide after a silent int32 downcast)
+    from sparkrdma_tpu.models.wordcount import WordCounter
+    from sparkrdma_tpu.models.aggregate import KeyedAggregator
+    import jax as _jax
+
+    if _jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled: int64 is exact, nothing to reject")
+    with pytest.raises(ValueError, match="int64 vals"):
+        WordCounter(mesh).count(
+            np.zeros(8, np.int32), np.full(8, 2**40, np.int64)
+        )
+    with pytest.raises(ValueError, match="int64 keys"):
+        KeyedAggregator(mesh).aggregate(
+            np.array([2**33 + 1, 1] * 4, np.int64), np.ones(8, np.int32)
+        )

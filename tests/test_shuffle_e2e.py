@@ -272,3 +272,130 @@ def test_abandoned_reader_cleans_up(cluster):
     while time.monotonic() < deadline and len(ex._callbacks) > before:
         time.sleep(0.05)
     assert len(ex._callbacks) == before
+
+
+def test_writer_spill_roundtrip(devices, tmp_path):
+    """Spilled + in-memory chunks merge into the same read results."""
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": 37300,
+        "spark.shuffle.tpu.shuffleSpillRecordThreshold": "100",
+        "spark.shuffle.tpu.spillDir": str(tmp_path),
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    ex = TpuShuffleManager(conf, is_driver=False, network=net,
+                           port=38300, executor_id="0")
+    try:
+        handle = driver.register_shuffle(0, 1, HashPartitioner(4))
+        w = ex.get_writer(handle, 0)
+        records = [(i % 37, i) for i in range(1000)]
+        w.write(records)
+        assert w.metrics.spills >= 9  # 1000 records / 100 threshold
+        assert w.metrics.bytes_spilled > 0
+        w.stop(True)
+        assert not list(tmp_path.glob("sparkrdma_tpu_spill_*")), (
+            "spill file must be deleted after commit"
+        )
+        got = []
+        for pid in range(4):
+            r = ex.get_reader(handle, pid, pid + 1, {ex.local_smid: [0]})
+            got.extend(r.read())
+        assert sorted(got) == sorted(records)
+    finally:
+        ex.stop()
+        driver.stop()
+
+
+def test_writer_spill_with_map_side_combine(devices, tmp_path):
+    """Spilled combiner chunks re-merge through merge_combiners on read."""
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": 37310,
+        "spark.shuffle.tpu.shuffleSpillRecordThreshold": "10",
+        "spark.shuffle.tpu.spillDir": str(tmp_path),
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    ex = TpuShuffleManager(conf, is_driver=False, network=net,
+                           port=38310, executor_id="0")
+    try:
+        agg = Aggregator(lambda v: v, lambda c, v: c + v, lambda a, b: a + b)
+        handle = driver.register_shuffle(
+            0, 1, HashPartitioner(2), aggregator=agg, map_side_combine=True
+        )
+        w = ex.get_writer(handle, 0)
+        # 20 distinct keys, threshold 10 -> at least one spill; every key
+        # appears in 2+ chunks so the reader must merge across chunks
+        w.write([(i % 20, 1) for i in range(400)])
+        w.stop(True)
+        assert w.metrics.spills >= 1
+        got = {}
+        for pid in range(2):
+            r = ex.get_reader(handle, pid, pid + 1, {ex.local_smid: [0]})
+            got.update(dict(r.read()))
+        assert got == {k: 20 for k in range(20)}
+    finally:
+        ex.stop()
+        driver.stop()
+
+
+def test_file_backed_commit(devices, tmp_path):
+    """Commits above the threshold land in an mmapped file segment that
+    serves reads and is unlinked on shuffle unregister."""
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": 37320,
+        "spark.shuffle.tpu.fileBackedCommitBytes": "1k",
+        "spark.shuffle.tpu.spillDir": str(tmp_path),
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    ex = TpuShuffleManager(conf, is_driver=False, network=net,
+                           port=38320, executor_id="0")
+    try:
+        handle = driver.register_shuffle(0, 1, HashPartitioner(3))
+        w = ex.get_writer(handle, 0)
+        records = [(i, "x" * 50) for i in range(500)]  # well over 1k
+        w.write(records)
+        w.stop(True)
+        files = list(tmp_path.glob("sparkrdma_tpu_shuffle_*"))
+        assert files, "file-backed commit must write a data file"
+        got = []
+        for pid in range(3):
+            r = ex.get_reader(handle, pid, pid + 1, {ex.local_smid: [0]})
+            got.extend(r.read())
+        assert sorted(got) == sorted(records)
+        ex.unregister_shuffle(0)
+        assert not list(tmp_path.glob("sparkrdma_tpu_shuffle_*")), (
+            "data file must be unlinked when the shuffle is released"
+        )
+    finally:
+        ex.stop()
+        driver.stop()
+
+
+def test_writer_spill_with_compression(devices, tmp_path):
+    """Spilled compressed chunks concatenate into valid framed streams."""
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": 37330,
+        "spark.shuffle.tpu.shuffleSpillRecordThreshold": "64",
+        "spark.shuffle.tpu.spillDir": str(tmp_path / "newdir"),  # not yet created
+        "spark.shuffle.tpu.compress": "true",
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    ex = TpuShuffleManager(conf, is_driver=False, network=net,
+                           port=38330, executor_id="0")
+    try:
+        handle = driver.register_shuffle(0, 1, HashPartitioner(3))
+        w = ex.get_writer(handle, 0)
+        records = [(i % 91, "v" * (i % 17)) for i in range(700)]
+        w.write(records)
+        w.stop(True)
+        assert w.metrics.spills >= 2
+        got = []
+        for pid in range(3):
+            r = ex.get_reader(handle, pid, pid + 1, {ex.local_smid: [0]})
+            got.extend(r.read())
+        assert sorted(got) == sorted(records)
+    finally:
+        ex.stop()
+        driver.stop()
