@@ -22,6 +22,20 @@ from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
 MAX_OVERFLOW_RETRIES = 6
 
 
+def check_no_silent_truncation(**columns) -> None:
+    """Reject int64 columns when jax_enable_x64 is off: jnp.asarray
+    would silently truncate them to int32, colliding keys or corrupting
+    values with no error.  Shared by every keyed model (aggregations
+    AND joins)."""
+    for name, col in columns.items():
+        if np.asarray(col).dtype == np.int64 and not jax.config.jax_enable_x64:
+            raise ValueError(
+                f"int64 {name} require jax_enable_x64 (without it JAX "
+                "silently truncates to int32 — colliding keys / "
+                "corrupting values)"
+            )
+
+
 class ExchangeModel:
     """Base for host-facing drivers of capacity-bucketed SPMD steps."""
 
@@ -83,13 +97,7 @@ class ExchangeModel:
         vals = np.asarray(vals)
         if keys.shape != vals.shape or keys.ndim != 1:
             raise ValueError("keys/vals must be equal-length 1-D arrays")
-        for name, col in (("keys", keys), ("vals", vals)):
-            if col.dtype == np.int64 and not jax.config.jax_enable_x64:
-                raise ValueError(
-                    f"int64 {name} require jax_enable_x64 (without it JAX "
-                    "silently truncates to int32 — colliding keys / "
-                    "corrupting sums)"
-                )
+        check_no_silent_truncation(keys=keys, vals=vals)
         n = keys.shape[0]
         if n == 0:
             return None, None

@@ -47,6 +47,7 @@ def make_aggregate_step(mesh: Mesh, n_local: int, capacity: int):
     spec = P(EXCHANGE_AXIS)
 
     def body(k, v, valid):  # local [n_local]
+        # (hash_exchange is the identity for D == 1 — no padded sorts)
         flat_k, flat_v, flat_m, max_fill = hash_exchange(
             k, v, valid, D, capacity
         )

@@ -8,18 +8,26 @@ keys are unique.
 
 - :class:`HashJoiner` — the exchange-shuffle join: both sides are
   hash-partitioned by key and moved with one ``all_to_all`` each, then
-  every device probes its co-partitioned pair locally (sort the
-  dimension side, ``searchsorted`` probe — no scatters).
+  every device probes its co-partitioned pair locally.
 - :class:`BroadcastJoiner` — the broadcast join: the dimension side is
   small, so it is replicated to every device (``in_specs=P(None)``, the
   all-gather XLA inserts for a replicated operand) and only the fact
   side is sharded; no exchange at all.
 
-Output is the matched triple per fact row plus a found mask; unmatched
-fact rows are dropped host-side (inner join).  Unique-key dimension
-sides make the output size statically equal to the fact side — the
-property that keeps the SPMD program shape-static (SURVEY.md §7
-"variable-length blocks" hard part does not arise).
+The local probe is a SORT-MERGE: both sides concatenate into one
+multi-operand sort (dimension rows ordered before fact rows of the same
+key); match detection is pure ``cummax``/``cumsum`` prefix scans
+(native TPU primitives, ~15 ms per 8M elements measured), and the value
+fill is ONE gather from the compact sorted dimension table.  The
+obvious alternatives measured far worse on real hardware:
+``jnp.searchsorted`` lowers to a gather per binary-search step and a
+general ``associative_scan`` fill compiles pathologically at
+multi-million element sizes.
+
+Output rows are the concatenated probe layout with a found mask (1 only
+on matched fact rows); unmatched/dimension rows are dropped host-side
+(inner join).  Static shapes throughout (SURVEY.md §7 "variable-length
+blocks" hard part does not arise).
 """
 
 from __future__ import annotations
@@ -32,36 +40,76 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkrdma_tpu.models._base import ExchangeModel
+from sparkrdma_tpu.models._base import (
+    ExchangeModel,
+    check_no_silent_truncation,
+)
 from sparkrdma_tpu.ops.exchange import hash_exchange
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
 
-def _probe(lk, l_valid, rk, rv, r_valid):
-    """Local probe: for each left key, find its (unique) right match.
-    Returns (rv_matched, found) aligned with lk.
+def _probe(lk, lv, l_valid, rk, rv, r_valid):
+    """Sort-merge probe: join fact rows against the (unique-keyed)
+    dimension rows.  Returns ``(keys, fact_vals, dim_vals, found)``, all
+    of length ``n_left + n_right`` — ``found`` is 1 exactly on matched
+    FACT rows (dimension and invalid rows carry 0); callers filter.
 
-    Validity of the HIT slot is checked explicitly: invalid right slots
-    (bucket fill / padding) are forced onto the sentinel key and sorted
-    AFTER valid slots of the same key, so a real right key equal to the
-    dtype max still wins the side="left" probe, and a fact key equal to
-    the dtype max cannot match a padding slot."""
-    n = rk.shape[0]
-    if n == 0:
+    Mechanics: one multi-operand sort of the concatenated sides, keyed
+    (key, side) with dimension rows (side 0) before fact rows (side 1)
+    of the same key.  A fact row matches iff the latest valid dimension
+    row at or before it falls inside its own key-run — detected with
+    two ``cummax`` scans (latest-dim position vs run-head position),
+    gather-free.  Its dimension value is then the ``cumsum``-ranked
+    entry of the separately key-sorted dimension table: ONE gather from
+    the compact table (unique keys make both key-orders agree row for
+    row).  Invalid slots (padding / bucket fill) are masked onto the
+    sentinel key and excluded from the fill, so a real key equal to the
+    dtype max still matches correctly and padding never matches."""
+    nl, nr = lk.shape[0], rk.shape[0]
+    sentinel = jnp.array(jnp.iinfo(lk.dtype).max, lk.dtype)
+    if nr == 0:
         # empty dimension side: no fact row can match
-        return jnp.zeros(lk.shape[0], rv.dtype), jnp.zeros(lk.shape[0], jnp.int32)
-    sentinel = jnp.array(jnp.iinfo(rk.dtype).max, rk.dtype)
+        return (
+            jnp.where(l_valid > 0, lk, sentinel), lv,
+            jnp.zeros(nl, rv.dtype), jnp.zeros(nl, jnp.int32),
+        )
     rk_m = jnp.where(r_valid > 0, rk, sentinel)
     r_inv = jnp.int32(1) - (r_valid > 0).astype(jnp.int32)
-    srk, sinv, srv = jax.lax.sort(
-        (rk_m, r_inv, rv), num_keys=2, is_stable=False
+    # compact dimension table in key order, valid rows first
+    _, _, srv = jax.lax.sort((rk_m, r_inv, rv), num_keys=2, is_stable=False)
+    keys = jnp.concatenate([jnp.where(l_valid > 0, lk, sentinel), rk_m])
+    side = jnp.concatenate([
+        jnp.ones(nl, jnp.int32), jnp.zeros(nr, jnp.int32)
+    ])
+    # only FACT rows' own values are read from the sorted payload (dim
+    # values come from the compact table below), so the dim slots carry
+    # zeros OF lv's DTYPE — concatenating lv with rv would silently
+    # promote mixed-dtype columns and corrupt fact values
+    payload = jnp.concatenate([lv, jnp.zeros(nr, lv.dtype)])
+    valid = jnp.concatenate([
+        (l_valid > 0).astype(jnp.int32), (r_valid > 0).astype(jnp.int32)
+    ])
+    sk, sside, spay, svalid = jax.lax.sort(
+        (keys, side, payload, valid), num_keys=2, is_stable=False
     )
-    idx = jnp.clip(
-        jnp.searchsorted(srk, lk, side="left").astype(jnp.int32), 0, n - 1
-    )
-    hit_valid = sinv[idx] == 0
-    found = ((srk[idx] == lk) & hit_valid & (l_valid > 0)).astype(jnp.int32)
-    return srv[idx], found
+    m = nl + nr
+    iota = jnp.arange(m, dtype=jnp.int32)
+    has = ((sside == 0) & (svalid > 0)).astype(jnp.int32)
+    # latest valid-dim position vs my run head: inside my run <=> match
+    # (the valid dim row of a key-run is always the run's FIRST row)
+    latest_dim = jax.lax.cummax(jnp.where(has > 0, iota, jnp.int32(-1)))
+    is_head = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    run_head = jax.lax.cummax(jnp.where(is_head, iota, jnp.int32(-1)))
+    found = (
+        (sside == 1) & (svalid > 0)
+        & (latest_dim >= 0) & (latest_dim >= run_head)
+    ).astype(jnp.int32)
+    # value fill: has-rank in the combined order == row index in the
+    # key-sorted dim table (keys unique among valid dim rows)
+    rank = jnp.cumsum(has) - 1
+    fv = srv[jnp.clip(rank, 0, nr - 1)]
+    fv = jnp.where(found > 0, fv, jnp.zeros((), rv.dtype))
+    return sk, spay, fv, found
 
 
 @functools.lru_cache(maxsize=16)
@@ -73,10 +121,11 @@ def make_hash_join_step(mesh: Mesh, n_left: int, n_right: int,
     spec = P(EXCHANGE_AXIS)
 
     def body(lk, lv, l_valid, rk, rv, r_valid):  # local shards
+        # (hash_exchange is the identity for D == 1 — no padded sorts)
         elk, elv, elm, fill_l = hash_exchange(lk, lv, l_valid, D, cap_l)
         erk, erv, erm, fill_r = hash_exchange(rk, rv, r_valid, D, cap_r)
-        rv_m, found = _probe(elk, elm, erk, erv, erm)
-        return elk, elv, rv_m, found, fill_l[None], fill_r[None]
+        jk, jlv, jrv, found = _probe(elk, elv, elm, erk, erv, erm)
+        return jk, jlv, jrv, found, fill_l[None], fill_r[None]
 
     mapped = jax.shard_map(
         body, mesh=mesh, in_specs=(spec,) * 6, out_specs=(spec,) * 6
@@ -90,8 +139,7 @@ def make_broadcast_join_step(mesh: Mesh, n_left: int, n_right_total: int):
     spec = P(EXCHANGE_AXIS)
 
     def body(lk, lv, l_valid, rk, rv, r_valid):  # rk/rv/r_valid: FULL table
-        rv_m, found = _probe(lk, l_valid, rk, rv, r_valid)
-        return lk, lv, rv_m, found
+        return _probe(lk, lv, l_valid, rk, rv, r_valid)
 
     mapped = jax.shard_map(
         body, mesh=mesh,
@@ -174,6 +222,7 @@ class BroadcastJoiner(ExchangeModel):
 
 
 def _as_columns(keys, vals):
+    check_no_silent_truncation(keys=keys, vals=vals)
     k = jnp.asarray(np.asarray(keys))
     v = jnp.asarray(np.asarray(vals))
     if k.shape != v.shape or k.ndim != 1:

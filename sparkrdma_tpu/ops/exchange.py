@@ -36,7 +36,13 @@ def hash_exchange(
     Returns (keys', vals', valid', max_fill): flat [D * capacity] local
     columns of everything this device now owns, plus the max TRUE bucket
     fill (> capacity signals overflow — caller retries bigger).
+
+    Degenerate ``n_devices == 1`` is the identity: every key already
+    lives here, so the bucketing sort and its capacity padding are
+    skipped entirely (outputs keep the input length, max_fill = 0).
     """
+    if n_devices == 1:
+        return keys, vals, valid, jnp.int32(0)
     my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
     ids = hash_partition_ids(keys, n_devices)
     ids = jnp.where(valid > 0, ids, my)

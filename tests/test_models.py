@@ -279,3 +279,34 @@ def test_wordcount_rejects_silent_int64_truncation(mesh, devices):
         KeyedAggregator(mesh).aggregate(
             np.array([2**33 + 1, 1] * 4, np.int64), np.ones(8, np.int32)
         )
+
+
+@pytest.mark.parametrize("joiner_cls", ["hash", "broadcast"])
+def test_join_mixed_dtype_fact_vals_exact(joiner_cls, mesh, devices):
+    # reviewer finding: int32 fact values joined against float32 dim
+    # values must come back EXACT (no silent promotion through the sort)
+    from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+    fk = np.array([1, 2, 3], np.int32)
+    fv = np.array([2**24 + 1, 7, 9], np.int32)  # 2^24+1 not float32-exact
+    dk = np.array([1, 2], np.int32)
+    dv = np.array([0.5, 1.5], np.float32)
+    j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
+    k, lv, rv = j.join(fk, fv, dk, dv)
+    got = sorted(zip(k.tolist(), lv.tolist(), rv.tolist()))
+    assert got == [(1, 2**24 + 1, 0.5), (2, 7, 1.5)]
+    assert lv.dtype == np.int32
+
+
+def test_join_rejects_silent_int64_truncation(mesh, devices):
+    from sparkrdma_tpu.models.join import HashJoiner
+    import jax as _jax
+
+    if _jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled: int64 is exact, nothing to reject")
+    fk = np.array([2**33 + 1, 5], np.int64)
+    fv = np.array([10, 20], np.int32)
+    dk = np.array([1], np.int64)
+    dv = np.array([99], np.int32)
+    with pytest.raises(ValueError, match="int64 keys"):
+        HashJoiner(mesh).join(fk, fv, dk, dv)
