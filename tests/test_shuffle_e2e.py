@@ -399,3 +399,41 @@ def test_writer_spill_with_compression(devices, tmp_path):
     finally:
         ex.stop()
         driver.stop()
+
+
+def test_concurrent_shuffles_stress(cluster):
+    """Many shuffles in flight at once over one manager set: exercises
+    the control plane's locking (driver maps, resolver registry, arena,
+    callbacks) the way overlapping Spark stages would."""
+    import concurrent.futures
+
+    net, conf, driver, executors = cluster
+    N_SHUFFLES, N_MAPS, N_PARTS = 6, 4, 3
+
+    def run_one(sid):
+        handle = driver.register_shuffle(
+            100 + sid, N_MAPS, HashPartitioner(N_PARTS)
+        )
+        records_per_map = [
+            [((m * 31 + i) % 50, (sid, m, i)) for i in range(200)]
+            for m in range(N_MAPS)
+        ]
+        maps_by_host = run_maps(handle, executors, records_per_map)
+        got = []
+        for pid in range(N_PARTS):
+            ex = executors[pid % len(executors)]
+            reader = ex.get_reader(handle, pid, pid + 1, maps_by_host)
+            got.extend(reader.read())
+        expect = [kv for recs in records_per_map for kv in recs]
+        assert sorted(got) == sorted(expect), f"shuffle {sid} corrupted"
+        driver.unregister_shuffle(100 + sid)
+        for ex in executors:
+            ex.unregister_shuffle(100 + sid)
+        return sid
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=N_SHUFFLES) as p:
+        done = sorted(p.map(run_one, range(N_SHUFFLES)))
+    assert done == list(range(N_SHUFFLES))
+    # no segment leaks across any executor after unregisters
+    for ex in executors:
+        assert ex.arena.stats()["segments"] == 0
