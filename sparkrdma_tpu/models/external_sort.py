@@ -1,0 +1,171 @@
+"""External TeraSort: sortByKey for datasets larger than device memory.
+
+The reference's headline job sorts 175 GB across 16 workers — far more
+than any single worker holds — by streaming shuffle files through
+registered memory (SURVEY.md §6).  The device-plane analog: a two-pass
+sample sort whose working set per device step is ONE chunk or ONE
+bucket, never the whole dataset:
+
+1. **Partition pass** — each input chunk is locally sorted ON DEVICE
+   (the fast path: one unstable multi-operand ``lax.sort``), sampled,
+   and split by global range splitters into per-bucket runs appended to
+   bucket spill files (sequential host IO; the
+   ``shuffleWriteBlockSize``-style chunking of
+   RdmaMappedFile.java:95-171, with disk standing in for registered
+   memory).  Splitters come from a first sampling sweep, so buckets are
+   equal-frequency ranges.
+2. **Merge pass** — bucket files are loaded in range order and sorted
+   ON DEVICE (each bucket fits by construction when ``num_buckets``
+   ≳ total/chunk); concatenating the bucket outputs yields the global
+   sort.
+
+Peak device memory: O(max(chunk, bucket)); disk holds the rest — the
+SURVEY §5 "chunked, memory-bounded exchange of larger-than-HBM
+shuffles" template realized for the sort job.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.models.terasort import TeraSorter
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+
+class ExternalTeraSorter:
+    """Streaming sortByKey: ``sort_chunks`` consumes (keys, vals) numpy
+    chunk pairs and yields globally sorted (keys, vals) chunks, one per
+    range bucket."""
+
+    def __init__(
+        self,
+        mesh=None,
+        num_buckets: int = 64,
+        sample_per_chunk: int = 4096,
+        spill_dir: Optional[str] = None,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.sorter = TeraSorter(self.mesh)
+        self.num_buckets = int(num_buckets)
+        self.sample_per_chunk = int(sample_per_chunk)
+        self.spill_dir = spill_dir
+        # stats (observability parity: spill volumes, bucket skew)
+        self.chunks_in = 0
+        self.bytes_spilled = 0
+        self.max_bucket_records = 0
+
+    # -- pass 1 helpers -----------------------------------------------------
+    def _device_sort(self, keys: np.ndarray, vals: np.ndarray):
+        sk, sv = self.sorter.sort(keys, vals)
+        return np.asarray(sk), np.asarray(sv)
+
+    def sort_chunks(
+        self, chunks: Iterable[Tuple[np.ndarray, np.ndarray]]
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Two-pass external sort.  ``chunks`` may be a one-shot
+        generator: chunk data is retained in per-bucket spill files, so
+        nothing is iterated twice.  Yields (sorted_keys, sorted_vals)
+        per bucket in ascending global range order."""
+        with tempfile.TemporaryDirectory(
+            prefix="sparkrdma_tpu_extsort_", dir=self.spill_dir
+        ) as tmp:
+            paths = [os.path.join(tmp, f"bucket_{r}.bin")
+                     for r in range(self.num_buckets)]
+            files = [open(p, "wb") for p in paths]
+            samples = []
+            staged = []  # sorted chunks awaiting splitters
+            dtype = None
+            try:
+                # One subtlety: splitters need a GLOBAL sample, so the
+                # first chunks are staged (sorted, in memory) until the
+                # sample stabilizes.  To keep memory bounded we fix the
+                # splitters after the FIRST chunk's sample plus any
+                # staged chunks — for uniformly shuffled inputs one
+                # chunk's quantiles are already unbiased; pathological
+                # orderings degrade bucket balance, not correctness.
+                splitters = None
+                for keys, vals in chunks:
+                    keys = np.asarray(keys)
+                    vals = np.asarray(vals)
+                    if dtype is None:
+                        dtype = (keys.dtype, vals.dtype)
+                    self.chunks_in += 1
+                    sk, sv = self._device_sort(keys, vals)
+                    n = len(sk)
+                    if n:
+                        step = max(1, n // self.sample_per_chunk)
+                        samples.append(sk[::step])
+                    if splitters is None:
+                        staged.append((sk, sv))
+                        if sum(len(s) for s, _ in staged) >= 1:
+                            splitters = self._make_splitters(samples)
+                            for s, v in staged:
+                                self._spill(files, s, v, splitters)
+                            staged = []
+                    else:
+                        self._spill(files, sk, sv, splitters)
+                if splitters is None:
+                    # zero or empty chunks only
+                    splitters = self._make_splitters(samples)
+                    for s, v in staged:
+                        self._spill(files, s, v, splitters)
+            finally:
+                for f in files:
+                    f.close()
+            if dtype is None:
+                return
+            # pass 2: per-bucket device sort, in range order
+            kd, vd = dtype
+            item = np.dtype([("k", kd), ("v", vd)])
+            for p in paths:
+                size = os.path.getsize(p)
+                if size == 0:
+                    continue
+                rec = np.fromfile(p, dtype=item)
+                self.max_bucket_records = max(
+                    self.max_bucket_records, len(rec)
+                )
+                yield self._device_sort(rec["k"], rec["v"])
+
+    def _make_splitters(self, samples) -> np.ndarray:
+        if not samples:
+            return np.zeros(0, np.int64)
+        cat = np.sort(np.concatenate(samples))
+        idx = (np.arange(1, self.num_buckets) * len(cat)) // self.num_buckets
+        return cat[np.clip(idx, 0, len(cat) - 1)]
+
+    def _spill(self, files, sk: np.ndarray, sv: np.ndarray,
+               splitters: np.ndarray) -> None:
+        """Append each splitter range of the SORTED chunk to its bucket
+        file (ranges are contiguous slices — sequential IO only)."""
+        edges = np.concatenate([
+            [0], np.searchsorted(sk, splitters, side="right"), [len(sk)]
+        ]).astype(np.int64)
+        # an empty sample (all chunks empty so far) yields no splitters:
+        # everything lands in bucket 0
+        for r in range(len(edges) - 1):
+            lo, hi = edges[r], edges[r + 1]
+            if hi <= lo:
+                continue
+            item = np.dtype([("k", sk.dtype), ("v", sv.dtype)])
+            rec = np.empty(hi - lo, dtype=item)
+            rec["k"] = sk[lo:hi]
+            rec["v"] = sv[lo:hi]
+            rec.tofile(files[r])
+            self.bytes_spilled += rec.nbytes
+
+    def sort(self, keys, vals) -> Tuple[np.ndarray, np.ndarray]:
+        """Convenience non-streaming wrapper (array in, array out)."""
+        keys = np.asarray(keys)
+        vals = np.asarray(vals)
+        outs = list(self.sort_chunks([(keys, vals)]))
+        if not outs:
+            return keys[:0], vals[:0]
+        return (
+            np.concatenate([k for k, _ in outs]),
+            np.concatenate([v for _, v in outs]),
+        )

@@ -310,3 +310,47 @@ def test_join_rejects_silent_int64_truncation(mesh, devices):
     dv = np.array([99], np.int32)
     with pytest.raises(ValueError, match="int64 keys"):
         HashJoiner(mesh).join(fk, fv, dk, dv)
+
+
+def test_external_sort_streaming_chunks(mesh, devices):
+    from sparkrdma_tpu.models.external_sort import ExternalTeraSorter
+
+    rng = np.random.default_rng(50)
+    all_k, all_v = [], []
+
+    def chunks():
+        for _ in range(10):
+            n = int(rng.integers(1000, 5000))
+            k = rng.integers(0, 1 << 30, n).astype(np.int32)
+            v = rng.integers(0, 1 << 30, n).astype(np.int32)
+            all_k.append(k)
+            all_v.append(v)
+            yield k, v
+
+    ext = ExternalTeraSorter(mesh, num_buckets=8, sample_per_chunk=512)
+    outs = list(ext.sort_chunks(chunks()))
+    got_k = np.concatenate([k for k, _ in outs])
+    got_v = np.concatenate([v for _, v in outs])
+    keys = np.concatenate(all_k)
+    vals = np.concatenate(all_v)
+    order = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(got_k, keys[order])
+    assert sorted(zip(got_k.tolist(), got_v.tolist())) == sorted(
+        zip(keys.tolist(), vals.tolist())
+    )
+    assert ext.chunks_in == 10
+    assert ext.bytes_spilled == keys.nbytes + vals.nbytes
+    # memory bound: no bucket anywhere near the whole dataset
+    assert ext.max_bucket_records < len(keys) // 2
+
+
+def test_external_sort_empty_and_single(mesh, devices):
+    from sparkrdma_tpu.models.external_sort import ExternalTeraSorter
+
+    ext = ExternalTeraSorter(mesh, num_buckets=4)
+    k, v = ext.sort(np.zeros(0, np.int32), np.zeros(0, np.int32))
+    assert len(k) == 0 and len(v) == 0
+    k, v = ExternalTeraSorter(mesh, num_buckets=4).sort(
+        np.array([5], np.int32), np.array([7], np.int32)
+    )
+    assert k.tolist() == [5] and v.tolist() == [7]
