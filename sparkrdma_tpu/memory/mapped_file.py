@@ -48,6 +48,11 @@ class MappedFile:
                 for chunk in chunks:
                     f.write(chunk)
                     total += len(chunk)
+                if total == 0:
+                    # mmap of a zero-byte file is invalid: pad to one
+                    # byte so an all-empty-partitions commit still maps
+                    # (the segment serves only EMPTY locations anyway)
+                    f.write(b"\x00")
             # read-only mapping: serves get_local_block / transport reads
             # without a resident copy (page cache backs it)
             self.array = np.memmap(self.path, dtype=np.uint8, mode="r",

@@ -47,16 +47,20 @@ class ExternalTeraSorter:
         num_buckets: int = 64,
         sample_per_chunk: int = 4096,
         spill_dir: Optional[str] = None,
+        max_split_depth: int = 4,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.sorter = TeraSorter(self.mesh)
         self.num_buckets = int(num_buckets)
         self.sample_per_chunk = int(sample_per_chunk)
         self.spill_dir = spill_dir
+        # recursion guard for oversized-bucket re-splitting
+        self.max_split_depth = int(max_split_depth)
         # stats (observability parity: spill volumes, bucket skew)
         self.chunks_in = 0
         self.bytes_spilled = 0
         self.max_bucket_records = 0
+        self.buckets_resplit = 0
 
     # -- pass 1 helpers -----------------------------------------------------
     def _device_sort(self, keys: np.ndarray, vals: np.ndarray):
@@ -64,12 +68,17 @@ class ExternalTeraSorter:
         return np.asarray(sk), np.asarray(sv)
 
     def sort_chunks(
-        self, chunks: Iterable[Tuple[np.ndarray, np.ndarray]]
+        self, chunks: Iterable[Tuple[np.ndarray, np.ndarray]],
+        preset_splitters: Optional[np.ndarray] = None,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Two-pass external sort.  ``chunks`` may be a one-shot
         generator: chunk data is retained in per-bucket spill files, so
         nothing is iterated twice.  Yields (sorted_keys, sorted_vals)
-        per bucket in ascending global range order."""
+        per bucket in ascending global range order.
+
+        ``preset_splitters`` skips the sampling sweep — used by the
+        oversized-bucket re-split, where the data is already on disk and
+        a whole-file sample is available up front."""
         with tempfile.TemporaryDirectory(
             prefix="sparkrdma_tpu_extsort_", dir=self.spill_dir
         ) as tmp:
@@ -86,17 +95,25 @@ class ExternalTeraSorter:
                 # splitters after the FIRST chunk's sample plus any
                 # staged chunks — for uniformly shuffled inputs one
                 # chunk's quantiles are already unbiased; pathological
-                # orderings degrade bucket balance, not correctness.
-                splitters = None
+                # (sorted/clustered) orderings skew bucket fill, which
+                # pass 2 repairs by recursively re-splitting any bucket
+                # that outgrew the per-step working-set bound.
+                splitters = preset_splitters
+                max_chunk_records = 0  # per-call (reuse must not inflate)
+                total_records = 0
                 for keys, vals in chunks:
                     keys = np.asarray(keys)
                     vals = np.asarray(vals)
                     if dtype is None:
                         dtype = (keys.dtype, vals.dtype)
                     self.chunks_in += 1
+                    max_chunk_records = max(max_chunk_records, len(keys))
+                    total_records += len(keys)
                     sk, sv = self._device_sort(keys, vals)
                     n = len(sk)
-                    if n:
+                    if n and splitters is None:
+                        # samples are only ever consumed to MAKE the
+                        # splitters; once fixed (or preset) skip the work
                         step = max(1, n // self.sample_per_chunk)
                         samples.append(sk[::step])
                     if splitters is None:
@@ -118,18 +135,91 @@ class ExternalTeraSorter:
                     f.close()
             if dtype is None:
                 return
-            # pass 2: per-bucket device sort, in range order
+            # pass 2: per-bucket device sort, in range order.  A bucket
+            # that outgrew the working-set bound (adversarial input order
+            # froze the splitters on an unrepresentative sample) is NOT
+            # loaded whole: it is recursively re-split with splitters
+            # sampled from its own data, keeping every device step at
+            # O(max(chunk, balanced bucket)).
             kd, vd = dtype
             item = np.dtype([("k", kd), ("v", vd)])
+            # the promised working-set bound: a balanced bucket (with 2x
+            # slack for benign imbalance) or one chunk, whichever is
+            # larger — balanced buckets never re-split, only skew does
+            cap = max(
+                max_chunk_records,
+                2 * total_records // self.num_buckets,
+                1,
+            )
             for p in paths:
                 size = os.path.getsize(p)
                 if size == 0:
+                    continue
+                n_rec = size // item.itemsize
+                if (n_rec > cap and self.num_buckets > 1
+                        and self.max_split_depth > 0):
+                    yield from self._resplit_bucket(p, item, cap)
                     continue
                 rec = np.fromfile(p, dtype=item)
                 self.max_bucket_records = max(
                     self.max_bucket_records, len(rec)
                 )
                 yield self._device_sort(rec["k"], rec["v"])
+
+    def _resplit_bucket(
+        self, path: str, item: np.dtype, cap: int
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Re-sort one oversized bucket file through a child sorter,
+        streaming it back in ≤cap-record chunks.  Unlike the parent
+        (which froze splitters on its first chunk's sample), the child
+        gets splitters from a strided sample of the ENTIRE file — the
+        data is already on disk, so a representative sample is one
+        sequential scan away and re-split buckets come out balanced even
+        for sorted/clustered input."""
+        child = ExternalTeraSorter(
+            self.mesh,
+            num_buckets=self.num_buckets,
+            sample_per_chunk=self.sample_per_chunk,
+            spill_dir=self.spill_dir,
+            max_split_depth=self.max_split_depth - 1,
+        )
+        n_rec = os.path.getsize(path) // item.itemsize
+        want = self.sample_per_chunk * self.num_buckets
+        stride = max(1, n_rec // max(want, 1))
+        # memmap so sampling pages in only the touched records, not the
+        # whole oversized file (that being too big is why we're here)
+        mm = np.memmap(path, dtype=item, mode="r")
+        keys = np.array(mm["k"][::stride])
+        del mm
+        splitters = child._make_splitters([np.sort(keys)])
+        if len(splitters) == 0 or (splitters == splitters[0]).all():
+            # duplicate-heavy bucket: identical splitters would route
+            # everything into one child bucket again — recursion makes
+            # no progress, so load-and-sort whole without burning
+            # max_split_depth passes of disk churn first
+            rec = np.fromfile(path, dtype=item)
+            self.max_bucket_records = max(self.max_bucket_records, len(rec))
+            yield self._device_sort(rec["k"], rec["v"])
+            return
+        self.buckets_resplit += 1
+
+        def chunk_reader():
+            with open(path, "rb") as f:
+                while True:
+                    raw = f.read(cap * item.itemsize)
+                    if not raw:
+                        return
+                    rec = np.frombuffer(raw, dtype=item)
+                    yield rec["k"], rec["v"]
+
+        yield from child.sort_chunks(
+            chunk_reader(), preset_splitters=splitters
+        )
+        self.max_bucket_records = max(
+            self.max_bucket_records, child.max_bucket_records
+        )
+        self.bytes_spilled += child.bytes_spilled
+        self.buckets_resplit += child.buckets_resplit
 
     def _make_splitters(self, samples) -> np.ndarray:
         if not samples:

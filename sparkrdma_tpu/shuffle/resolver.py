@@ -73,7 +73,9 @@ class ShuffleBlockResolver:
         self.stage_to_device = stage_to_device
         self.staging_pool = staging_pool  # pooled host buffers for concat
         # commits >= this many bytes go to an mmapped file segment (the
-        # RdmaMappedFile path); 0 keeps everything in memory/HBM
+        # RdmaMappedFile path); 0 disables the size trigger — but a
+        # writer whose output spilled still commits file-backed via
+        # ``prefer_file_backed`` (its data is already on disk)
         self.file_backed_threshold = file_backed_threshold
         self.spill_dir = spill_dir
         self._shuffles: Dict[int, _ShuffleData] = {}
@@ -94,15 +96,23 @@ class ShuffleBlockResolver:
         shuffle_id: int,
         map_id: int,
         partition_bytes: Sequence,
+        prefer_file_backed: bool = False,
     ) -> MapTaskOutput:
         """Stage one map task's serialized partitions into a registered
         segment and build its location table.  Each partition payload is
         ``bytes`` or a :class:`ChunkedPayload` (spill-merge commits
-        stream their chunks — nothing is pre-joined in RAM)."""
+        stream their chunks — nothing is pre-joined in RAM).
+
+        ``prefer_file_backed`` routes the commit to the mmap path even
+        below ``file_backed_threshold`` — set by writers whose output
+        already spilled to disk, so the commit never re-materializes in
+        one in-memory buffer what spilling was bounding."""
         num_partitions = len(partition_bytes)
         sd = self._get_or_create(shuffle_id, num_partitions)
         total = sum(_payload_len(b) for b in partition_bytes)
-        if self.file_backed_threshold and total >= self.file_backed_threshold:
+        if prefer_file_backed or (
+            self.file_backed_threshold and total >= self.file_backed_threshold
+        ):
             return self._commit_file_backed(
                 sd, shuffle_id, map_id, partition_bytes, total
             )

@@ -205,7 +205,10 @@ class ShuffleWriter:
             _payload_len(b) for b in partition_bytes
         )
         mto = self.manager.resolver.commit_map_output(
-            self.handle.shuffle_id, self.map_id, partition_bytes
+            self.handle.shuffle_id, self.map_id, partition_bytes,
+            # spilled output is already on disk: commit via the mmap
+            # path so peak memory stays bounded by the spill threshold
+            prefer_file_backed=self._spill_file is not None,
         )
         self.manager.publish_map_output(self.handle.shuffle_id, self.map_id, mto)
         self.metrics.write_time_ms += (time.monotonic() - t0) * 1000

@@ -78,8 +78,15 @@ class CompressedSerializer(Serializer):
     outputs are CONCATENATION-SAFE like the inner serializer's — the
     writer's spill-merge and any block concatenation rely on this
     (plain ``zlib.decompress`` would silently discard trailing frames).
+
+    Wire-format versioning: this framed layout is
+    ``WIRE_FORMAT_VERSION`` 2 (v1 was unframed ``1B tag + body``).  Any
+    future layout change MUST claim fresh codec tag values so that
+    mixed-version data fails fast on the existing "unknown codec tag"
+    check instead of decoding garbage — tags 0-2 are forever v2.
     """
 
+    WIRE_FORMAT_VERSION = 2
     _RAW, _ZLIB, _LZMA = 0, 1, 2
 
     def __init__(self, inner: Serializer = None, codec: str = "zlib",

@@ -192,3 +192,17 @@ def test_arena_unbudgeted_file_segment():
         pass
     arena.release(seg.mkey)
     assert arena.stats()["file_bytes"] == 0
+
+
+def test_mapped_file_empty_input(tmp_path):
+    # advisor finding: a zero-byte chunk stream must still map (the
+    # segment serves only EMPTY locations, but construction can't raise)
+    from sparkrdma_tpu.memory.mapped_file import MappedFile
+
+    mf = MappedFile([], directory=str(tmp_path))
+    try:
+        assert mf.array.shape == (1,)
+        assert mf.array[0] == 0
+    finally:
+        mf.free()
+    assert not list(tmp_path.iterdir()), "file must be unlinked on free"

@@ -354,3 +354,71 @@ def test_external_sort_empty_and_single(mesh, devices):
         np.array([5], np.int32), np.array([7], np.int32)
     )
     assert k.tolist() == [5] and v.tolist() == [7]
+
+
+def test_external_sort_resplits_sorted_input(mesh, devices):
+    """Adversarial (already sorted) input freezes the first-chunk
+    splitters on an unrepresentative sample; pass 2 must re-split the
+    oversized bucket instead of loading it whole (advisor finding)."""
+    from sparkrdma_tpu.models.external_sort import ExternalTeraSorter
+
+    n_chunk, n_chunks = 2000, 8
+    keys = np.arange(n_chunk * n_chunks, dtype=np.int32)
+    vals = keys[::-1].copy()
+
+    def chunks():
+        for c in range(n_chunks):
+            sl = slice(c * n_chunk, (c + 1) * n_chunk)
+            yield keys[sl], vals[sl]
+
+    ext = ExternalTeraSorter(mesh, num_buckets=8, sample_per_chunk=256)
+    outs = list(ext.sort_chunks(chunks()))
+    got_k = np.concatenate([k for k, _ in outs])
+    got_v = np.concatenate([v for _, v in outs])
+    np.testing.assert_array_equal(got_k, keys)
+    np.testing.assert_array_equal(got_v, vals)
+    # sorted input routes chunks 2..N into the last range bucket; the
+    # re-split must both trigger and restore the working-set bound
+    assert ext.buckets_resplit >= 1
+    assert ext.max_bucket_records <= n_chunk
+
+
+def test_external_sort_balanced_input_no_resplit(mesh, devices):
+    """Balanced buckets larger than one chunk must NOT trigger the
+    re-split path (the bound is max(chunk, balanced bucket))."""
+    from sparkrdma_tpu.models.external_sort import ExternalTeraSorter
+
+    rng = np.random.default_rng(51)
+    # 16 chunks of 1000 into 4 buckets: balanced buckets hold ~4000
+    # records, well over one chunk — still no re-split
+    ext = ExternalTeraSorter(mesh, num_buckets=4, sample_per_chunk=512)
+    ks = rng.integers(0, 1 << 30, (16, 1000)).astype(np.int32)
+    outs = list(ext.sort_chunks((k, k.copy()) for k in ks))
+    got = np.concatenate([k for k, _ in outs])
+    np.testing.assert_array_equal(got, np.sort(ks.reshape(-1)))
+    assert ext.buckets_resplit == 0
+
+
+def test_external_sort_duplicate_heavy_bucket(mesh, devices):
+    """An all-one-key bucket cannot be split by key; the re-split must
+    detect no-progress and fall back to a whole load instead of
+    recursing max_split_depth times over the same file."""
+    from sparkrdma_tpu.models.external_sort import ExternalTeraSorter
+
+    keys = np.concatenate([
+        np.arange(2000, dtype=np.int32),          # chunk 1: spread
+        np.full(14000, 7_000_000, np.int32),      # chunks 2..8: one key
+    ])
+    vals = np.arange(len(keys), dtype=np.int32)
+    ext = ExternalTeraSorter(mesh, num_buckets=8, sample_per_chunk=128)
+    outs = list(ext.sort_chunks(
+        (keys[i:i + 2000], vals[i:i + 2000])
+        for i in range(0, len(keys), 2000)
+    ))
+    got_k = np.concatenate([k for k, _ in outs])
+    got_v = np.concatenate([v for _, v in outs])
+    order = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(got_k, keys[order])
+    assert sorted(got_v.tolist()) == sorted(vals.tolist())
+    # the degenerate bucket loaded whole exactly once (no useless churn)
+    assert ext.buckets_resplit == 0

@@ -296,6 +296,11 @@ def test_writer_spill_roundtrip(devices, tmp_path):
         assert not list(tmp_path.glob("sparkrdma_tpu_spill_*")), (
             "spill file must be deleted after commit"
         )
+        # a spilled commit routes to the mmap (file-backed) path so peak
+        # memory stays bounded by the spill threshold
+        assert ex.arena.stats()["file_bytes"] > 0, (
+            "spilled commit should be file-backed"
+        )
         got = []
         for pid in range(4):
             r = ex.get_reader(handle, pid, pid + 1, {ex.local_smid: [0]})
