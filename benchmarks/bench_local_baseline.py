@@ -3,10 +3,17 @@
 
 The reference measures Spark local[*] with the stock SortShuffleManager
 as its CPU-only control (BASELINE.md config 1).  Here the same job —
-groupByKey over (key, payload) records — runs through our full record
-plane: write → publish → resolve → fetch → read over the loopback
-transport, with every executor in one process.  The metric is
-end-to-end shuffled payload bytes per second on the record (host) plane;
+groupByKey over (key, 64B payload) records — runs through our full
+record plane: write → publish → resolve → fetch → read over the
+loopback transport, with every executor in one process.
+
+The record plane is COLUMNAR (conf ``serializer=columnar``): records
+travel as fixed-width key/value columns, partitioning and grouping are
+numpy kernels plus the native prefetching row gather, and blocks are
+committed key-sorted so readers merge views — the unsafe-row analog of
+the reference wrapping Spark's UnsafeShuffleWriter
+(RdmaWrapperShuffleWriter.scala:85-101).  The metric is end-to-end
+shuffled payload bytes per second on the record (host) plane;
 ``vs_baseline`` is vs the RoCE line rate the reference's NIC plane is
 bounded by (the record plane is NOT expected to reach it — that is the
 device plane's job, configs 3-5).
@@ -21,30 +28,36 @@ sys.path.insert(0, ".")
 from benchmarks.common import ROCE_LINE_RATE_GBPS, emit
 
 from sparkrdma_tpu.api import TpuShuffleContext
+from sparkrdma_tpu.conf import TpuShuffleConf
 
-N_RECORDS = 200_000
+N_RECORDS = 1_000_000
 PAYLOAD = 64  # bytes per record
 N_KEYS = 512
+REPS = 5
 
 
 def main():
     rng = np.random.default_rng(0)
-    keys = rng.integers(0, N_KEYS, N_RECORDS)
-    payload = bytes(PAYLOAD)
-    records = [(int(k), payload) for k in keys]
+    keys = rng.integers(0, N_KEYS, N_RECORDS).astype(np.int64)
+    vals = np.frombuffer(rng.bytes(N_RECORDS * PAYLOAD), dtype=f"S{PAYLOAD}")
+    conf = TpuShuffleConf({"spark.shuffle.tpu.serializer": "columnar"})
 
-    with TpuShuffleContext(num_executors=4, stage_to_device=False) as ctx:
-        ds = ctx.parallelize(records, num_slices=8)
-        t0 = time.perf_counter()
-        out = ds.group_by_key(num_partitions=8).collect()
-        dt = time.perf_counter() - t0
+    with TpuShuffleContext(num_executors=4, conf=conf,
+                           stage_to_device=False) as ctx:
+        ds = ctx.parallelize_columns(keys, vals, num_slices=8)
+        out = ds.group_by_key(num_partitions=8).collect()  # warm + check
+        assert len(out) == N_KEYS, f"expected {N_KEYS} groups, got {len(out)}"
+        assert sum(len(vs) for _, vs in out) == N_RECORDS
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            ds.group_by_key(num_partitions=8).collect()
+            best = min(best, time.perf_counter() - t0)
 
-    assert len(out) == N_KEYS, f"expected {N_KEYS} groups, got {len(out)}"
-    assert sum(len(vs) for _, vs in out) == N_RECORDS
-    gbps = N_RECORDS * PAYLOAD / dt / 1e9
+    gbps = N_RECORDS * PAYLOAD / best / 1e9
     emit(
-        f"local[*] groupByKey record-plane throughput ({N_RECORDS} x "
-        f"{PAYLOAD}B records)",
+        f"local[*] groupByKey columnar record-plane throughput "
+        f"({N_RECORDS} x {PAYLOAD}B records)",
         gbps, "GB/s", gbps / ROCE_LINE_RATE_GBPS,
     )
 

@@ -31,14 +31,21 @@ from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from sparkrdma_tpu.conf import TpuShuffleConf
-from sparkrdma_tpu.shuffle.manager import Aggregator, TpuShuffleManager
+from sparkrdma_tpu.shuffle.manager import (
+    Aggregator,
+    ColumnarAggregator,
+    TpuShuffleManager,
+)
 from sparkrdma_tpu.shuffle.partitioner import (
     HashPartitioner,
     Partitioner,
     RangePartitioner,
 )
 from sparkrdma_tpu.transport import LoopbackNetwork
+from sparkrdma_tpu.utils.columns import ColumnBatch
 
 
 class TpuShuffleContext:
@@ -89,6 +96,24 @@ class TpuShuffleContext:
         size = (len(items) + n - 1) // n
         parts = [items[i * size : (i + 1) * size] for i in range(n)]
         return Dataset(self, [p for p in parts])
+
+    def parallelize_columns(self, keys, vals,
+                            num_slices: Optional[int] = None) -> "Dataset":
+        """Columnar dataset from parallel (keys, vals) arrays — the
+        record plane's fast path (set conf ``serializer=columnar`` so
+        the shuffle stays columnar end to end).  Wide ops on the result
+        run as vectorized numpy kernels instead of per-record Python."""
+        keys = np.asarray(keys)
+        vals = np.asarray(vals)
+        whole = ColumnBatch(keys, vals)  # validates shape/dtype
+        n = num_slices or len(self.executors) * 2
+        n = max(1, min(n, max(1, len(whole))))
+        bounds = [(i * len(whole)) // n for i in range(n + 1)]
+        parts = [
+            ColumnBatch(keys[lo:hi], vals[lo:hi])
+            for lo, hi in zip(bounds, bounds[1:])
+        ]
+        return Dataset(self, parts)
 
     # -- device-native workloads (the MXU/ICI plane) ------------------------
     def device_sort(self, keys, vals=None, mesh=None):
@@ -247,6 +272,16 @@ class Dataset:
         return len(self._parts)
 
     # -- wide transformations ------------------------------------------------
+    @property
+    def _is_columnar(self) -> bool:
+        """True when partitions are ColumnBatch columns with no pending
+        tuple-level narrow transform (which would de-columnarize)."""
+        return (
+            self._transform is None
+            and bool(self._parts)
+            and all(isinstance(p, ColumnBatch) for p in self._parts)
+        )
+
     def _shuffled(self, partitioner, **kw) -> "Dataset":
         parts = self._materialize()
         out = self.ctx.run_shuffle(parts, partitioner, **kw)
@@ -255,23 +290,35 @@ class Dataset:
     def partition_by(self, num_partitions: int) -> "Dataset":
         return self._shuffled(HashPartitioner(num_partitions))
 
-    def reduce_by_key(self, f: Callable[[Any, Any], Any],
+    def reduce_by_key(self, f,
                       num_partitions: Optional[int] = None) -> "Dataset":
-        agg = Aggregator(
-            create_combiner=lambda v: v, merge_value=f, merge_combiners=f
-        )
+        """``f`` is a binary combiner; a columnar dataset also accepts
+        the vectorizable names ``"sum"``/``"min"``/``"max"`` (required
+        to stay on the columnar fast path)."""
         n = num_partitions or self.num_partitions
+        if isinstance(f, str):
+            agg: Aggregator = ColumnarAggregator.reduce(f)
+        else:
+            agg = Aggregator(
+                create_combiner=lambda v: v, merge_value=f, merge_combiners=f
+            )
         return self._shuffled(
             HashPartitioner(n), aggregator=agg, map_side_combine=True
         )
 
     def group_by_key(self, num_partitions: Optional[int] = None) -> "Dataset":
+        n = num_partitions or self.num_partitions
+        if self._is_columnar:
+            # no map-side combine: grouping collects rather than
+            # reduces, so combining would only concatenate columns
+            return self._shuffled(
+                HashPartitioner(n), aggregator=ColumnarAggregator.group(),
+            )
         agg = Aggregator(
             create_combiner=lambda v: [v],
             merge_value=lambda c, v: c + [v],
             merge_combiners=lambda a, b: a + b,
         )
-        n = num_partitions or self.num_partitions
         return self._shuffled(
             HashPartitioner(n), aggregator=agg, map_side_combine=True
         )
@@ -281,12 +328,22 @@ class Dataset:
         """Range-partitioned global sort: concatenating the output
         partitions in order yields the sorted data."""
         parts = self._materialize()
-        keys = [k for part in parts for k, _ in part]
         n = num_partitions or self.num_partitions
         rng = random.Random(seed)
-        sample = (
-            rng.sample(keys, min(sample_size, len(keys))) if keys else []
-        )
+        if parts and all(isinstance(p, ColumnBatch) for p in parts):
+            all_keys = np.concatenate([p.keys for p in parts])
+            if len(all_keys):
+                idx = rng.sample(
+                    range(len(all_keys)), min(sample_size, len(all_keys))
+                )
+                sample = all_keys[np.asarray(idx)].tolist()
+            else:
+                sample = []
+        else:
+            keys = [k for part in parts for k, _ in part]
+            sample = (
+                rng.sample(keys, min(sample_size, len(keys))) if keys else []
+            )
         ds = Dataset(self.ctx, parts)
         return ds._shuffled(RangePartitioner(n, sample), key_ordering=True)
 

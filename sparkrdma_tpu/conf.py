@@ -139,6 +139,13 @@ class TpuShuffleConf:
         return str(self.get("compressCodec", "zlib"))
 
     @property
+    def serializer_name(self) -> str:
+        """Record serializer: ``pickle`` (default; arbitrary objects) or
+        ``columnar`` (fixed-width key/value columns, the unsafe-row
+        analog — the record plane's fast path)."""
+        return str(self.get("serializer", "")).lower()
+
+    @property
     def lazy_staging(self) -> bool:
         """ODP analog (reference: useOdp, RdmaShuffleConf.scala:68-83):
         keep committed map output in host memory and stage to HBM on

@@ -34,13 +34,15 @@ class DeviceSegment:
     released; its ``free()`` is called exactly once on release."""
 
     def __init__(self, mkey: int, array, shuffle_id: Optional[int] = None,
-                 keepalive=None, budgeted: bool = True):
+                 keepalive=None, budgeted: bool = True,
+                 zero_copy_ok: bool = False):
         self.mkey = mkey
         self.array = array  # jax.Array uint8[nbytes] (or np.ndarray on host)
         self.nbytes = int(array.shape[0])
         self.shuffle_id = shuffle_id
         self.keepalive = keepalive
         self.budgeted = budgeted
+        self.zero_copy_ok = zero_copy_ok
         self.created_at = time.monotonic()
 
     def _release_keepalive(self) -> None:
@@ -51,13 +53,25 @@ class DeviceSegment:
             except Exception:
                 pass
 
-    def read(self, offset: int, length: int) -> bytes:
+    def read(self, offset: int, length: int):
+        """Serve one block.  Host-resident segments (plain numpy or
+        mmap) return a ZERO-COPY read-only view — safe because the view
+        keeps the backing buffer alive by refcount after release (the
+        reference's zero-copy DirectByteBuffer serving,
+        RdmaMappedFile.java:225-229).  Device segments materialize a
+        host copy (the device→host transfer is the copy).  Pool-backed
+        host buffers must NOT be registered with ``zero_copy_ok`` —
+        the pool reuses freed memory under live views."""
         end = offset + length
         if offset < 0 or end > self.nbytes:
             raise TransportError(
                 f"read [{offset},{end}) outside segment mkey={self.mkey} "
                 f"of {self.nbytes}B"
             )
+        if self.zero_copy_ok:
+            view = self.array[offset:end].view()
+            view.flags.writeable = False
+            return view
         return bytes(np.asarray(self.array[offset:end]))
 
 
@@ -76,13 +90,19 @@ class ArenaManager(BlockStore):
         self._released_ever = 0
 
     def register(self, array, shuffle_id: Optional[int] = None,
-                 keepalive=None, budgeted: bool = True) -> DeviceSegment:
+                 keepalive=None, budgeted: bool = True,
+                 zero_copy_ok: bool = False) -> DeviceSegment:
         """Register a 1-D uint8 array as a readable segment.
 
         ``budgeted=False`` registers without debiting the byte budget —
         for file-backed (mmap) segments whose pages live in the OS
         cache, not the arena's memory (their bytes are tracked in the
-        ``file_bytes`` stat instead)."""
+        ``file_bytes`` stat instead).
+
+        ``zero_copy_ok`` lets reads serve views into ``array`` — ONLY
+        safe when the backing memory is never recycled while Python
+        references exist (plain numpy buffers, read-only mmaps; NOT
+        pooled staging buffers)."""
         if array.ndim != 1 or str(array.dtype) != "uint8":
             raise ValueError(
                 f"segments must be 1-D uint8, got {array.shape} {array.dtype}"
@@ -98,7 +118,7 @@ class ArenaManager(BlockStore):
             mkey = self._next_mkey
             self._next_mkey += 1
             seg = DeviceSegment(mkey, array, shuffle_id, keepalive=keepalive,
-                                budgeted=budgeted)
+                                budgeted=budgeted, zero_copy_ok=zero_copy_ok)
             self._segments[mkey] = seg
             if budgeted:
                 self._total_bytes += nbytes

@@ -47,10 +47,41 @@ def _load_native():
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)
     ]
     lib.staging_pool_trim.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    if hasattr(lib, "row_gather"):
+        lib.row_gather.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
     return lib
 
 
 _NATIVE = _load_native()
+
+
+def native_row_gather(src: np.ndarray, idx: np.ndarray,
+                      out: np.ndarray) -> bool:
+    """``out[i] = src[idx[i]]`` via the prefetching C gather.  Returns
+    False (caller falls back to ``np.take``) when the native lib is
+    absent or the arrays don't qualify: src/out must be 1-D, same
+    dtype, contiguous (an unaligned uint8-view is fine — only the
+    stride matters); idx must be contiguous int64 within range."""
+    if _NATIVE is None or not hasattr(_NATIVE, "row_gather"):
+        return False
+    if (
+        src.ndim != 1 or out.ndim != 1 or idx.ndim != 1
+        or src.dtype != out.dtype
+        or idx.dtype != np.int64
+        or out.shape[0] != idx.shape[0]
+        or src.strides[0] != src.dtype.itemsize
+        or out.strides[0] != out.dtype.itemsize
+        or idx.strides[0] != 8
+    ):
+        return False
+    _NATIVE.row_gather(
+        src.ctypes.data, out.ctypes.data, idx.ctypes.data,
+        idx.shape[0], src.dtype.itemsize,
+    )
+    return True
 
 
 class StagingBuffer:

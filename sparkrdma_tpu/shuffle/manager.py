@@ -72,6 +72,48 @@ class Aggregator:
 
 
 @dataclass
+class ColumnarAggregator(Aggregator):
+    """Aggregator the columnar plane can vectorize.
+
+    ``kind`` names the combine: ``"group"`` (group_by_key — values
+    collected per key) or a reduction ``"sum"``/``"min"``/``"max"``.
+    The inherited scalar callables keep tuple-plane interop working, so
+    a ColumnarAggregator is always safe to hand to the generic path."""
+
+    kind: str = "group"
+
+    _REDUCERS = {
+        "sum": (lambda a, b: a + b),
+        "min": min,
+        "max": max,
+    }
+
+    @classmethod
+    def group(cls) -> "ColumnarAggregator":
+        return cls(
+            create_combiner=lambda v: [v],
+            merge_value=lambda c, v: c + [v],
+            merge_combiners=lambda a, b: a + b,
+            kind="group",
+        )
+
+    @classmethod
+    def reduce(cls, kind: str) -> "ColumnarAggregator":
+        if kind not in cls._REDUCERS:
+            raise ValueError(
+                f"unknown columnar reduction {kind!r} "
+                f"(have {sorted(cls._REDUCERS)})"
+            )
+        f = cls._REDUCERS[kind]
+        return cls(
+            create_combiner=lambda v: v,
+            merge_value=f,
+            merge_combiners=f,
+            kind=kind,
+        )
+
+
+@dataclass
 class ShuffleHandle:
     """Returned by register_shuffle; carried to writers and readers
     (reference: Serialized/BaseShuffleHandle selection,
@@ -136,10 +178,22 @@ class TpuShuffleManager:
         self.executor_id = executor_id
         if serializer is not None:
             self.serializer = serializer
-        elif conf.compress:
-            self.serializer = CompressedSerializer(codec=conf.compress_codec)
         else:
-            self.serializer = PickleSerializer()
+            name = conf.serializer_name
+            if name == "columnar":
+                from sparkrdma_tpu.utils.serde import ColumnarSerializer
+
+                inner: Serializer = ColumnarSerializer()
+            elif name in ("", "pickle"):
+                inner = PickleSerializer()
+            else:
+                raise ValueError(
+                    f"unknown serializer {name!r} (want columnar|pickle)"
+                )
+            self.serializer = (
+                CompressedSerializer(inner, codec=conf.compress_codec)
+                if conf.compress else inner
+            )
         self.stats = ShuffleReaderStats(conf) if conf.collect_shuffle_reader_stats else None
 
         if is_driver:

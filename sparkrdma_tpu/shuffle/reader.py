@@ -127,7 +127,7 @@ class ShuffleReader:
                         )
                         self.metrics.local_blocks += 1
                         self.metrics.local_bytes += len(data)
-                        if data:
+                        if len(data):  # ndarray views: no bool()
                             local_payloads.append(data)
                 continue
 
@@ -278,16 +278,13 @@ class ShuffleReader:
         self._results.put(_Result(error=err))
 
     # -- consumption --------------------------------------------------------
-    def _iter_raw(self) -> Iterator[Record]:
-        """Blocking consume: local payloads first, then remote completions
-        (hasNext/next, RdmaShuffleFetcherIterator.scala:332-374)."""
+    def _iter_block_bytes(self) -> Iterator[bytes]:
+        """Blocking consume of raw block payloads: local first, then
+        remote completions (hasNext/next,
+        RdmaShuffleFetcherIterator.scala:332-374)."""
         try:
             local_payloads = self._start_remote_fetches()
-            deser = self.manager.serializer.deserialize
-            for data in local_payloads:
-                for rec in deser(data):
-                    self.metrics.records_read += 1
-                    yield rec
+            yield from local_payloads
             while True:
                 with self._pending_lock:
                     if (
@@ -308,13 +305,18 @@ class ShuffleReader:
                 for data in res.blocks:
                     self.metrics.remote_blocks += 1
                     self.metrics.remote_bytes += len(data)
-                    for rec in deser(data):
-                        self.metrics.records_read += 1
-                        yield rec
+                    yield data
         finally:
             # runs on normal exhaustion, fetch failure, AND abandoned
             # iteration (GeneratorExit) — timers and callbacks never leak
             self._cleanup()
+
+    def _iter_raw(self) -> Iterator[Record]:
+        deser = self.manager.serializer.deserialize
+        for data in self._iter_block_bytes():
+            for rec in deser(data):
+                self.metrics.records_read += 1
+                yield rec
 
     def _cleanup(self) -> None:
         for t in self._timers:
@@ -322,11 +324,68 @@ class ShuffleReader:
         for cb_id in self._callback_ids:
             self.manager.unregister_fetch_callback(cb_id)
 
+    def _read_columnar(self) -> Iterator[Record]:
+        """Columnar read: blocks deserialize to column batches and the
+        aggregate/sort stage runs as numpy kernels — the read-side half
+        of the unsafe-row analog.  Yields (key, value) pairs where
+        group_by_key values are numpy arrays (the columnar stand-in for
+        the tuple plane's lists)."""
+        from sparkrdma_tpu.utils.columns import (
+            combine_columns,
+            concat_batches,
+            group_columns,
+            stable_key_order,
+        )
+
+        deser = self.manager.serializer.deserialize_columns
+        batches = []
+        total = 0
+        for data in self._iter_block_bytes():
+            for b in deser(data):
+                self.metrics.records_read += len(b)
+                total += len(b)
+                batches.append(b)
+        if total == 0:
+            return iter(())
+        agg = self.handle.aggregator
+        if agg is not None and agg.kind != "group":
+            # reduce each block first (key-sorted blocks reduce with no
+            # sort), then combine the shrunken remainders
+            reduced = [combine_columns(b, agg.kind) for b in batches]
+            batch = combine_columns(concat_batches(reduced), agg.kind)
+            # combine output is key-sorted, so key_ordering holds too
+            return iter(zip(batch.keys.tolist(), batch.vals.tolist()))
+        if agg is not None:
+            if all(b.key_sorted for b in batches):
+                from sparkrdma_tpu.utils.columns import merge_sorted_groups
+
+                per = [group_columns(b) for b in batches if len(b)]
+                entries = sum(len(uk) for uk, _ in per)
+                # per-key merge beats concat+gather only while the
+                # Python loop stays small next to the moved bytes
+                if entries <= max(1 << 15, total // 8):
+                    return merge_sorted_groups(per)
+            uk, groups = group_columns(concat_batches(batches))
+            return iter(zip(uk.tolist(), groups))
+        batch = concat_batches(batches)
+        if self.handle.key_ordering:
+            order = stable_key_order(batch.keys)
+            return iter(zip(
+                batch.keys[order].tolist(), batch.vals[order].tolist()
+            ))
+        return iter(batch)
+
     def read(self) -> Iterator[Record]:
         """Full read path: fetch → deserialize → aggregate → sort
         (RdmaShuffleReader.scala:43-113)."""
-        records = self._iter_raw()
+        from sparkrdma_tpu.shuffle.manager import ColumnarAggregator
+
         agg = self.handle.aggregator
+        if getattr(self.manager.serializer, "supports_columns", False) and (
+            agg is None or isinstance(agg, ColumnarAggregator)
+        ):
+            return self._read_columnar()
+        records = self._iter_raw()
         if agg is not None:
             combined: Dict[Any, Any] = {}
             if self.handle.map_side_combine:

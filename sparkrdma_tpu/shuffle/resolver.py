@@ -46,7 +46,7 @@ def _payload_len(p) -> int:
 def _payload_chunks(p):
     if isinstance(p, ChunkedPayload):
         yield from p.chunks_fn()
-    elif p:
+    elif len(p):  # bytes OR ndarray views (no ndarray bool())
         yield p
 
 
@@ -117,9 +117,13 @@ class ShuffleBlockResolver:
                 sd, shuffle_id, map_id, partition_bytes, total
             )
         staging_buf = None
-        if self.staging_pool is not None and total > 0:
+        if self.stage_to_device and self.staging_pool is not None and total > 0:
             # serialize through the pooled, page-aligned native buffer —
-            # the registered-staging path (RdmaBuffer analog)
+            # the registered-staging path (RdmaBuffer analog).  Host-only
+            # commits deliberately AVOID the pool: their segments serve
+            # zero-copy read views, and pooled memory may be recycled
+            # while a view is still alive; plain numpy buffers are kept
+            # alive by the views themselves.
             try:
                 staging_buf = self.staging_pool.alloc(total)
                 buf = staging_buf.view
@@ -151,7 +155,10 @@ class ShuffleBlockResolver:
             # be returned to the pool while the device array can still
             # read through it
             seg = self.arena.register(
-                array, shuffle_id=shuffle_id, keepalive=staging_buf
+                array, shuffle_id=shuffle_id, keepalive=staging_buf,
+                # host commits are plain numpy (never pooled): reads may
+                # serve refcount-protected views
+                zero_copy_ok=not self.stage_to_device and staging_buf is None,
             )
         except BaseException:
             # register never took ownership: return the buffer ourselves
@@ -167,6 +174,44 @@ class ShuffleBlockResolver:
             else:
                 mto.put(pid, BlockLocation(o, n, seg.mkey))
         # install, releasing any superseded segment from a task retry
+        self._install(sd, map_id, mto, seg)
+        return mto
+
+    def commit_assembled(
+        self, shuffle_id: int, map_id: int, buf: np.ndarray,
+        ranges: Sequence[Tuple[int, int]],
+    ) -> MapTaskOutput:
+        """Commit a writer-assembled contiguous buffer: ``ranges[pid] =
+        (offset, length)`` within ``buf``.  The writer gathered records
+        straight into ``buf``, so this path adds NO further copy on the
+        host plane (the buffer itself becomes the registered segment);
+        device staging is the one ``jnp.asarray`` transfer."""
+        sd = self._get_or_create(shuffle_id, len(ranges))
+        total = int(buf.shape[0])
+        if self.file_backed_threshold and total >= self.file_backed_threshold:
+            return self._commit_file_backed(
+                sd, shuffle_id, map_id,
+                [buf[off : off + n] for off, n in ranges], total,
+            )
+        if self.stage_to_device:
+            import jax.numpy as jnp
+
+            array = jnp.asarray(buf if total else buf[:1])
+            zero_copy = False
+        else:
+            array = buf if total else np.zeros(1, np.uint8)
+            zero_copy = True
+        seg = self.arena.register(
+            array, shuffle_id=shuffle_id, zero_copy_ok=zero_copy
+        )
+        if self.node is not None:
+            self.node.register_block_store(seg.mkey, self.arena)
+        mto = MapTaskOutput(len(ranges))
+        for pid, (off, n) in enumerate(ranges):
+            mto.put(
+                pid,
+                BlockLocation.EMPTY if n == 0 else BlockLocation(off, n, seg.mkey),
+            )
         self._install(sd, map_id, mto, seg)
         return mto
 
@@ -187,9 +232,11 @@ class ShuffleBlockResolver:
             directory=self.spill_dir,
         )
         try:
+            # mmap reads may serve views: MappedFile.free defers closing
+            # the mapping while views are exported (BufferError path)
             seg = self.arena.register(
                 mf.array, shuffle_id=shuffle_id, keepalive=mf,
-                budgeted=False,
+                budgeted=False, zero_copy_ok=True,
             )
         except BaseException:
             mf.free()

@@ -18,10 +18,25 @@ import threading
 import time
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
+import numpy as np
+
 from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.utils.columns import (
+    ColumnBatch,
+    combine_columns,
+    concat_batches,
+    stable_key_order,
+    take_rows,
+)
 from sparkrdma_tpu.utils.serde import Record
 from sparkrdma_tpu.utils.trace import get_tracer
+
+
+def _chunked_payload(length: int, chunks_fn):
+    from sparkrdma_tpu.shuffle.resolver import ChunkedPayload
+
+    return ChunkedPayload(length, chunks_fn)
 
 
 class WriteMetrics:
@@ -50,6 +65,14 @@ class ShuffleWriter:
             else None
         )
         self._stopped = False
+        # columnar plane: per-partition ColumnBatch runs (created on the
+        # first columnar write; a writer is tuple- OR column-mode)
+        self._col_buckets: Optional[List[List[ColumnBatch]]] = None
+        # pending columnar writes: (batch, order, counts) with the
+        # gather DEFERRED — the no-spill commit gathers records straight
+        # into the final segment buffer (one copy total); spills
+        # materialize first
+        self._col_pending: Optional[List[Tuple[ColumnBatch, Optional[Any], Any]]] = None
         # spill state (Spark sort-shuffle spill role; 0 = disabled)
         self._spill_threshold = manager.conf.shuffle_spill_record_threshold
         self._records_in_memory = 0
@@ -60,8 +83,92 @@ class ShuffleWriter:
         ]
 
     # -- write --------------------------------------------------------------
-    def write(self, records: Iterable[Record]) -> None:
+    def write(self, records) -> None:
+        if isinstance(records, ColumnBatch):
+            # the columnar path needs BOTH a column-capable serializer
+            # (else ColumnBatch objects would be pickled whole and the
+            # reader's tuple unpack breaks) AND either no map-side
+            # combine or a vectorizable one; otherwise iterate the
+            # batch through the tuple plane (correct, just slow)
+            if getattr(self.manager.serializer, "supports_columns", False) \
+                    and (self._combined is None or getattr(
+                        self.handle.aggregator, "kind", None) is not None):
+                return self.write_columns(records)
+        self._write_records(records)
+
+    def write_columns(self, batch: ColumnBatch) -> None:
+        """Columnar fast path: one vectorized partition pass per batch —
+        the unsafe-row analog of keeping the reference's map-side hot
+        loop inside Spark's serialized-row writers
+        (RdmaWrapperShuffleWriter.scala:85-101).
+
+        Only the (pid, key) PERMUTATION is computed here; the expensive
+        record gather is deferred so the commit can gather straight into
+        the final segment buffer.  Sorting by key within each bucket
+        costs two cheap index sorts (stable_key_order rides the uint16
+        radix path for pids, and for modest-range keys) and lets readers
+        merge blocks as views instead of re-sorting."""
         t0 = time.monotonic()
+        if any(self._buckets) or any(self._combined or []):
+            raise TypeError(
+                "writer already holds tuple records; one map task must "
+                "stay on a single record plane"
+            )
+        P = self.handle.partitioner.num_partitions
+        if self._col_pending is None:
+            self._col_pending = []
+        n = len(batch)
+        if n == 0:
+            return
+        if P == 1:
+            counts = np.array([n], np.int64)
+            self._col_pending.append((batch, None, counts))
+        else:
+            pids = self.handle.partitioner.partition_array(batch.keys)
+            korder = stable_key_order(batch.keys)
+            porder = stable_key_order(pids[korder])
+            order = korder[porder]  # pid-major, key-sorted within
+            counts = np.bincount(pids, minlength=P).astype(np.int64)
+            self._col_pending.append((batch, order, counts))
+        self.metrics.records_written += n
+        self._records_in_memory += n
+        if (self._spill_threshold
+                and self._records_in_memory >= self._spill_threshold):
+            self.spill()
+        self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
+
+    def _materialize_pending(self) -> None:
+        """Gather pending columnar writes into per-partition batches
+        (the spill / combine / compressed-serializer path)."""
+        P = self.handle.partitioner.num_partitions
+        if self._col_buckets is None:
+            self._col_buckets = [[] for _ in range(P)]
+        if not self._col_pending:
+            self._col_pending = []
+            return
+        for batch, order, counts in self._col_pending:
+            if order is None:  # P == 1: whole batch, original order
+                self._col_buckets[0].append(batch)
+                continue
+            sk = take_rows(batch.keys, order)
+            sv = take_rows(batch.vals, order)
+            bounds = np.cumsum(counts)[:-1]
+            ksp = np.split(sk, bounds)
+            vsp = np.split(sv, bounds)
+            for pid in range(P):
+                if len(ksp[pid]):
+                    self._col_buckets[pid].append(
+                        ColumnBatch(ksp[pid], vsp[pid], key_sorted=True)
+                    )
+        self._col_pending = []
+
+    def _write_records(self, records: Iterable[Record]) -> None:
+        t0 = time.monotonic()
+        if self._col_buckets is not None or self._col_pending is not None:
+            raise TypeError(
+                "writer already holds columnar records; one map task "
+                "must stay on a single record plane"
+            )
         part = self.handle.partitioner.partition
         if self._combined is not None:
             agg = self.handle.aggregator
@@ -95,6 +202,8 @@ class ShuffleWriter:
         merge_combiners folds duplicate keys across spilled chunks."""
         if self._records_in_memory == 0:
             return
+        if self._col_pending:
+            self._materialize_pending()
         serializer = self.manager.serializer
         if self._spill_file is None:
             spill_dir = self.manager.conf.spill_dir
@@ -108,11 +217,12 @@ class ShuffleWriter:
             self._spill_path = path
         f = self._spill_file
         f.seek(0, os.SEEK_END)
-        sources = (
-            [d.items() if d else None for d in self._combined]
-            if self._combined is not None
-            else [b if b else None for b in self._buckets]
-        )
+        if self._col_buckets is not None:
+            sources = self._columnar_sources()
+        elif self._combined is not None:
+            sources = [d.items() if d else None for d in self._combined]
+        else:
+            sources = [b if b else None for b in self._buckets]
         for pid, src in enumerate(sources):
             if src is None:
                 continue
@@ -121,12 +231,40 @@ class ShuffleWriter:
             f.write(raw)
             self._spilled[pid].append((off, len(raw)))
             self.metrics.bytes_spilled += len(raw)
-        if self._combined is not None:
+        if self._col_buckets is not None:
+            self._col_buckets = [[] for _ in self._col_buckets]
+        elif self._combined is not None:
             self._combined = [dict() for _ in self._combined]
         else:
             self._buckets = [[] for _ in self._buckets]
         self._records_in_memory = 0
         self.metrics.spills += 1
+
+    def _columnar_sources(self) -> List[Optional[object]]:
+        """Per-partition serialize sources: a list of key-sorted batches
+        (one frame each — concatenation would cost a copy AND lose the
+        sortedness flag readers exploit), or one combined batch for
+        reducing aggregators."""
+        kind = (
+            getattr(self.handle.aggregator, "kind", None)
+            if self.handle.map_side_combine else None
+        )
+        out: List[Optional[object]] = []
+        for batches in self._col_buckets:
+            if not batches:
+                out.append(None)
+            elif kind is None or kind == "group":
+                out.append(batches)
+            else:
+                # per-batch combine first: key-sorted batches reduce
+                # without a sort, and the re-combine input is tiny
+                reduced = [combine_columns(b, kind) for b in batches]
+                b = (
+                    reduced[0] if len(reduced) == 1
+                    else combine_columns(concat_batches(reduced), kind)
+                )
+                out.append(b if len(b) else None)
+        return out
 
     def _iter_partition_chunks(self, pid: int, final: bytes):
         """Yield a partition's spilled chunks (read back one at a time)
@@ -170,7 +308,35 @@ class ShuffleWriter:
     def _commit(self) -> MapTaskOutput:
         t0 = time.monotonic()
         serializer = self.manager.serializer
-        if self._combined is not None:
+        if self._col_pending is not None or self._col_buckets is not None:
+            kind = (
+                getattr(self.handle.aggregator, "kind", None)
+                if self.handle.map_side_combine else None
+            )
+            if (
+                self._spill_file is None
+                and (self._col_buckets is None
+                     or not any(self._col_buckets))
+                and (kind is None or kind == "group")
+                and getattr(serializer, "frame_header", None) is not None
+            ):
+                return self._commit_direct(t0)
+            self._materialize_pending()
+        if self._col_buckets is not None:
+            chunked = getattr(serializer, "serialize_chunks", None)
+            if chunked is not None and self._spill_file is None:
+                # zero-copy commit: headers + uint8 column views stream
+                # straight into the resolver's staging buffer
+                return self._commit_payloads([
+                    _chunked_payload(*chunked(src)) if src is not None
+                    else b""
+                    for src in self._columnar_sources()
+                ], t0)
+            finals = [
+                serializer.serialize(src) if src is not None else b""
+                for src in self._columnar_sources()
+            ]
+        elif self._combined is not None:
             finals = [
                 serializer.serialize(d.items()) if d else b""
                 for d in self._combined
@@ -199,6 +365,63 @@ class ShuffleWriter:
                     ))
         else:
             partition_bytes = finals
+        return self._commit_payloads(partition_bytes, t0)
+
+    def _commit_direct(self, t0: float) -> MapTaskOutput:
+        """Zero-intermediate-copy columnar commit: lay all frames out in
+        ONE buffer and gather each column straight into place with the
+        deferred (pid, key) permutation — records touch host memory once
+        between the user's arrays and the registered segment."""
+        ser = self.manager.serializer
+        P = self.handle.partitioner.num_partitions
+        frames = []  # (pid, batch, order, lo, cnt, header)
+        pid_sizes = np.zeros(P + 1, np.int64)
+        for batch, order, counts in (self._col_pending or []):
+            kitem = batch.keys.dtype.itemsize
+            vitem = batch.vals.dtype.itemsize
+            lo = 0
+            for pid in range(P):
+                cnt = int(counts[pid]) if pid < len(counts) else 0
+                if cnt:
+                    header = ser.frame_header(
+                        batch.keys.dtype, batch.vals.dtype, cnt,
+                        key_sorted=order is not None,
+                    )
+                    frames.append((pid, batch, order, lo, cnt, header))
+                    pid_sizes[pid + 1] += len(header) + cnt * (kitem + vitem)
+                lo += cnt
+        starts = np.cumsum(pid_sizes)
+        total = int(starts[P])
+        buf = np.empty(max(total, 1), np.uint8)
+        cursors = starts[:P].copy()
+        for pid, batch, order, lo, cnt, header in frames:
+            c = int(cursors[pid])
+            hl = len(header)
+            buf[c : c + hl] = np.frombuffer(header, np.uint8)
+            c += hl
+            for col in (batch.keys, batch.vals):
+                nb = cnt * col.dtype.itemsize
+                out = buf[c : c + nb].view(col.dtype)
+                if order is None:  # P == 1: original order, no gather
+                    np.copyto(out, col)
+                else:
+                    take_rows(col, order[lo : lo + cnt], out=out)
+                c += nb
+            cursors[pid] = c
+        ranges = [
+            (int(starts[p]), int(starts[p + 1] - starts[p])) for p in range(P)
+        ]
+        self.metrics.bytes_written = total
+        mto = self.manager.resolver.commit_assembled(
+            self.handle.shuffle_id, self.map_id, buf[:total], ranges,
+        )
+        self.manager.publish_map_output(
+            self.handle.shuffle_id, self.map_id, mto
+        )
+        self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
+        return mto
+
+    def _commit_payloads(self, partition_bytes, t0: float) -> MapTaskOutput:
         from sparkrdma_tpu.shuffle.resolver import _payload_len
 
         self.metrics.bytes_written = sum(

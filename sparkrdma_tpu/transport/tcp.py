@@ -210,7 +210,10 @@ class TcpChannel(Channel):
             body = bytearray(_RESP_HDR.pack(req_id, 0))
             for b in blocks:
                 body += _LEN.pack(len(b))
-                body += b
+                # blocks may be zero-copy ndarray views; memoryview
+                # appends raw bytes (bytearray += ndarray would
+                # dispatch to numpy broadcasting)
+                body += memoryview(b)
         except BaseException as e:
             body = bytearray(_RESP_HDR.pack(req_id, 1))
             body += str(e).encode("utf-8", "replace")

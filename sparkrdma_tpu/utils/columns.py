@@ -1,0 +1,196 @@
+"""Columnar record batches: the unsafe-row analog for the record plane.
+
+The reference keeps its map-side hot loop off slow object paths by
+wrapping Spark's ``UnsafeShuffleWriter`` — records stay in serialized
+row form end to end (RdmaWrapperShuffleWriter.scala:85-101).  The
+TPU-native record plane gets the same property from columns: a
+:class:`ColumnBatch` holds one batch of (key, value) records as two
+parallel numpy arrays, so partitioning, serialization, combining, and
+grouping are all vectorized numpy kernels instead of per-record Python.
+
+Value columns may be any fixed-width dtype — numeric, ``|SN`` byte
+strings (the classic 10-90 byte shuffle payload), or structured rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class ColumnBatch:
+    """One batch of records as parallel (keys, vals) columns.
+
+    ``key_sorted`` marks a batch whose rows are already in ascending
+    key order — writers set it after map-side bucket sorting, it rides
+    the wire in the frame flags, and sorted-aware readers merge such
+    runs with views instead of re-sorting (the gather is the record
+    plane's most expensive kernel)."""
+
+    __slots__ = ("keys", "vals", "key_sorted")
+
+    def __init__(self, keys: np.ndarray, vals: np.ndarray,
+                 key_sorted: bool = False):
+        keys = np.asarray(keys)
+        vals = np.asarray(vals)
+        if keys.ndim != 1 or vals.ndim != 1 or keys.shape[0] != vals.shape[0]:
+            raise ValueError(
+                f"keys/vals must be equal-length 1-D columns, got "
+                f"{keys.shape} / {vals.shape}"
+            )
+        if keys.dtype.hasobject or vals.dtype.hasobject:
+            raise TypeError(
+                "object-dtype columns defeat the columnar plane; use the "
+                "tuple record path for non-fixed-width records"
+            )
+        if vals.dtype.kind == "S" and vals.dtype.itemsize:
+            # numpy bytes-strings ('S') strip trailing NULs on every
+            # element extraction, silently corrupting raw payloads;
+            # reinterpret as void rows of the same width — exact bytes,
+            # zero-copy.  (Keys keep 'S' semantics: their padded
+            # comparison is what hashing and ordering want.)
+            vals = vals.view(f"V{vals.dtype.itemsize}")
+        self.keys = keys
+        self.vals = vals
+        self.key_sorted = key_sorted
+
+    def __len__(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.keys.nbytes + self.vals.nbytes)
+
+    def __iter__(self) -> Iterator[Tuple]:
+        """Record view (slow path, for plane interop): yields Python
+        (key, value) scalars."""
+        yield from zip(self.keys.tolist(), self.vals.tolist())
+
+    @classmethod
+    def from_records(
+        cls,
+        records: Iterable[Tuple],
+        key_dtype=None,
+        val_dtype=None,
+    ) -> "ColumnBatch":
+        """Pack an iterable of (k, v) tuples into columns (dtype
+        inferred by numpy unless given)."""
+        ks: List = []
+        vs: List = []
+        for k, v in records:
+            ks.append(k)
+            vs.append(v)
+        keys = np.asarray(ks, dtype=key_dtype)
+        vals = np.asarray(vs, dtype=val_dtype)
+        return cls(keys, vals)
+
+
+def concat_batches(batches: List[ColumnBatch]) -> Optional[ColumnBatch]:
+    """Concatenate batches into one (None for an empty list)."""
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    return ColumnBatch(
+        np.concatenate([b.keys for b in batches]),
+        np.concatenate([b.vals for b in batches]),
+    )
+
+
+_REDUCE_UFUNCS = {
+    "sum": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def take_rows(col: np.ndarray, idx: np.ndarray,
+              out: Optional[np.ndarray] = None) -> np.ndarray:
+    """``col[idx]`` through the native prefetching row gather when
+    eligible (2.5-3x numpy on wide rows — the record plane's hottest
+    kernel); falls back to ``np.take``."""
+    from sparkrdma_tpu.memory.staging import native_row_gather
+
+    if out is None:
+        out = np.empty(idx.shape[0], col.dtype)
+    if not native_row_gather(col, idx, out):
+        np.take(col, idx, out=out)
+    return out
+
+
+def stable_key_order(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort choosing the fastest numpy path: integer keys
+    spanning < 2^16 values (partition ids, modest-cardinality group
+    keys) rebase to uint16 where numpy's stable sort is RADIX — measured
+    ~15x faster than the int64 timsort path (5.6ms vs 86ms per 1M)."""
+    if len(keys) and np.issubdtype(keys.dtype, np.integer):
+        kmin = keys.min()
+        if int(keys.max()) - int(kmin) < (1 << 16):
+            return np.argsort(
+                (keys - kmin).astype(np.uint16), kind="stable"
+            )
+    return np.argsort(keys, kind="stable")
+
+
+def _run_heads(sorted_keys: np.ndarray) -> np.ndarray:
+    """Indices of the first row of each key run in a key-sorted column."""
+    heads = np.empty(len(sorted_keys), bool)
+    heads[0] = True
+    np.not_equal(sorted_keys[1:], sorted_keys[:-1], out=heads[1:])
+    return np.flatnonzero(heads)
+
+
+def combine_columns(batch: ColumnBatch, kind: str) -> ColumnBatch:
+    """Vectorized reduce-by-key over one batch: sort by key, then one
+    ``ufunc.reduceat`` per run — the columnar combiner the tuple plane
+    does per record through ``Aggregator.merge_value``."""
+    if kind == "group":
+        return batch  # grouping collects, nothing to reduce map-side
+    ufunc = _REDUCE_UFUNCS[kind]
+    if len(batch) == 0:
+        return batch
+    if batch.key_sorted:
+        sk, sv = batch.keys, batch.vals
+    else:
+        order = stable_key_order(batch.keys)
+        sk = take_rows(batch.keys, order)
+        sv = take_rows(batch.vals, order)
+    idx = _run_heads(sk)
+    return ColumnBatch(sk[idx], ufunc.reduceat(sv, idx), key_sorted=True)
+
+
+def group_columns(batch: ColumnBatch) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Vectorized group-by-key: returns (unique_keys, per-key value
+    arrays) — group_by_key's output with numpy arrays standing in for
+    the tuple plane's Python lists.  A ``key_sorted`` batch skips the
+    sort+gather entirely (value arrays are then VIEWS into the batch)."""
+    if batch.key_sorted:
+        sk, sv = batch.keys, batch.vals
+    else:
+        order = stable_key_order(batch.keys)
+        sk = take_rows(batch.keys, order)
+        sv = take_rows(batch.vals, order)
+    idx = _run_heads(sk)
+    return sk[idx], np.split(sv, idx[1:])
+
+
+def merge_sorted_groups(
+    per_batch: List[Tuple[np.ndarray, List[np.ndarray]]],
+) -> Iterator[Tuple[Any, np.ndarray]]:
+    """Group-by-key over pre-grouped (unique_keys, value-views) runs —
+    the read-side merge for KEY-SORTED blocks, skipping the global
+    concat+gather (the record plane's most expensive kernel).  Worth it
+    when total unique keys is modest (the per-key Python loop); callers
+    guard on cardinality and fall back to ``group_columns`` over a
+    concat otherwise."""
+    groups: "dict" = {}
+    for uk, splits in per_batch:
+        for k, v in zip(uk.tolist(), splits):
+            lst = groups.get(k)
+            if lst is None:
+                groups[k] = [v]
+            else:
+                lst.append(v)
+    for k, vs in groups.items():
+        yield k, (vs[0] if len(vs) == 1 else np.concatenate(vs))
