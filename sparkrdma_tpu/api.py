@@ -59,11 +59,27 @@ class TpuShuffleContext:
         base_port: int = 39000,
         tasks_per_executor: int = 4,
         stage_to_device: bool = True,
+        mesh=None,
     ):
         if num_executors <= 0:
             raise ValueError("num_executors must be > 0")
         self.conf = conf or TpuShuffleConf()
-        self.network = network if network is not None else LoopbackNetwork()
+        if network is not None:
+            self.network = network
+        elif self.conf.read_plane == "collective":
+            # bulk fetches between executors ride all_to_all tile
+            # rounds over the device mesh (SURVEY §7 READ inversion)
+            from sparkrdma_tpu.parallel.collective_read import (
+                CollectiveNetwork,
+            )
+
+            self.network = CollectiveNetwork(
+                mesh=mesh,
+                tile_bytes=self.conf.exchange_tile_bytes,
+                flush_ms=self.conf.exchange_flush_ms,
+            )
+        else:
+            self.network = LoopbackNetwork()
         self.driver = TpuShuffleManager(
             self.conf, is_driver=True, network=self.network,
             port=self.conf.driver_port or base_port,
@@ -77,6 +93,15 @@ class TpuShuffleContext:
             )
             for i in range(num_executors)
         ]
+        if hasattr(self.network, "attach_executor"):
+            n_dev = len(self.network.coordinator.devices)
+            if num_executors > n_dev:
+                raise ValueError(
+                    f"collective read plane: {num_executors} executors "
+                    f"need {num_executors} mesh devices, have {n_dev}"
+                )
+            for i, ex in enumerate(self.executors):
+                self.network.attach_executor(ex, i)
         self._pools = [
             ThreadPoolExecutor(
                 max_workers=tasks_per_executor,
@@ -207,6 +232,8 @@ class TpuShuffleContext:
             p.shutdown(wait=True)
         for m in self.executors + [self.driver]:
             m.stop()
+        if hasattr(self.network, "coordinator"):
+            self.network.stop()
 
     def __enter__(self):
         return self

@@ -216,6 +216,28 @@ class TpuShuffleConf:
         return self._bytes_in_range("exchangeTileBytes", 4 << 20, 64 << 10, 1 << 30)
 
     @property
+    def read_plane(self) -> str:
+        """Bulk fetch plane: ``host`` (loopback/TCP one-sided byte
+        reads) or ``collective`` (fetches between mesh-resident
+        executors ride all_to_all tile rounds over ICI — the
+        SURVEY §7 "one-sided READ pull model" inversion)."""
+        return str(self.get("readPlane", "host")).lower()
+
+    @property
+    def device_arena_bytes(self) -> int:
+        """Capacity of each executor's persistent HBM arena on the
+        collective plane (all arenas share one capacity so the pack
+        program compiles once)."""
+        return self._bytes_in_range("deviceArenaBytes", 64 << 20,
+                                    1 << 20, 1 << 40)
+
+    @property
+    def exchange_flush_ms(self) -> int:
+        """How long the exchange coordinator batches pending fetches
+        before running a collective round."""
+        return self._time_ms("exchangeFlush", 2)
+
+    @property
     def exchange_max_rounds_in_flight(self) -> int:
         """Bounded outstanding exchange rounds (maxBytesInFlight analog
         for the collective data plane)."""

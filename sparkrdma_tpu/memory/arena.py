@@ -75,6 +75,38 @@ class DeviceSegment:
         return bytes(np.asarray(self.array[offset:end]))
 
 
+class ArenaSpanSegment:
+    """A registered span of the persistent per-device HBM arena
+    (memory/device_arena.py) — the collective read plane's MR analog.
+    Duck-types DeviceSegment for the ArenaManager bookkeeping; the
+    coordinator recognizes it via its ``span`` attribute and resolves
+    block locations to absolute arena offsets."""
+
+    __slots__ = ("mkey", "span", "nbytes", "shuffle_id", "budgeted",
+                 "zero_copy_ok", "keepalive")
+
+    def __init__(self, mkey: int, span, shuffle_id: Optional[int] = None):
+        self.mkey = mkey
+        self.span = span
+        self.nbytes = span.nbytes
+        self.shuffle_id = shuffle_id
+        self.budgeted = True
+        self.zero_copy_ok = False
+        self.keepalive = None
+
+    def _release_keepalive(self) -> None:
+        self.span.free()
+
+    def read(self, offset: int, length: int) -> bytes:
+        end = offset + length
+        if offset < 0 or end > self.nbytes:
+            raise TransportError(
+                f"read [{offset},{end}) outside arena span mkey={self.mkey} "
+                f"of {self.nbytes}B"
+            )
+        return self.span.arena.read(self.span.offset + offset, length)
+
+
 class ArenaManager(BlockStore):
     """Per-process registry of device segments, keyed by mkey."""
 
@@ -124,6 +156,26 @@ class ArenaManager(BlockStore):
                 self._total_bytes += nbytes
             else:
                 self._file_bytes += nbytes
+            self._registered_ever += 1
+        return seg
+
+    def register_arena_span(self, span, shuffle_id: Optional[int] = None
+                            ) -> ArenaSpanSegment:
+        """Register an allocated device-arena span as a readable
+        segment (its HBM is real, so it debits the byte budget; the
+        span is freed back to its arena on release)."""
+        with self._lock:
+            if (self.max_bytes
+                    and self._total_bytes + span.nbytes > self.max_bytes):
+                raise MemoryError(
+                    f"arena budget exhausted: "
+                    f"{self._total_bytes + span.nbytes}B > {self.max_bytes}B"
+                )
+            mkey = self._next_mkey
+            self._next_mkey += 1
+            seg = ArenaSpanSegment(mkey, span, shuffle_id)
+            self._segments[mkey] = seg
+            self._total_bytes += seg.nbytes
             self._registered_ever += 1
         return seg
 

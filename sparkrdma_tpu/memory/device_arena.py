@@ -1,0 +1,172 @@
+"""Persistent per-device HBM arenas: the registered-MR pool the
+collective data plane reads from.
+
+The reference registers each shuffle file's chunks as ibverbs MRs and
+reducers pull byte ranges with one-sided READs against (addr, len, key)
+(RdmaMappedFile.java:95-171, RdmaChannel.java:441-474).  The TPU analog
+(SURVEY.md §7 mapping): ONE persistent uint8 HBM array per executor
+device — commits sub-allocate spans and write their bytes in with a
+donated ``dynamic_update_slice`` — so every committed block on a device
+is addressable as (arena, offset, length), and one mesh-wide gather can
+pack ANY set of blocks for an ``all_to_all`` round without per-segment
+program shapes (the arena's shape is fixed, so the pack program
+compiles once).
+
+Allocation is a first-fit free list with coalescing (the
+RdmaBufferManager role for device memory); writes are padded to
+``WRITE_ALIGN`` so the update-slice programs compile per size class,
+not per commit.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+WRITE_ALIGN = 4096  # commit padding granularity (4 KiB, the mmap analog)
+
+# gather granularity of the collective read plane: block offsets within
+# an arena must be multiples of this (byte-granular device gathers are
+# ~100x slower than row gathers); WRITE_ALIGN is a multiple, so span
+# starts are always row-aligned
+ROW_BYTES = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _write_fn(arena_bytes: int, chunk_bytes: int):
+    """Jitted in-place arena write (donated: XLA reuses the arena
+    buffer instead of copying all ``arena_bytes``)."""
+    import jax
+
+    def body(arena, chunk, offset):
+        return jax.lax.dynamic_update_slice(arena, chunk, (offset,))
+
+    return jax.jit(body, donate_argnums=(0,))
+
+
+class ArenaSpan:
+    """One allocated byte range of a device arena."""
+
+    __slots__ = ("arena", "offset", "nbytes", "freed")
+
+    def __init__(self, arena: "DeviceArena", offset: int, nbytes: int):
+        self.arena = arena
+        self.offset = offset
+        self.nbytes = nbytes
+        self.freed = False
+
+    def free(self) -> None:
+        self.arena.free(self)
+
+
+class DeviceArena:
+    """One persistent uint8 HBM array on a single device."""
+
+    def __init__(self, capacity: int, device=None):
+        import jax
+        import jax.numpy as jnp
+
+        capacity = (capacity + WRITE_ALIGN - 1) // WRITE_ALIGN * WRITE_ALIGN
+        self.capacity = capacity
+        self.device = device if device is not None else jax.devices()[0]
+        with jax.default_device(self.device):
+            self.array = jnp.zeros(capacity, jnp.uint8)
+        self._lock = threading.Lock()
+        # first-fit free list: sorted non-adjacent (offset, nbytes)
+        self._free: List[Tuple[int, int]] = [(0, capacity)]
+        self.allocated_bytes = 0
+        self.peak_bytes = 0
+        self.writes = 0
+
+    # -- allocation ---------------------------------------------------------
+    def alloc(self, nbytes: int) -> ArenaSpan:
+        """First-fit allocate a WRITE_ALIGN-padded span."""
+        need = max(WRITE_ALIGN, (nbytes + WRITE_ALIGN - 1)
+                   // WRITE_ALIGN * WRITE_ALIGN)
+        with self._lock:
+            for i, (off, size) in enumerate(self._free):
+                if size >= need:
+                    if size == need:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = (off + need, size - need)
+                    self.allocated_bytes += need
+                    self.peak_bytes = max(self.peak_bytes, self.allocated_bytes)
+                    return ArenaSpan(self, off, need)
+        raise MemoryError(
+            f"device arena exhausted: need {need}B, "
+            f"{self.capacity - self.allocated_bytes}B free (fragmented)"
+        )
+
+    def free(self, span: ArenaSpan) -> None:
+        with self._lock:
+            if span.freed:
+                return
+            span.freed = True
+            self.allocated_bytes -= span.nbytes
+            # insert sorted + coalesce with neighbors
+            entry = (span.offset, span.nbytes)
+            lo, hi = 0, len(self._free)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self._free[mid][0] < entry[0]:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            self._free.insert(lo, entry)
+            i = max(0, lo - 1)
+            while i < len(self._free) - 1:
+                off, size = self._free[i]
+                noff, nsize = self._free[i + 1]
+                if off + size == noff:
+                    self._free[i] = (off, size + nsize)
+                    self._free.pop(i + 1)
+                else:
+                    if i >= lo:
+                        break
+                    i += 1
+
+    # -- data movement ------------------------------------------------------
+    def write(self, span: ArenaSpan, data: np.ndarray) -> None:
+        """Write host bytes into the span (donated in-place update on
+        device; data is padded to the span's aligned size so the
+        programs compile per size class)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = int(data.shape[0])
+        if n > span.nbytes:
+            raise ValueError(f"write of {n}B exceeds span of {span.nbytes}B")
+        if n < span.nbytes:
+            padded = np.zeros(span.nbytes, np.uint8)
+            padded[:n] = data
+            data = padded
+        with self._lock:
+            self.writes += 1
+            with jax.default_device(self.device):
+                chunk = jnp.asarray(data)
+                fn = _write_fn(self.capacity, span.nbytes)
+                self.array = fn(self.array, chunk, np.int32(span.offset))
+
+    def read(self, offset: int, length: int) -> bytes:
+        """Host read (transport fallback / local short-circuit): one
+        device→host copy of just the requested range."""
+        end = offset + length
+        if offset < 0 or end > self.capacity:
+            raise ValueError(
+                f"read [{offset},{end}) outside arena of {self.capacity}B"
+            )
+        return bytes(np.asarray(self.array[offset:end]))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "allocated_bytes": self.allocated_bytes,
+                "peak_bytes": self.peak_bytes,
+                "free_extents": len(self._free),
+                "writes": self.writes,
+            }

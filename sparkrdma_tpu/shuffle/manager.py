@@ -213,6 +213,9 @@ class TpuShuffleManager:
 
         if conf.trace:
             get_tracer().enabled = True
+        # persistent per-device HBM arena — set when a CollectiveNetwork
+        # attaches this executor to a mesh device
+        self.device_arena = None
         self.arena = ArenaManager(conf.max_buffer_allocation_size)
         self.staging_pool = StagingPool(conf.max_buffer_allocation_size)
         if not is_driver and conf.max_agg_prealloc > 0:

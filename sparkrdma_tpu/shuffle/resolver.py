@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sparkrdma_tpu.memory.arena import ArenaManager, DeviceSegment
+from sparkrdma_tpu.memory.device_arena import ROW_BYTES as _ROW_BYTES
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.utils.types import BlockLocation
@@ -72,6 +73,11 @@ class ShuffleBlockResolver:
         self.node = node
         self.stage_to_device = stage_to_device
         self.staging_pool = staging_pool  # pooled host buffers for concat
+        # persistent per-device HBM arena (set when the executor is
+        # attached to a collective network); commits then land as arena
+        # spans with ROW_BYTES-aligned partitions so the exchange
+        # coordinator can row-gather them
+        self.device_arena = None
         # commits >= this many bytes go to an mmapped file segment (the
         # RdmaMappedFile path); 0 disables the size trigger — but a
         # writer whose output spilled still commits file-backed via
@@ -109,7 +115,16 @@ class ShuffleBlockResolver:
         one in-memory buffer what spilling was bounding."""
         num_partitions = len(partition_bytes)
         sd = self._get_or_create(shuffle_id, num_partitions)
-        total = sum(_payload_len(b) for b in partition_bytes)
+        use_arena = self.stage_to_device and self.device_arena is not None
+        # collective plane: partition starts row-aligned for the gather
+        align = _ROW_BYTES if use_arena else 1
+        offsets: List[Tuple[int, int]] = []
+        total = 0
+        for b in partition_bytes:
+            total = (total + align - 1) // align * align
+            n = _payload_len(b)
+            offsets.append((total, n))
+            total += n
         if prefer_file_backed or (
             self.file_backed_threshold and total >= self.file_backed_threshold
         ):
@@ -134,32 +149,44 @@ class ShuffleBlockResolver:
                 buf = np.empty(max(total, 1), dtype=np.uint8)
         else:
             buf = np.empty(max(total, 1), dtype=np.uint8)
-        offsets: List[Tuple[int, int]] = []
-        off = 0
-        for b in partition_bytes:
-            n = _payload_len(b)
-            offsets.append((off, n))
+        for (off, _n), b in zip(offsets, partition_bytes):
             for chunk in _payload_chunks(b):
                 m = len(chunk)
                 buf[off : off + m] = np.frombuffer(chunk, np.uint8)
                 off += m
         try:
-            if self.stage_to_device:
-                import jax.numpy as jnp
-
-                array = jnp.asarray(buf[: max(total, 1)])
+            if use_arena:
+                span = self.device_arena.alloc(max(total, 1))
+                try:
+                    self.device_arena.write(span, buf[: max(total, 1)])
+                    seg = self.arena.register_arena_span(
+                        span, shuffle_id=shuffle_id
+                    )
+                except BaseException:
+                    span.free()
+                    raise
+                if staging_buf is not None:
+                    staging_buf.free()
+                    staging_buf = None
             else:
-                array = np.asarray(buf[: max(total, 1)])
-            # PJRT may zero-copy alias page-aligned host buffers: the
-            # staging buffer must live until the segment is released, not
-            # be returned to the pool while the device array can still
-            # read through it
-            seg = self.arena.register(
-                array, shuffle_id=shuffle_id, keepalive=staging_buf,
-                # host commits are plain numpy (never pooled): reads may
-                # serve refcount-protected views
-                zero_copy_ok=not self.stage_to_device and staging_buf is None,
-            )
+                if self.stage_to_device:
+                    import jax.numpy as jnp
+
+                    array = jnp.asarray(buf[: max(total, 1)])
+                else:
+                    array = np.asarray(buf[: max(total, 1)])
+                # PJRT may zero-copy alias page-aligned host buffers: the
+                # staging buffer must live until the segment is released,
+                # not be returned to the pool while the device array can
+                # still read through it
+                seg = self.arena.register(
+                    array, shuffle_id=shuffle_id, keepalive=staging_buf,
+                    # host commits are plain numpy (never pooled): reads
+                    # may serve refcount-protected views
+                    zero_copy_ok=(
+                        not self.stage_to_device and staging_buf is None
+                    ),
+                )
         except BaseException:
             # register never took ownership: return the buffer ourselves
             if staging_buf is not None:
@@ -193,17 +220,28 @@ class ShuffleBlockResolver:
                 sd, shuffle_id, map_id,
                 [buf[off : off + n] for off, n in ranges], total,
             )
-        if self.stage_to_device:
-            import jax.numpy as jnp
-
-            array = jnp.asarray(buf if total else buf[:1])
-            zero_copy = False
+        if self.stage_to_device and self.device_arena is not None:
+            span = self.device_arena.alloc(max(total, 1))
+            try:
+                self.device_arena.write(span, buf)
+                seg = self.arena.register_arena_span(
+                    span, shuffle_id=shuffle_id
+                )
+            except BaseException:
+                span.free()
+                raise
         else:
-            array = buf if total else np.zeros(1, np.uint8)
-            zero_copy = True
-        seg = self.arena.register(
-            array, shuffle_id=shuffle_id, zero_copy_ok=zero_copy
-        )
+            if self.stage_to_device:
+                import jax.numpy as jnp
+
+                array = jnp.asarray(buf if total else buf[:1])
+                zero_copy = False
+            else:
+                array = buf if total else np.zeros(1, np.uint8)
+                zero_copy = True
+            seg = self.arena.register(
+                array, shuffle_id=shuffle_id, zero_copy_ok=zero_copy
+            )
         if self.node is not None:
             self.node.register_block_store(seg.mkey, self.arena)
         mto = MapTaskOutput(len(ranges))
