@@ -68,11 +68,20 @@ class TpuShuffleContext:
             self.network = network
         elif self.conf.read_plane == "collective":
             # bulk fetches between executors ride all_to_all tile
-            # rounds over the device mesh (SURVEY §7 READ inversion)
+            # rounds over the device mesh (SURVEY §7 READ inversion);
+            # default mesh = exactly one device per executor, so no
+            # placeholder arenas join the collective
             from sparkrdma_tpu.parallel.collective_read import (
                 CollectiveNetwork,
             )
+            from sparkrdma_tpu.parallel.mesh import make_mesh
 
+            if mesh is None:
+                import jax
+
+                mesh = make_mesh(
+                    min(num_executors, len(jax.devices()))
+                )
             self.network = CollectiveNetwork(
                 mesh=mesh,
                 tile_bytes=self.conf.exchange_tile_bytes,

@@ -28,6 +28,15 @@ import numpy as np
 
 WRITE_ALIGN = 4096  # commit padding granularity (4 KiB, the mmap analog)
 
+
+def _size_class(nbytes: int) -> int:
+    """Power-of-two size class ≥ WRITE_ALIGN (shared by alloc and
+    write: write's reshape to ROW_BYTES rows relies on spans being
+    classed this way)."""
+    if nbytes <= 0:
+        return WRITE_ALIGN
+    return max(WRITE_ALIGN, 1 << (int(nbytes) - 1).bit_length())
+
 # gather granularity of the collective read plane: block offsets within
 # an arena must be multiples of this (byte-granular device gathers are
 # ~100x slower than row gathers); WRITE_ALIGN is a multiple, so span
@@ -36,13 +45,15 @@ ROW_BYTES = 128
 
 
 @functools.lru_cache(maxsize=None)
-def _write_fn(arena_bytes: int, chunk_bytes: int):
+def _write_fn(arena_rows: int, chunk_rows: int):
     """Jitted in-place arena write (donated: XLA reuses the arena
-    buffer instead of copying all ``arena_bytes``)."""
+    buffer instead of copying the whole arena)."""
     import jax
 
-    def body(arena, chunk, offset):
-        return jax.lax.dynamic_update_slice(arena, chunk, (offset,))
+    def body(arena, chunk, row_offset):
+        return jax.lax.dynamic_update_slice(
+            arena, chunk, (row_offset, 0)
+        )
 
     return jax.jit(body, donate_argnums=(0,))
 
@@ -63,7 +74,13 @@ class ArenaSpan:
 
 
 class DeviceArena:
-    """One persistent uint8 HBM array on a single device."""
+    """One persistent uint8 HBM array on a single device.
+
+    The array is natively 2-D ``[rows, ROW_BYTES]`` — the exact shape
+    the collective pack program consumes, so a flush hands XLA each
+    device's arena buffer as-is (a 1-D array reshaped at flush time
+    carries a non-default layout and forces a full arena relayout copy
+    inside EVERY exchange round — measured 20x slower)."""
 
     def __init__(self, capacity: int, device=None):
         import jax
@@ -71,9 +88,10 @@ class DeviceArena:
 
         capacity = (capacity + WRITE_ALIGN - 1) // WRITE_ALIGN * WRITE_ALIGN
         self.capacity = capacity
+        self.rows = capacity // ROW_BYTES
         self.device = device if device is not None else jax.devices()[0]
         with jax.default_device(self.device):
-            self.array = jnp.zeros(capacity, jnp.uint8)
+            self.array = jnp.zeros((self.rows, ROW_BYTES), jnp.uint8)
         self._lock = threading.Lock()
         # first-fit free list: sorted non-adjacent (offset, nbytes)
         self._free: List[Tuple[int, int]] = [(0, capacity)]
@@ -83,9 +101,11 @@ class DeviceArena:
 
     # -- allocation ---------------------------------------------------------
     def alloc(self, nbytes: int) -> ArenaSpan:
-        """First-fit allocate a WRITE_ALIGN-padded span."""
-        need = max(WRITE_ALIGN, (nbytes + WRITE_ALIGN - 1)
-                   // WRITE_ALIGN * WRITE_ALIGN)
+        """First-fit allocate a power-of-two span (the buffer-manager
+        size classes, RdmaBufferManager.java:88,135-147 — here the
+        classes also bound how many distinct donated-write programs XLA
+        compiles: one per class, not one per commit size)."""
+        need = _size_class(nbytes)
         with self._lock:
             for i, (off, size) in enumerate(self._free):
                 if size >= need:
@@ -140,26 +160,36 @@ class DeviceArena:
         n = int(data.shape[0])
         if n > span.nbytes:
             raise ValueError(f"write of {n}B exceeds span of {span.nbytes}B")
-        if n < span.nbytes:
-            padded = np.zeros(span.nbytes, np.uint8)
+        # pad to the next size class ≤ span (spans are pow2-classed), so
+        # the donated-update program count stays logarithmic while the
+        # host copy stays near the payload size
+        chunk_n = min(span.nbytes, _size_class(n))
+        if n < chunk_n:
+            padded = np.zeros(chunk_n, np.uint8)
             padded[:n] = data
             data = padded
         with self._lock:
             self.writes += 1
             with jax.default_device(self.device):
-                chunk = jnp.asarray(data)
-                fn = _write_fn(self.capacity, span.nbytes)
-                self.array = fn(self.array, chunk, np.int32(span.offset))
+                chunk = jnp.asarray(data.reshape(-1, ROW_BYTES))
+                fn = _write_fn(self.rows, chunk_n // ROW_BYTES)
+                self.array = fn(
+                    self.array, chunk, np.int32(span.offset // ROW_BYTES)
+                )
 
     def read(self, offset: int, length: int) -> bytes:
         """Host read (transport fallback / local short-circuit): one
-        device→host copy of just the requested range."""
+        device→host copy of just the covering row range."""
         end = offset + length
         if offset < 0 or end > self.capacity:
             raise ValueError(
                 f"read [{offset},{end}) outside arena of {self.capacity}B"
             )
-        return bytes(np.asarray(self.array[offset:end]))
+        r0 = offset // ROW_BYTES
+        r1 = (end + ROW_BYTES - 1) // ROW_BYTES
+        rows = np.asarray(self.array[r0:r1]).reshape(-1)
+        lo = offset - r0 * ROW_BYTES
+        return bytes(rows[lo : lo + length])
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -61,19 +61,21 @@ logger = logging.getLogger(__name__)
 @functools.lru_cache(maxsize=32)
 def _pack_a2a_fn(mesh, arena_rows: int, n_devices: int, c_rows: int):
     """Jitted pack+exchange: per device, gather its requested rows and
-    all_to_all them.  arena: [D, AR, ROW] sharded by source; idx:
-    [D, D, C] row indices sharded by source; out: [D, D, C, ROW]
-    sharded by DESTINATION (out[d, s] = rows src s sent dst d)."""
+    all_to_all them.  arena: [D*AR, ROW] sharded by source on dim 0 —
+    the 2-D shape each DeviceArena holds natively, so flush hands XLA
+    the resident buffers with no relayout; idx: [D, D, C] row indices
+    sharded by source; out: [D, D, C, ROW] sharded by DESTINATION
+    (out[d, s] = rows src s sent dst d)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    spec_arena = P(EXCHANGE_AXIS, None, None)
+    spec_arena = P(EXCHANGE_AXIS, None)
     spec_idx = P(EXCHANGE_AXIS, None, None)
     spec_out = P(EXCHANGE_AXIS, None, None, None)
 
-    def body(arena, idx):  # local: [1, AR, ROW], [1, D, C]
-        tile = jnp.take(arena[0], idx[0].reshape(-1), axis=0)
+    def body(arena, idx):  # local: [AR, ROW], [1, D, C]
+        tile = jnp.take(arena, idx[0].reshape(-1), axis=0)
         tile = tile.reshape(n_devices, c_rows, ROW_BYTES)
         y = jax.lax.all_to_all(
             tile[None], EXCHANGE_AXIS, split_axis=1, concat_axis=0
@@ -126,8 +128,16 @@ class ExchangeCoordinator:
         self.tile_rows = max(1, int(tile_bytes) // ROW_BYTES)
         self.flush_ms = flush_ms
         self._entries: Dict[int, ExecutorEntry] = {}  # device_index →
+        # zero arenas standing in for unattached mesh devices (symmetric
+        # collective participation), created once per (device, shape)
+        self._placeholders: Dict[Tuple[int, int], object] = {}
         self._pending: List[_Request] = []
         self._lock = threading.Lock()
+        # rounds are globally ordered collective launches: concurrent
+        # multi-device dispatches from different threads stall XLA's
+        # cross-device rendezvous, so exactly ONE round runs at a time —
+        # fetches submitted meanwhile merge into the next (fuller) batch
+        self._exec_lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
         self._stopped = False
         # stats (reader-stats analog for the collective plane)
@@ -229,23 +239,24 @@ class ExchangeCoordinator:
 
     def flush(self) -> None:
         """Run all pending fetches as one batched exchange."""
-        with self._lock:
-            if self._timer is not None:
-                self._timer.cancel()
-                self._timer = None
-            batch, self._pending = self._pending, []
-            entries = dict(self._entries)
-        if not batch:
-            return
-        try:
-            self._execute(batch, entries)
-        except BaseException as e:
-            logger.exception("collective exchange batch failed")
-            for req in batch:
-                try:
-                    req.listener.on_failure(e)
-                except BaseException:
-                    pass
+        with self._exec_lock:
+            with self._lock:
+                if self._timer is not None:
+                    self._timer.cancel()
+                    self._timer = None
+                batch, self._pending = self._pending, []
+                entries = dict(self._entries)
+            if not batch:
+                return
+            try:
+                self._execute(batch, entries)
+            except BaseException as e:
+                logger.exception("collective exchange batch failed")
+                for req in batch:
+                    try:
+                        req.listener.on_failure(e)
+                    except BaseException:
+                        pass
 
     def _execute(self, batch: List[_Request],
                  entries: Dict[int, ExecutorEntry]) -> None:
@@ -322,20 +333,24 @@ class ExchangeCoordinator:
                 for i, dev in enumerate(self.devices):
                     a = arenas[i]
                     if a is not None:
-                        arr = a.array.reshape(arena_rows, ROW_BYTES)[None]
+                        arr = a.array  # natively [AR, ROW] on dev
                     else:
-                        import jax.numpy as jnp
+                        key = (i, arena_rows)
+                        arr = self._placeholders.get(key)
+                        if arr is None:
+                            import jax.numpy as jnp
 
-                        with jax.default_device(dev):
-                            arr = jnp.zeros(
-                                (1, arena_rows, ROW_BYTES), jnp.uint8
-                            )
+                            with jax.default_device(dev):
+                                arr = jnp.zeros(
+                                    (arena_rows, ROW_BYTES), jnp.uint8
+                                )
+                            self._placeholders[key] = arr
                     shards.append(jax.device_put(arr, dev))
                     idx_shards.append(jax.device_put(
                         idx_np[i : i + 1, :, lo : lo + c_rows], dev
                     ))
                 arena_g = jax.make_array_from_single_device_arrays(
-                    (D, arena_rows, ROW_BYTES), arena_sharding, shards
+                    (D * arena_rows, ROW_BYTES), arena_sharding, shards
                 )
                 idx_g = jax.make_array_from_single_device_arrays(
                     (D, D, c_rows), idx_sharding, idx_shards

@@ -87,6 +87,17 @@ class ShuffleBlockResolver:
         self._shuffles: Dict[int, _ShuffleData] = {}
         self._lock = threading.Lock()
 
+    @property
+    def commit_align(self) -> int:
+        """Partition-offset alignment writers must honor in assembled
+        commits: arena-resident blocks are row-gathered by the
+        collective plane, so their offsets must be ROW_BYTES-aligned
+        (unaligned blocks still read correctly — they just fall back to
+        the host path)."""
+        if self.stage_to_device and self.device_arena is not None:
+            return _ROW_BYTES
+        return 1
+
     def _get_or_create(self, shuffle_id: int, num_partitions: int) -> _ShuffleData:
         with self._lock:
             sd = self._shuffles.get(shuffle_id)

@@ -390,9 +390,22 @@ class ShuffleWriter:
                     frames.append((pid, batch, order, lo, cnt, header))
                     pid_sizes[pid + 1] += len(header) + cnt * (kitem + vitem)
                 lo += cnt
-        starts = np.cumsum(pid_sizes)
-        total = int(starts[P])
+        # partition starts honor the resolver's commit alignment (the
+        # collective plane row-gathers arena blocks at ROW_BYTES
+        # granularity); sizes stay exact, the gaps are never served
+        align = self.manager.resolver.commit_align
+        sizes = pid_sizes[1:]
+        starts = np.zeros(P + 1, np.int64)
+        for p in range(P):
+            starts[p + 1] = (
+                (starts[p] + sizes[p] + align - 1) // align * align
+            )
+        total = int(starts[P - 1] + sizes[P - 1]) if P else 0
         buf = np.empty(max(total, 1), np.uint8)
+        # zero the alignment gaps so committed segments are
+        # deterministic (gap bytes are staged but never served)
+        for p in range(P - 1):
+            buf[starts[p] + sizes[p] : starts[p + 1]] = 0
         cursors = starts[:P].copy()
         for pid, batch, order, lo, cnt, header in frames:
             c = int(cursors[pid])
@@ -408,10 +421,8 @@ class ShuffleWriter:
                     take_rows(col, order[lo : lo + cnt], out=out)
                 c += nb
             cursors[pid] = c
-        ranges = [
-            (int(starts[p]), int(starts[p + 1] - starts[p])) for p in range(P)
-        ]
-        self.metrics.bytes_written = total
+        ranges = [(int(starts[p]), int(sizes[p])) for p in range(P)]
+        self.metrics.bytes_written = int(sizes.sum())  # payload, not gaps
         mto = self.manager.resolver.commit_assembled(
             self.handle.shuffle_id, self.map_id, buf[:total], ranges,
         )
