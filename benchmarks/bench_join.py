@@ -50,10 +50,9 @@ def main():
         lv = jax.device_put(fact_vals, sh)
         l_valid = jax.device_put(np.ones(n_fact, np.int32), sh)
         if isinstance(joiner, HashJoiner):
-            cap_l = joiner._capacity(n_fact // D, 2.0)
-            cap_r = joiner._capacity(max(1, n_dim // D), 2.0)
+            cap = joiner._capacity((n_fact + n_dim) // D, 2.0)
             step = make_hash_join_step(
-                mesh, n_fact // D, max(1, n_dim // D), cap_l, cap_r
+                mesh, n_fact // D, max(1, n_dim // D), cap
             )
             rk = jax.device_put(dim_keys, sh)
             rv = jax.device_put(dim_vals, sh)

@@ -30,12 +30,20 @@ WRITE_ALIGN = 4096  # commit padding granularity (4 KiB, the mmap analog)
 
 
 def _size_class(nbytes: int) -> int:
-    """Power-of-two size class ≥ WRITE_ALIGN (shared by alloc and
-    write: write's reshape to ROW_BYTES rows relies on spans being
-    classed this way)."""
-    if nbytes <= 0:
+    """Span size class ≥ WRITE_ALIGN: the next {2^k, 1.5·2^k} value
+    (shared by alloc and write; write's reshape to ROW_BYTES rows
+    relies on spans being classed this way).  Two classes per octave
+    keep the donated-write program count logarithmic while capping
+    allocation waste at ~33% (pure pow2 classes wasted up to 2x of the
+    arena on large commits)."""
+    n = int(nbytes)
+    if n <= WRITE_ALIGN:
         return WRITE_ALIGN
-    return max(WRITE_ALIGN, 1 << (int(nbytes) - 1).bit_length())
+    p = 1 << (n - 1).bit_length()  # next pow2
+    threeq = (p >> 1) + (p >> 2)   # 1.5·(p/2) = 0.75·p
+    if n <= threeq and threeq % WRITE_ALIGN == 0:
+        return threeq
+    return p
 
 # gather granularity of the collective read plane: block offsets within
 # an arena must be multiples of this (byte-granular device gathers are
@@ -101,7 +109,7 @@ class DeviceArena:
 
     # -- allocation ---------------------------------------------------------
     def alloc(self, nbytes: int) -> ArenaSpan:
-        """First-fit allocate a power-of-two span (the buffer-manager
+        """First-fit allocate a size-classed span (the buffer-manager
         size classes, RdmaBufferManager.java:88,135-147 — here the
         classes also bound how many distinct donated-write programs XLA
         compiles: one per class, not one per commit size)."""
@@ -179,7 +187,10 @@ class DeviceArena:
 
     def read(self, offset: int, length: int) -> bytes:
         """Host read (transport fallback / local short-circuit): one
-        device→host copy of just the covering row range."""
+        device→host copy of just the covering row range.  Materializes
+        under the arena lock — a concurrent donated write invalidates
+        the previous buffer, so an unlocked slice could observe a
+        deleted array mid-copy."""
         end = offset + length
         if offset < 0 or end > self.capacity:
             raise ValueError(
@@ -187,7 +198,8 @@ class DeviceArena:
             )
         r0 = offset // ROW_BYTES
         r1 = (end + ROW_BYTES - 1) // ROW_BYTES
-        rows = np.asarray(self.array[r0:r1]).reshape(-1)
+        with self._lock:
+            rows = np.asarray(self.array[r0:r1]).reshape(-1)
         lo = offset - r0 * ROW_BYTES
         return bytes(rows[lo : lo + length])
 

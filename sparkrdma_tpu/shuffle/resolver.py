@@ -128,7 +128,7 @@ class ShuffleBlockResolver:
         sd = self._get_or_create(shuffle_id, num_partitions)
         use_arena = self.stage_to_device and self.device_arena is not None
         # collective plane: partition starts row-aligned for the gather
-        align = _ROW_BYTES if use_arena else 1
+        align = self.commit_align
         offsets: List[Tuple[int, int]] = []
         total = 0
         for b in partition_bytes:

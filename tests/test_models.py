@@ -422,3 +422,44 @@ def test_external_sort_duplicate_heavy_bucket(mesh, devices):
     assert sorted(got_v.tolist()) == sorted(vals.tolist())
     # the degenerate bucket loaded whole exactly once (no useless churn)
     assert ext.buckets_resplit == 0
+
+
+def test_join_int64_keys_under_x64():
+    """64-bit keys/values must survive the packed transport when
+    jax_enable_x64 is on: keys differing only in their high 32 bits
+    must NOT collide (regression: the uint32 transport collapsed
+    2**32+1 onto 1).  Runs in a subprocess because x64 is a global
+    startup flag."""
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ["JAX_ENABLE_X64"] = "1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+mesh = make_mesh(1)
+fact_keys = np.array([1, 2**32 + 1, 5], dtype=np.int64)
+fact_vals = np.array([10, 20, 30], dtype=np.int64)
+dim_keys = np.array([1, 5], dtype=np.int64)
+dim_vals = np.array([100, 2**33 + 7], dtype=np.int64)
+for joiner in (HashJoiner(mesh), BroadcastJoiner(mesh)):
+    k, fv, dv = joiner.join(fact_keys, fact_vals, dim_keys, dim_vals)
+    rows = sorted(zip(k.tolist(), fv.tolist(), dv.tolist()))
+    assert rows == [(1, 10, 100), (5, 30, 2**33 + 7)], (
+        type(joiner).__name__, rows)
+print("OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0 and "OK" in out.stdout, (
+        out.stdout + out.stderr
+    )
