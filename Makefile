@@ -1,13 +1,18 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native test bench dryrun clean
+.PHONY: all native lint test bench dryrun clean
 
 all: native
 
 native:
 	$(MAKE) -C native
 
-test: native
+# style gate failing the build — the checkstyle/scalastyle analog
+# (reference pom.xml:93-141 runs both at validate, failsOnError=true)
+lint:
+	python tools/lint.py
+
+test: native lint
 	python -m pytest tests/ -x -q
 
 bench: native

@@ -32,7 +32,7 @@ from sparkrdma_tpu.models.join import (
     make_broadcast_join_step,
     make_hash_join_step,
 )
-from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
+from sparkrdma_tpu.parallel.mesh import make_mesh
 
 
 def main():

@@ -200,7 +200,9 @@ class TpuShuffleConf:
     @property
     def shuffle_read_block_size(self) -> int:
         """Target size of one grouped fetch (reference: 256k)."""
-        return self._bytes_in_range("shuffleReadBlockSize", 256 << 10, 16 << 10, 1 << 30)
+        return self._bytes_in_range(
+            "shuffleReadBlockSize", 256 << 10, 16 << 10, 1 << 30
+        )
 
     @property
     def max_bytes_in_flight(self) -> int:
@@ -213,7 +215,9 @@ class TpuShuffleConf:
         """Payload bytes per chip per all_to_all tile round.  The SPMD
         analog of shuffle_read_block_size: every chip contributes exactly
         one padded tile of this size per round."""
-        return self._bytes_in_range("exchangeTileBytes", 4 << 20, 64 << 10, 1 << 30)
+        return self._bytes_in_range(
+            "exchangeTileBytes", 4 << 20, 64 << 10, 1 << 30
+        )
 
     @property
     def read_plane(self) -> str:
@@ -292,6 +296,21 @@ class TpuShuffleConf:
     @property
     def partition_location_fetch_timeout_ms(self) -> int:
         return self._time_ms("partitionLocationFetchTimeout", 120_000)
+
+    @property
+    def heartbeat_interval_ms(self) -> int:
+        """Driver→executor liveness probe period on the hello/announce
+        plane; 0 disables the heartbeat monitor.  Plays the role of RDMA
+        CM DISCONNECTED events (RdmaNode.java:176-189) — the transport
+        here has no connection-level death notification."""
+        return self._time_ms("heartbeatInterval", 5_000)
+
+    @property
+    def heartbeat_timeout_ms(self) -> int:
+        """How long an executor may go without acking a heartbeat
+        before the driver prunes it (remove_executor — the
+        onBlockManagerRemoved analog, RdmaShuffleManager.scala:253-263)."""
+        return self._time_ms("heartbeatTimeout", 15_000)
 
     @property
     def connect_timeout_ms(self) -> int:

@@ -179,6 +179,40 @@ class ArenaManager(BlockStore):
             self._registered_ever += 1
         return seg
 
+    def replace_with_span(self, mkey: int, span
+                          ) -> Optional[ArenaSpanSegment]:
+        """Swap a host-resident segment for a device-arena span under
+        the SAME mkey — the on-demand registration step of the lazy
+        staging (ODP) path: published BlockLocations keep working
+        because the mkey never changes.  Returns the new segment, or
+        None (freeing ``span``) when the mkey is gone."""
+        with self._lock:
+            old = self._segments.get(mkey)
+            if old is None:
+                released = None
+            else:
+                freed = old.nbytes if old.budgeted else 0
+                if (self.max_bytes and self._total_bytes - freed
+                        + span.nbytes > self.max_bytes):
+                    raise MemoryError(
+                        f"arena budget exhausted staging mkey={mkey}: "
+                        f"{self._total_bytes - freed + span.nbytes}B > "
+                        f"{self.max_bytes}B"
+                    )
+                seg = ArenaSpanSegment(mkey, span, old.shuffle_id)
+                self._segments[mkey] = seg
+                if old.budgeted:
+                    self._total_bytes -= old.nbytes
+                else:
+                    self._file_bytes -= old.nbytes
+                self._total_bytes += seg.nbytes
+                released = old
+        if released is None:
+            span.free()
+            return None
+        released._release_keepalive()
+        return seg
+
     def get(self, mkey: int) -> Optional[DeviceSegment]:
         with self._lock:
             return self._segments.get(mkey)

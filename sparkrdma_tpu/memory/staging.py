@@ -15,7 +15,8 @@ import ctypes
 import logging
 import os
 import threading
-from typing import Dict, Optional
+import weakref
+from typing import Dict
 
 import numpy as np
 
@@ -121,6 +122,10 @@ class StagingPool:
         self.is_native = _NATIVE is not None and not force_python
         self._lock = threading.Lock()
         self._closed = False
+        # outstanding alloc_gc buffers: close() must DEFER destroying
+        # the native pool until the last one is collected (destroying
+        # frees the pages a live consumer view still reads)
+        self._gc_live = 0
         if self.is_native:
             self._handle = _NATIVE.staging_pool_create(
                 ctypes.c_uint64(max_bytes)
@@ -156,10 +161,93 @@ class StagingPool:
             return StagingBuffer(self, ptr, cap, view)
         return self._py_alloc(size)
 
+    def alloc_gc(self, size: int) -> np.ndarray:
+        """Pooled buffer whose RELEASE is tied to garbage collection of
+        the returned uint8 view and every numpy slice of it — the
+        BufferReleasingInputStream analog
+        (RdmaShuffleFetcherIterator.scala:377-406): consumers receive
+        zero-copy slices of one pooled buffer and the buffer returns to
+        the pool only when the last slice dies, so no explicit release
+        call can free memory under a live view.
+
+        Native pool: the block physically returns for reuse.  Python
+        fallback: the memory goes back to the OS and only the
+        accounting is adjusted (numpy owns the pages)."""
+        if size <= 0:
+            raise ValueError(f"alloc size must be > 0: {size}")
+        if self._closed:
+            raise MemoryError("pool closed")
+        if self.is_native:
+            ptr = _NATIVE.staging_alloc(self._handle, ctypes.c_uint64(size))
+            if not ptr:
+                raise MemoryError(
+                    f"staging pool budget exhausted allocating {size}B "
+                    f"(budget {self.max_bytes}B)"
+                )
+            cap = _NATIVE.staging_block_size(
+                self._handle, ctypes.c_void_p(ptr)
+            )
+            raw = (ctypes.c_uint8 * cap).from_address(ptr)
+            with self._lock:
+                self._gc_live += 1
+
+            def _ret(pool=self, address=ptr):
+                # runs when raw (kept alive by every slice's base chain)
+                # is collected; the handle stays valid after close()
+                # because destroy is deferred to the LAST of us.  Free
+                # and the destroy decision happen under ONE lock hold —
+                # two finalizers racing could otherwise free into a
+                # just-destroyed pool.
+                with pool._lock:
+                    handle = pool._handle
+                    if handle is not None:
+                        _NATIVE.staging_free(
+                            handle, ctypes.c_void_p(address)
+                        )
+                    pool._gc_live -= 1
+                    destroy = (
+                        pool._closed and pool._gc_live == 0
+                        and handle is not None
+                    )
+                    if destroy:
+                        pool._handle = None
+                if destroy:
+                    _NATIVE.staging_pool_destroy(handle)
+
+            weakref.finalize(raw, _ret)
+            return np.frombuffer(raw, dtype=np.uint8)
+        # python fallback: fresh numpy memory, GC frees it to the OS
+        cls = self._round_class(size)
+        with self._lock:
+            self._tick += 1
+            self._total_allocs += 1
+            if self.max_bytes and self._owned + cls > self.max_bytes:
+                self._py_trim(0)
+                if self._owned + cls > self.max_bytes:
+                    self._failed += 1
+                    raise MemoryError(
+                        f"staging pool budget exhausted allocating {size}B"
+                    )
+            self._owned += cls
+            self._in_use += cls
+        view = np.empty(cls, dtype=np.uint8)
+
+        def _reclaim(pool=self, cls=cls):
+            with pool._lock:
+                pool._owned -= cls
+                pool._in_use -= cls
+
+        weakref.finalize(view, _reclaim)
+        return view
+
     def stats(self) -> Dict[str, int]:
         if self.is_native:
             arr = (ctypes.c_uint64 * 6)()
-            _NATIVE.staging_pool_stats(self._handle, arr)
+            # hold the lock across the native call: the deferred-destroy
+            # finalizer must not tear the handle down mid-read
+            with self._lock:
+                if self._handle:
+                    _NATIVE.staging_pool_stats(self._handle, arr)
             return dict(zip(STAT_FIELDS, (int(x) for x in arr)))
         with self._lock:
             idle = self._owned - self._in_use
@@ -201,11 +289,17 @@ class StagingPool:
     def close(self) -> None:
         if self._closed:
             return
-        self._closed = True
-        if self.is_native:
-            _NATIVE.staging_pool_destroy(self._handle)
-            self._handle = None
-        else:
+        handle = None
+        with self._lock:
+            self._closed = True
+            if self.is_native and self._gc_live == 0:
+                handle, self._handle = self._handle, None
+            # else (gc_live > 0): the LAST outstanding alloc_gc
+            # buffer's finalizer destroys the pool — destroying now
+            # would free pages a live consumer view still reads
+        if handle:
+            _NATIVE.staging_pool_destroy(handle)
+        if not self.is_native:
             self._free_lists.clear()
 
     # -- internals ----------------------------------------------------------

@@ -17,7 +17,6 @@ from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sparkrdma_tpu.models._base import ExchangeModel

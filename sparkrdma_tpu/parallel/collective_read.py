@@ -108,13 +108,18 @@ class _Request:
 class ExecutorEntry:
     """One mesh-resident executor known to the coordinator."""
 
-    __slots__ = ("address", "device_index", "arena_manager", "device_arena")
+    __slots__ = ("address", "device_index", "arena_manager", "device_arena",
+                 "resolver")
 
-    def __init__(self, address, device_index, arena_manager, device_arena):
+    def __init__(self, address, device_index, arena_manager, device_arena,
+                 resolver=None):
         self.address = address
         self.device_index = device_index
         self.arena_manager = arena_manager
         self.device_arena = device_arena
+        # lazy-staging hook: lets the coordinator fault host-committed
+        # segments into the arena on first device-plane touch (ODP)
+        self.resolver = resolver
 
 
 class ExchangeCoordinator:
@@ -205,9 +210,22 @@ class ExchangeCoordinator:
     def _resolve(entry: ExecutorEntry,
                  loc: BlockLocation) -> Optional[Tuple[int, int]]:
         """BlockLocation → absolute (arena offset, length), or None when
-        the block can't ride the collective plane."""
+        the block can't ride the collective plane.  A host-committed
+        lazy segment is staged into the arena here — the first
+        device-plane touch IS the registration, exactly ODP's
+        page-fault semantics (RdmaBufferManager.java:103-110)."""
         seg = entry.arena_manager.get(loc.mkey)
         span = getattr(seg, "span", None)
+        if span is None and entry.resolver is not None:
+            try:
+                seg = entry.resolver.ensure_staged(loc.mkey)
+            except MemoryError:
+                logger.warning(
+                    "lazy staging of mkey=%d skipped (arena full)",
+                    loc.mkey,
+                )
+                seg = None
+            span = getattr(seg, "span", None)
         if span is None or span.arena is not entry.device_arena:
             return None
         abs_off = span.offset + loc.address
@@ -525,7 +543,8 @@ class CollectiveNetwork(LoopbackNetwork):
         manager.device_arena = arena
         manager.resolver.device_arena = arena
         entry = ExecutorEntry(
-            manager.node.address, device_index, manager.arena, arena
+            manager.node.address, device_index, manager.arena, arena,
+            resolver=manager.resolver,
         )
         self.coordinator.attach(entry)
         return entry

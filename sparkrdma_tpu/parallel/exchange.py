@@ -69,6 +69,55 @@ class ExchangeIntegrityError(TransportError):
 TILE_ALIGN = 128
 
 
+class NonAddressableStreamError(TransportError):
+    """A caller touched a destination row that lives on another host.
+
+    ``exchange_bytes`` is host-local by construction (each process only
+    holds its own devices' shards) — silently returning empty streams
+    for remote destinations made the API *look* total while dropping
+    data, so those rows now fail loudly on access."""
+
+    def __init__(self, dst: int):
+        super().__init__(
+            f"destination {dst} is not addressable from process "
+            f"{jax.process_index()}: exchange_bytes results are "
+            f"host-local; read this row on the process that owns "
+            f"device {dst}"
+        )
+        self.dst = dst
+
+
+class HostLocalStreams:
+    """Result of a multi-host ``exchange_bytes``: list-like [D][S] with
+    only this host's destination rows present.  Indexing a remote
+    destination raises :class:`NonAddressableStreamError` instead of
+    returning empty bytes; ``addressable`` lists the valid rows.
+
+    There is deliberately no ``__iter__``: plain iteration falls back to
+    ``__getitem__(0..)`` and raises the moment it touches a remote row,
+    so single-host code (`for row in result`) that silently assumed the
+    full matrix fails LOUDLY on a multi-host mesh instead of consuming a
+    partial one.  Multi-host code iterates ``items()`` explicitly."""
+
+    def __init__(self, rows: List[List[bytes]], filled: frozenset):
+        self._rows = rows
+        self.addressable = frozenset(filled)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __getitem__(self, d: int):
+        if d not in self.addressable:
+            raise NonAddressableStreamError(d)
+        return self._rows[d]
+
+    def items(self):
+        """(destination, row) pairs for this host's rows — the explicit
+        multi-host iteration idiom."""
+        for d in sorted(self.addressable):
+            yield d, self._rows[d]
+
+
 class ExchangePlan:
     """Static plan for one exchange of per-pair streams of known length.
 
@@ -182,7 +231,12 @@ class TileExchange:
     # -- host-driven byte exchange ------------------------------------------
     def exchange_bytes(
         self, streams: Sequence[Sequence[bytes]]
-    ) -> List[List[bytes]]:
+    ):
+        """Move ``streams[s][d]`` → ``out[d][s]``.  Single-host (every
+        destination addressable) returns plain ``[D][S]`` lists; on a
+        multi-host mesh the return is a :class:`HostLocalStreams` whose
+        remote destination rows raise on access (each process holds
+        only its own devices' shards)."""
         D = self.n_devices
         if len(streams) != D or any(len(row) != D for row in streams):
             raise ValueError(
@@ -241,6 +295,11 @@ class TileExchange:
         ]
         if self.verify_integrity:
             self._verify(streams, result, filled_dsts)
+        if len(filled_dsts) < D:
+            # multi-host: only this process's destination rows hold
+            # data — hand back a guarded view so a remote row fails
+            # loudly instead of reading as empty streams
+            return HostLocalStreams(result, frozenset(filled_dsts))
         return result
 
     def _verify(self, streams, result, filled_dsts) -> None:

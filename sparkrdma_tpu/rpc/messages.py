@@ -13,8 +13,12 @@ same type covering a sub-range, so the receiver just applies them in any
 order (the publish path lands each sub-range via
 ``MapTaskOutput.put_range``).
 
-The five message types mirror the reference's set
-(RdmaRpcMsg.scala:31-35):
+The first five message types mirror the reference's set
+(RdmaRpcMsg.scala:31-35); types 6-7 carry the failure-detection plane
+the reference gets from RDMA CM DISCONNECTED events + Spark's
+onBlockManagerRemoved listener (RdmaNode.java:176-189,
+RdmaShuffleManager.scala:253-263), which have no transport-level analog
+here:
 
 ====  =====================================  ===========================
 type  class                                  direction
@@ -24,6 +28,8 @@ type  class                                  direction
  3    PublishMapTaskOutputMsg                executor → driver
  4    FetchMapStatusMsg                      executor → driver
  5    FetchMapStatusResponseMsg              driver → executor
+ 6    FetchMapStatusFailedMsg                driver → executor
+ 7    HeartbeatMsg                           driver ↔ executor
 ====  =====================================  ===========================
 """
 
@@ -419,6 +425,64 @@ class FetchMapStatusResponseMsg(RpcMsg):
         return FetchMapStatusResponseMsg(callback_id, total, index, locs)
 
 
+@dataclass(frozen=True)
+class FetchMapStatusFailedMsg(RpcMsg):
+    """Driver tells a requester its fetch-status CANNOT be answered —
+    unregistered shuffle, or the publishing executor was lost before its
+    table filled.  The requester converts this to a metadata fetch
+    failure immediately instead of riding out the full location timeout
+    (the fast stage-retry path; reference reducers discover the same
+    condition only via FetchFailedException after timeouts)."""
+
+    callback_id: int
+    reason: str
+
+    MSG_TYPE = 6
+
+    def _payload(self) -> bytes:
+        reason = self.reason.encode("utf-8")[:1024]
+        return struct.pack("<ii", self.callback_id, len(reason)) + reason
+
+    def _payload_size(self) -> int:
+        return 8 + len(self.reason.encode("utf-8")[:1024])
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "FetchMapStatusFailedMsg":
+        callback_id, n = struct.unpack_from("<ii", view, 0)
+        reason = bytes(view[8 : 8 + n]).decode("utf-8", "replace")
+        return FetchMapStatusFailedMsg(callback_id, reason)
+
+
+@dataclass(frozen=True)
+class HeartbeatMsg(RpcMsg):
+    """Liveness probe on the hello/announce plane: the driver pings
+    each executor; the executor echoes with ``is_ack=True``.  A missed
+    ack window (or an outright send failure) drives automatic
+    ``remove_executor`` — the role RDMA CM DISCONNECTED events play in
+    the reference (RdmaNode.java:176-189)."""
+
+    shuffle_manager_id: ShuffleManagerId
+    seq: int
+    is_ack: bool
+
+    MSG_TYPE = 7
+
+    def _payload(self) -> bytes:
+        buf = bytearray()
+        self.shuffle_manager_id.write(buf)
+        buf += struct.pack("<ii", self.seq, 1 if self.is_ack else 0)
+        return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return self.shuffle_manager_id.serialized_length() + 8
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "HeartbeatMsg":
+        smid, off = ShuffleManagerId.read(view, 0)
+        seq, ack = struct.unpack_from("<ii", view, off)
+        return HeartbeatMsg(smid, seq, bool(ack))
+
+
 MSG_TYPES: Dict[int, Type[RpcMsg]] = {
     cls.MSG_TYPE: cls
     for cls in (
@@ -427,5 +491,7 @@ MSG_TYPES: Dict[int, Type[RpcMsg]] = {
         PublishMapTaskOutputMsg,
         FetchMapStatusMsg,
         FetchMapStatusResponseMsg,
+        FetchMapStatusFailedMsg,
+        HeartbeatMsg,
     )
 }

@@ -24,7 +24,7 @@ from __future__ import annotations
 import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
@@ -33,8 +33,10 @@ from sparkrdma_tpu.memory.staging import StagingPool
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
+    FetchMapStatusFailedMsg,
     FetchMapStatusMsg,
     FetchMapStatusResponseMsg,
+    HeartbeatMsg,
     HelloMsg,
     PublishMapTaskOutputMsg,
     RpcMsg,
@@ -135,10 +137,13 @@ class ShuffleHandle:
 class _FetchCallback:
     """Reassembles segmented fetch-status responses by (index, total)
     and fires once complete (registry analog of
-    RdmaShuffleManager.scala:378-387)."""
+    RdmaShuffleManager.scala:378-387); ``on_error`` fires instead when
+    the driver answers with FetchMapStatusFailedMsg."""
 
-    def __init__(self, on_locations: Callable[[List[BlockLocation]], None]):
+    def __init__(self, on_locations: Callable[[List[BlockLocation]], None],
+                 on_error: Optional[Callable[[str], None]] = None):
         self.on_locations = on_locations
+        self.on_error = on_error
         self._parts: Dict[int, Tuple[BlockLocation, ...]] = {}
         self._got = 0
         self._lock = threading.Lock()
@@ -155,6 +160,10 @@ class _FetchCallback:
             for idx in sorted(self._parts):
                 locs.extend(self._parts[idx])
             self.on_locations(locs)
+
+    def on_failed(self, reason: str) -> None:
+        if self.on_error is not None:
+            self.on_error(reason)
 
 
 class TpuShuffleManager:
@@ -194,7 +203,10 @@ class TpuShuffleManager:
                 CompressedSerializer(inner, codec=conf.compress_codec)
                 if conf.compress else inner
             )
-        self.stats = ShuffleReaderStats(conf) if conf.collect_shuffle_reader_stats else None
+        self.stats = (
+            ShuffleReaderStats(conf)
+            if conf.collect_shuffle_reader_stats else None
+        )
 
         if is_driver:
             port = port or conf.driver_port or 37000
@@ -218,6 +230,10 @@ class TpuShuffleManager:
         self.device_arena = None
         self.arena = ArenaManager(conf.max_buffer_allocation_size)
         self.staging_pool = StagingPool(conf.max_buffer_allocation_size)
+        # bulk TCP receives land in pooled buffers served as zero-copy
+        # slices (release tied to slice GC, the
+        # BufferReleasingInputStream analog)
+        self.node.staging_pool = self.staging_pool
         if not is_driver and conf.max_agg_prealloc > 0:
             # warm the pool off the critical path (reference: async
             # preallocation, RdmaBufferManager.java:112-120)
@@ -232,10 +248,12 @@ class TpuShuffleManager:
             staging_pool=self.staging_pool,
             file_backed_threshold=conf.file_backed_commit_bytes,
             spill_dir=conf.spill_dir,
+            lazy_staging=conf.lazy_staging,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)
         self._executors: List[ShuffleManagerId] = []  # join order
+        self._removed: set = set()  # tombstones for pruned executors
         self._executors_lock = threading.Lock()
         self._shuffle_partitions: Dict[int, int] = {}
         self._shuffle_num_maps: Dict[int, int] = {}
@@ -255,6 +273,20 @@ class TpuShuffleManager:
         self._next_callback_id = 1
         self._hello_sent = False
         self._stopped = False
+
+        # heartbeat plane (driver side): last ack time per executor +
+        # monitor thread — the CM DISCONNECTED/onBlockManagerRemoved
+        # analog (RdmaNode.java:176-189, RdmaShuffleManager.scala:253-263)
+        self._last_ack: Dict[ShuffleManagerId, float] = {}
+        self._hb_seq = 0
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if is_driver and conf.heartbeat_interval_ms > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="drv-heartbeat",
+            )
+            self._hb_thread.start()
 
         if not is_driver:
             self._say_hello()
@@ -282,11 +314,14 @@ class TpuShuffleManager:
         )
 
     def _send_msg(self, channel: Channel, msg: RpcMsg,
-                  on_failure: Optional[Callable] = None) -> None:
+                  on_failure: Optional[Callable] = None
+                  ) -> None:
         frames = msg.encode_segments(self.conf.recv_wr_size)
         channel.send_rpc(
-            frames, FnCompletionListener(on_failure=on_failure or (lambda e: logger.warning(
-                "rpc send failed: %s", e)))
+            frames,
+            FnCompletionListener(on_failure=on_failure or (
+                lambda e: logger.warning("rpc send failed: %s", e)
+            )),
         )
 
     def _say_hello(self) -> None:
@@ -313,15 +348,100 @@ class TpuShuffleManager:
             self._handle_fetch_status(msg, channel)
         elif isinstance(msg, FetchMapStatusResponseMsg):
             self._handle_fetch_response(msg)
+        elif isinstance(msg, FetchMapStatusFailedMsg):
+            self._handle_fetch_failed(msg)
+        elif isinstance(msg, HeartbeatMsg):
+            self._handle_heartbeat(msg, channel)
+
+    # -- heartbeat / failure detection ---------------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Driver liveness monitor: ping every executor each interval;
+        prune executors whose acks stop (or whose ping can't even be
+        posted — the loopback-partition / dead-TCP-peer fast path)."""
+        import time as _time
+
+        interval = self.conf.heartbeat_interval_ms / 1000.0
+        timeout = self.conf.heartbeat_timeout_ms / 1000.0
+        while not self._hb_stop.wait(interval):
+            self._hb_seq += 1
+            now = _time.monotonic()
+            for smid in self.executors:
+                # the monitor must survive anything one executor's
+                # bookkeeping throws — a dead monitor silently disables
+                # failure detection for the rest of the job
+                try:
+                    last = self._last_ack.get(smid, now)
+                    if now - last > timeout:
+                        logger.warning(
+                            "driver: executor %s missed heartbeats for "
+                            "%.1fs — pruning",
+                            smid.block_manager_id.executor_id, now - last,
+                        )
+                        self.remove_executor(smid)
+                        continue
+                    try:
+                        ch = self.node.get_channel(
+                            (smid.host, smid.port),
+                            ChannelType.RPC_REQUESTOR,
+                            self.network.connect, must_retry=False,
+                        )
+                        self._send_msg(
+                            ch,
+                            HeartbeatMsg(self.local_smid, self._hb_seq,
+                                         False),
+                            on_failure=lambda e, smid=smid:
+                                self._on_executor_send_failure(smid, e),
+                        )
+                    except Exception as e:
+                        self._on_executor_send_failure(smid, e)
+                except Exception:
+                    logger.exception(
+                        "heartbeat monitor: probe of %s failed", smid.host
+                    )
+
+    def _on_executor_send_failure(self, smid: ShuffleManagerId,
+                                  err: BaseException) -> None:
+        """A control-plane send to an executor failed outright: its
+        channel is dead (partition / closed peer).  Prune immediately —
+        the reference gets this signal from CM DISCONNECTED events."""
+        if self._stopped:
+            return
+        with self._executors_lock:
+            known = smid in self._executors
+        if known:
+            logger.warning(
+                "driver: channel to executor %s dead (%s) — pruning",
+                smid.block_manager_id.executor_id, err,
+            )
+            self.remove_executor(smid)
+
+    def _handle_heartbeat(self, msg: HeartbeatMsg, channel: Channel) -> None:
+        if msg.is_ack:
+            import time as _time
+
+            self._last_ack[msg.shuffle_manager_id] = _time.monotonic()
+            return
+        # executor side: echo on the receiving channel's reply path
+        try:
+            self._send_msg(
+                channel.reply_channel(),
+                HeartbeatMsg(self.local_smid, msg.seq, True),
+            )
+        except Exception:
+            logger.warning("heartbeat ack failed", exc_info=True)
 
     # -- driver handlers -----------------------------------------------------
     def _handle_hello(self, msg: HelloMsg) -> None:
         assert self.is_driver, "hello must only reach the driver"
+        import time as _time
+
         smid = msg.shuffle_manager_id
         with self._executors_lock:
+            self._removed.discard(smid)  # re-join after a prune is legal
             if smid not in self._executors:
                 self._executors.append(smid)
             members = list(self._executors)
+        self._last_ack.setdefault(smid, _time.monotonic())
         logger.info("driver: hello from %s (now %d executors)",
                     smid.block_manager_id.executor_id, len(members))
         announce = AnnounceShuffleManagersMsg(members)
@@ -382,14 +502,35 @@ class TpuShuffleManager:
 
     def _handle_fetch_status(self, msg: FetchMapStatusMsg, channel: Channel) -> None:
         assert self.is_driver, "fetch-status must only reach the driver"
+
+        def reply_failed(reason: str) -> None:
+            # immediate negative answer → requester converts to a
+            # metadata fetch failure and the stage retries NOW instead
+            # of riding out the full location timeout
+            logger.warning("fetch-status failed (shuffle=%d): %s",
+                           msg.shuffle_id, reason)
+            try:
+                self._send_msg(
+                    channel.reply_channel(),
+                    FetchMapStatusFailedMsg(msg.callback_id, reason),
+                )
+            except Exception:
+                logger.exception("fetch-status failure reply failed")
+
+        with self._executors_lock:
+            tombstoned = msg.host in self._removed
+        if tombstoned:
+            reply_failed(
+                f"executor {msg.host.host}:{msg.host.port} was removed"
+            )
+            return
         try:
             mtos = {
                 mid: self._get_or_create_mto(msg.shuffle_id, msg.host, mid)
                 for mid in {m for m, _ in msg.block_ids}
             }
         except KeyError:
-            logger.warning("fetch-status for unregistered shuffle %d",
-                           msg.shuffle_id)
+            reply_failed(f"shuffle {msg.shuffle_id} not registered on driver")
             return
 
         def answer():
@@ -400,11 +541,10 @@ class TpuShuffleManager:
                     if t.fill_future.exception() is not None
                 ]
                 if failed:
-                    # executor lost mid-publish; requester's timer converts
-                    # this to a metadata fetch failure
-                    logger.warning(
-                        "fetch-status unanswerable: maps %s of shuffle %d "
-                        "lost before publish completed", failed, msg.shuffle_id,
+                    # executor lost mid-publish
+                    reply_failed(
+                        f"maps {sorted(failed)} lost before publish "
+                        f"completed (executor removed)"
                     )
                     return
                 locs = [mtos[m].get_location(r) for m, r in msg.block_ids]
@@ -447,13 +587,21 @@ class TpuShuffleManager:
             return
         cb.on_response(msg)
 
+    def _handle_fetch_failed(self, msg: FetchMapStatusFailedMsg) -> None:
+        with self._callbacks_lock:
+            cb = self._callbacks.get(msg.callback_id)
+        if cb is None:
+            return  # reader already gone (timeout fired / task ended)
+        cb.on_failed(msg.reason)
+
     def register_fetch_callback(
-        self, on_locations: Callable[[List[BlockLocation]], None]
+        self, on_locations: Callable[[List[BlockLocation]], None],
+        on_error: Optional[Callable[[str], None]] = None,
     ) -> int:
         with self._callbacks_lock:
             cb_id = self._next_callback_id
             self._next_callback_id += 1
-            self._callbacks[cb_id] = _FetchCallback(on_locations)
+            self._callbacks[cb_id] = _FetchCallback(on_locations, on_error)
         return cb_id
 
     def unregister_fetch_callback(self, cb_id: int) -> None:
@@ -529,6 +677,8 @@ class TpuShuffleManager:
         with self._executors_lock:
             if smid in self._executors:
                 self._executors.remove(smid)
+            self._removed.add(smid)
+        self._last_ack.pop(smid, None)
         with self._outputs_lock:
             doomed: List[MapTaskOutput] = []
             for by_host in self._outputs.values():
@@ -536,10 +686,18 @@ class TpuShuffleManager:
                 if by_map:
                     doomed.extend(by_map.values())
         for mto in doomed:
-            if not mto.fill_future.done():
-                mto.fill_future.set_exception(
-                    RuntimeError(f"executor lost: {smid.host}:{smid.port}")
-                )
+            # check-then-set races a concurrently completing publish;
+            # losing that race is fine (the table filled — readers can
+            # use it), it must just not kill the caller
+            try:
+                if not mto.fill_future.done():
+                    mto.fill_future.set_exception(
+                        RuntimeError(
+                            f"executor lost: {smid.host}:{smid.port}"
+                        )
+                    )
+            except Exception:
+                pass
 
     # -- in-process helpers for the job layer --------------------------------
     def maps_by_host(self, shuffle_id: int) -> Dict[ShuffleManagerId, List[int]]:
@@ -558,6 +716,9 @@ class TpuShuffleManager:
         if self._stopped:
             return
         self._stopped = True
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         if self.stats is not None:
             self.stats.print_stats()
         if self.conf.trace:

@@ -153,7 +153,17 @@ class ShuffleReader:
                 )
                 self._enqueue_fetches(host, locs)
 
-            cb_id = self.manager.register_fetch_callback(on_locations)
+            def on_status_failed(reason, host=host, timer=timer):
+                # driver answered negatively (executor lost / shuffle
+                # unregistered): fail NOW, not at the timeout
+                timer.cancel()
+                self._fail(MetadataFetchFailedError(
+                    host.host, self.handle.shuffle_id, reason
+                ))
+
+            cb_id = self.manager.register_fetch_callback(
+                on_locations, on_status_failed
+            )
             self._callback_ids.append(cb_id)
             msg = FetchMapStatusMsg(
                 self.manager.local_smid, host, self.handle.shuffle_id,

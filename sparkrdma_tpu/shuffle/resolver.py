@@ -15,6 +15,7 @@ RdmaShuffleBlockResolver.scala:73-78).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -25,6 +26,8 @@ from sparkrdma_tpu.memory.device_arena import ROW_BYTES as _ROW_BYTES
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.utils.types import BlockLocation
+
+logger = logging.getLogger(__name__)
 
 
 class ChunkedPayload:
@@ -68,10 +71,18 @@ class ShuffleBlockResolver:
     def __init__(self, arena: ArenaManager, node: Optional[Node] = None,
                  stage_to_device: bool = True, staging_pool=None,
                  file_backed_threshold: int = 0,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 lazy_staging: bool = False):
         self.arena = arena
         self.node = node
         self.stage_to_device = stage_to_device
+        # ODP analog (RdmaShuffleConf.scala:68-83,
+        # RdmaBufferManager.java:103-110): commits stay in host memory;
+        # the first device-plane touch stages the segment into the HBM
+        # arena on demand (ensure_staged), optionally swept ahead by
+        # prefetch_shuffle (RdmaMappedFile.java:158-168's odp prefetch)
+        self.lazy_staging = lazy_staging
+        self._stage_lock = threading.Lock()
         self.staging_pool = staging_pool  # pooled host buffers for concat
         # persistent per-device HBM arena (set when the executor is
         # attached to a collective network); commits then land as arena
@@ -93,10 +104,76 @@ class ShuffleBlockResolver:
         commits: arena-resident blocks are row-gathered by the
         collective plane, so their offsets must be ROW_BYTES-aligned
         (unaligned blocks still read correctly — they just fall back to
-        the host path)."""
-        if self.stage_to_device and self.device_arena is not None:
+        the host path).  Lazy commits align too: they may be staged
+        into the arena later."""
+        if self.device_arena is not None and (
+                self.stage_to_device or self.lazy_staging):
             return _ROW_BYTES
         return 1
+
+    # -- lazy staging (the ODP page-fault path) ------------------------------
+    def ensure_staged(self, mkey: int):
+        """Stage a host-committed segment into the device arena on
+        demand, keeping its mkey (published locations stay valid).
+        Returns the (possibly already) arena-backed segment, or None
+        when this block cannot ride the device plane."""
+        if not self.lazy_staging or self.device_arena is None:
+            return None
+        with self._stage_lock:
+            seg = self.arena.get(mkey)
+            if seg is None:
+                return None
+            if getattr(seg, "span", None) is not None:
+                return seg  # already staged (racing reader won)
+            arr = getattr(seg, "array", None)
+            if not isinstance(arr, np.ndarray) or arr.dtype != np.uint8:
+                return None  # not host bytes (already a device array)
+            span = self.device_arena.alloc(max(int(arr.shape[0]), 1))
+            try:
+                self.device_arena.write(span, arr)
+                new_seg = self.arena.replace_with_span(mkey, span)
+            except BaseException:
+                span.free()
+                raise
+            if new_seg is not None:
+                # swap the shuffle-output entry too, dropping the last
+                # reference to the host copy (local reads now serve from
+                # the arena; the host bytes free once views die)
+                with self._lock:
+                    sd = self._shuffles.get(new_seg.shuffle_id)
+                    if sd is not None:
+                        for mid, (mto, s) in sd.outputs.items():
+                            if s.mkey == mkey:
+                                sd.outputs[mid] = (mto, new_seg)
+                                break
+            return new_seg
+
+    def prefetch_shuffle(self, shuffle_id: int) -> int:
+        """Stage every host-resident segment of one shuffle ahead of
+        the reads (the ODP prefetch sweep, RdmaMappedFile.java:158-168).
+        Returns how many of the shuffle's segments are arena-resident
+        after the sweep."""
+        if not self.lazy_staging or self.device_arena is None:
+            return 0
+        with self._lock:
+            sd = self._shuffles.get(shuffle_id)
+            mkeys = (
+                [seg.mkey for _, seg in sd.outputs.values()] if sd else []
+            )
+        staged = 0
+        for mkey in mkeys:
+            try:
+                seg = self.ensure_staged(mkey)
+            except MemoryError:
+                # arena full: skip — the segment keeps serving from
+                # host, exactly like the on-demand path's fallback
+                logger.warning(
+                    "prefetch: staging mkey=%d skipped (arena full)", mkey
+                )
+                continue
+            if seg is not None:
+                staged += 1
+        return staged
 
     def _get_or_create(self, shuffle_id: int, num_partitions: int) -> _ShuffleData:
         with self._lock:

@@ -14,13 +14,11 @@ from __future__ import annotations
 
 import os
 import tempfile
-import threading
 import time
-from typing import Any, Callable, Iterable, List, Optional, Tuple
+from typing import Any, Iterable, List, Optional, Tuple
 
 import numpy as np
 
-from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.utils.columns import (
     ColumnBatch,

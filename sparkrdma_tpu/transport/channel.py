@@ -26,7 +26,7 @@ from __future__ import annotations
 import enum
 import threading
 from collections import deque
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Sequence
 
 from sparkrdma_tpu.utils.types import BlockLocation
 

@@ -20,7 +20,7 @@ same failure semantics the reference gets from CQ error completions.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from sparkrdma_tpu.transport.channel import (
     Channel,

@@ -54,6 +54,9 @@ class Node:
         self.address = address
         self.conf = conf or TpuShuffleConf()
         self.is_executor = is_executor
+        # optional pooled-buffer source for bulk receives (set by the
+        # owning manager; TCP read responses land in pooled buffers)
+        self.staging_pool = None
         self._receive_listener: Optional[ReceiveListener] = None
         self._block_stores: Dict[int, BlockStore] = {}
         self._block_store_lock = threading.Lock()

@@ -172,19 +172,45 @@ class TcpChannel(Channel):
                 opcode, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
                 if length > _MAX_FRAME:
                     raise TransportError(f"oversized frame: {length}B")
+                if opcode == OP_READ_RESP:
+                    # bulk data lands in a POOLED buffer; blocks are
+                    # zero-copy slices whose collection returns it
+                    # (BufferReleasingInputStream analog via alloc_gc)
+                    self._finish_read(self._recv_payload(length))
+                    continue
                 payload = _recv_exact(self._sock, length) if length else b""
                 if opcode == OP_RPC:
                     self.node.dispatch_frame(self, payload)
                 elif opcode == OP_READ_REQ:
                     self._serve_read(payload)
-                elif opcode == OP_READ_RESP:
-                    self._finish_read(payload)
                 else:
                     raise TransportError(f"unknown opcode {opcode}")
         except BaseException as e:
             if self.state not in (ChannelState.STOPPED,):
                 self._error(e)
                 self._fail_outstanding(e)
+
+    def _recv_payload(self, length: int):
+        """Receive a bulk payload, preferring a pooled staging buffer
+        (zero-copy slices for the consumer, pool reuse on release)."""
+        pool = getattr(self.node, "staging_pool", None)
+        if pool is not None and length > 0:
+            try:
+                arr = pool.alloc_gc(length)
+            except MemoryError:
+                arr = None
+            if arr is not None:
+                view = memoryview(arr)[:length]
+                got = 0
+                while got < length:
+                    n = self._sock.recv_into(view[got:], length - got)
+                    if n == 0:
+                        raise TransportError("connection closed by peer")
+                    got += n
+                out = arr[:length]
+                out.flags.writeable = False
+                return out
+        return _recv_exact(self._sock, length) if length else b""
 
     def _fail_outstanding(self, err: BaseException) -> None:
         with self._reads_lock:
@@ -232,7 +258,7 @@ class TcpChannel(Channel):
         try:
             if status != 0:
                 raise TransportError(
-                    payload[_RESP_HDR.size:].decode("utf-8", "replace")
+                    bytes(payload[_RESP_HDR.size:]).decode("utf-8", "replace")
                 )
             blocks, off = [], _RESP_HDR.size
             for _ in range(count):
@@ -257,7 +283,9 @@ class TcpNetwork:
 
     def __init__(self, listen_backlog: int = 128):
         self.listen_backlog = listen_backlog
-        self._listeners: Dict[Address, Tuple[socket.socket, threading.Thread, Node]] = {}
+        self._listeners: Dict[
+            Address, Tuple[socket.socket, threading.Thread, Node]
+        ] = {}
         self._lock = threading.Lock()
 
     # -- membership ---------------------------------------------------------

@@ -16,7 +16,7 @@ import contextlib
 import json
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 class Tracer:

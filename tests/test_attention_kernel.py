@@ -4,7 +4,6 @@ attention end-to-end through both implementations."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from sparkrdma_tpu.models.ring_attention import ring_attention

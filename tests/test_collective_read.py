@@ -14,7 +14,7 @@ import pytest
 
 from sparkrdma_tpu.api import TpuShuffleContext
 from sparkrdma_tpu.conf import TpuShuffleConf
-from sparkrdma_tpu.memory.device_arena import ROW_BYTES, WRITE_ALIGN, DeviceArena
+from sparkrdma_tpu.memory.device_arena import WRITE_ALIGN, DeviceArena
 from sparkrdma_tpu.parallel.collective_read import CollectiveNetwork
 from sparkrdma_tpu.parallel.mesh import make_mesh
 

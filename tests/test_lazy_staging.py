@@ -1,0 +1,155 @@
+"""Lazy staging — the ODP analog made real (VERDICT round-1 item 5).
+
+Reference: ``useOdp`` registers memory on demand with an optional
+prefetch advise (RdmaShuffleConf.scala:68-83,
+RdmaBufferManager.java:103-110, RdmaMappedFile.java:158-168).  Here
+``lazyStaging=true`` keeps commits in host memory; the first collective
+(device-plane) touch faults the segment into the HBM arena under its
+original mkey, and ``prefetch_shuffle`` sweeps a whole shuffle ahead of
+the reads.
+"""
+
+import numpy as np
+
+from sparkrdma_tpu.api import TpuShuffleContext
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.memory.arena import ArenaSpanSegment
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+
+
+def _conf(lazy: bool):
+    conf = TpuShuffleConf()
+    conf.set("readPlane", "collective")
+    conf.set("deviceArenaBytes", 8 << 20)
+    conf.set("serializer", "columnar")
+    if lazy:
+        conf.set("lazyStaging", "true")
+    return conf
+
+
+def _segments(ex):
+    with ex.arena._lock:
+        return [s for s in ex.arena._segments.values()]
+
+
+def _run_one_map(ctx, shuffle_id, ex_index=0):
+    """Commit one map output on one executor, no reads."""
+    part = HashPartitioner(4)
+    handle = ctx.driver.register_shuffle(shuffle_id, 1, part)
+    ex = ctx.executors[ex_index]
+    w = ex.get_writer(handle, 0)
+    w.write([(i % 7, i) for i in range(400)])
+    w.stop(True)
+    return handle, ex
+
+
+def test_eager_commit_is_arena_resident(devices):
+    with TpuShuffleContext(
+        num_executors=2, conf=_conf(lazy=False), base_port=51000
+    ) as ctx:
+        _, ex = _run_one_map(ctx, 0)
+        segs = _segments(ex)
+        assert segs and all(
+            isinstance(s, ArenaSpanSegment) for s in segs
+        ), "eager staging must commit straight into the device arena"
+
+
+def test_lazy_commit_stays_on_host_then_faults_in(devices):
+    with TpuShuffleContext(
+        num_executors=2, conf=_conf(lazy=True), base_port=52000
+    ) as ctx:
+        part = HashPartitioner(4)
+        handle = ctx.driver.register_shuffle(7, 2, part)
+        from collections import defaultdict
+
+        maps_by_host = defaultdict(list)
+        for map_id in range(2):
+            ex = ctx.executors[map_id]
+            w = ex.get_writer(handle, map_id)
+            w.write([(i % 5, i) for i in range(300)])
+            w.stop(True)
+            maps_by_host[ex.local_smid].append(map_id)
+
+        # BEFORE any read: committed segments are host numpy, NOT arena
+        for ex in ctx.executors:
+            segs = _segments(ex)
+            assert segs
+            assert all(
+                not isinstance(s, ArenaSpanSegment)
+                and isinstance(getattr(s, "array", None), np.ndarray)
+                for s in segs
+            ), "lazy commit must stay in host memory until first touch"
+
+        # cross-executor read: the collective plane faults segments in
+        got = {}
+        for pid in range(4):
+            ex = ctx.executors[pid % 2]
+            reader = ex.get_reader(handle, pid, pid + 1, dict(maps_by_host))
+            for k, v in reader.read():
+                got[k] = got.get(k, 0) + (
+                    len(v) if hasattr(v, "__len__") else 1
+                )
+        assert sum(got.values()) == 600
+
+        stats = ctx.network.coordinator.stats()
+        assert stats["rounds_executed"] > 0, "reads must ride the collective"
+        assert stats["fallback_blocks"] == 0, (
+            "lazy segments must fault into the arena, not fall back"
+        )
+        # AFTER the reads: remotely-touched segments are arena-resident
+        staged = [
+            s for ex in ctx.executors for s in _segments(ex)
+            if isinstance(s, ArenaSpanSegment)
+        ]
+        assert staged, "first device-plane touch must stage segments"
+
+
+def test_prefetch_sweep_stages_everything(devices):
+    with TpuShuffleContext(
+        num_executors=2, conf=_conf(lazy=True), base_port=53000
+    ) as ctx:
+        _, ex = _run_one_map(ctx, 3)
+        assert not any(
+            isinstance(s, ArenaSpanSegment) for s in _segments(ex)
+        )
+        n = ex.resolver.prefetch_shuffle(3)
+        assert n == 1
+        assert all(
+            isinstance(s, ArenaSpanSegment) for s in _segments(ex)
+        ), "prefetch sweep must stage every segment of the shuffle"
+        # segment content survives the swap (same mkey, same bytes)
+        data = ex.resolver.get_local_block(3, 0, 0)
+        assert isinstance(data, bytes)
+
+
+def test_lazy_without_device_arena_is_host_only(devices):
+    """lazyStaging on the plain host plane: commits stay host, reads
+    work, ensure_staged is a no-op."""
+    conf = TpuShuffleConf()
+    conf.set("lazyStaging", "true")
+    with TpuShuffleContext(
+        num_executors=2, conf=conf, base_port=54000
+    ) as ctx:
+        handle, ex = _run_one_map(ctx, 0)
+        assert ex.resolver.ensure_staged(
+            _segments(ex)[0].mkey
+        ) is None
+        assert ex.resolver.prefetch_shuffle(0) == 0
+        data = ex.resolver.get_local_block(0, 0, 0)
+        assert isinstance(data, (bytes, np.ndarray, memoryview))
+
+
+def test_lazy_read_result_matches_eager(devices):
+    data = [(i % 11, i) for i in range(2000)]
+
+    def run(lazy, port):
+        with TpuShuffleContext(
+            num_executors=2, conf=_conf(lazy=lazy), base_port=port
+        ) as ctx:
+            return sorted(
+                ctx.parallelize(data, num_slices=4)
+                .reduce_by_key(lambda a, b: a + b, num_partitions=4)
+                .collect()
+            )
+
+    assert run(False, 55000) == run(True, 56000)

@@ -206,3 +206,68 @@ def test_mapped_file_empty_input(tmp_path):
     finally:
         mf.free()
     assert not list(tmp_path.iterdir()), "file must be unlinked on free"
+
+
+def test_alloc_gc_returns_on_collection():
+    """alloc_gc ties pool release to GC of the view and its slices
+    (the BufferReleasingInputStream analog)."""
+    import gc
+
+    from sparkrdma_tpu.memory.staging import StagingPool
+
+    for force_python in (True, False):
+        pool = StagingPool(1 << 22, force_python=force_python)
+        arr = pool.alloc_gc(100_000)
+        arr[:4] = [1, 2, 3, 4]
+        sl = arr[:4].copy()  # consumer data survives buffer release
+        view = arr[1:3]  # a consumer slice keeps the buffer alive
+        before = pool.stats()
+        assert before["in_use"] > 0
+        del arr
+        gc.collect()
+        # slice still alive -> buffer must NOT have returned
+        assert pool.stats()["in_use"] == before["in_use"]
+        assert bytes(view) == b"\x02\x03"
+        del view
+        gc.collect()
+        after = pool.stats()
+        assert after["in_use"] == 0, (force_python, after)
+        assert bytes(sl) == b"\x01\x02\x03\x04"
+        pool.close()
+
+
+def test_alloc_gc_native_reuses_block():
+    from sparkrdma_tpu.memory.staging import StagingPool
+
+    import gc
+
+    pool = StagingPool(1 << 22)
+    if not pool.is_native:
+        pool.close()
+        import pytest
+
+        pytest.skip("native staging allocator not built")
+    a = pool.alloc_gc(50_000)
+    addr_a = a.ctypes.data
+    del a
+    gc.collect()
+    b = pool.alloc_gc(50_000)
+    assert b.ctypes.data == addr_a, "freed block must be reused"
+    del b
+    gc.collect()
+    pool.close()
+
+
+def test_alloc_gc_close_with_outstanding_views_is_safe():
+    import gc
+
+    from sparkrdma_tpu.memory.staging import StagingPool
+
+    pool = StagingPool(1 << 22)
+    arr = pool.alloc_gc(10_000)
+    arr[:3] = [9, 8, 7]
+    pool.close()
+    # view stays readable after close (leak, not use-after-free)
+    assert bytes(arr[:3]) == b"\x09\x08\x07"
+    del arr
+    gc.collect()

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from sparkrdma_tpu.ops import (
     hash_partition_ids,

@@ -16,7 +16,9 @@ from sparkrdma_tpu.utils.types import BlockLocation, BlockManagerId, ShuffleMana
 
 
 def smid(i: int) -> ShuffleManagerId:
-    return ShuffleManagerId(f"host{i}", 9000 + i, BlockManagerId(str(i), f"host{i}", 7000 + i))
+    return ShuffleManagerId(
+        f"host{i}", 9000 + i, BlockManagerId(str(i), f"host{i}", 7000 + i)
+    )
 
 
 def test_hello_roundtrip():
@@ -190,3 +192,24 @@ def test_compressed_serializer_multi_frame_roundtrip():
     records = [(i, i * 3) for i in range(1050)]  # 11 frames
     blob = s.serialize(records)
     assert list(s.deserialize(blob)) == records
+
+
+def test_fetch_failed_roundtrip():
+    from sparkrdma_tpu.rpc.messages import FetchMapStatusFailedMsg
+
+    msg = FetchMapStatusFailedMsg(77, "executor host3:9003 was removed")
+    out = decode_msg(msg.encode())
+    assert out == msg
+    # reasons are clamped to 1 KiB on the wire
+    long = FetchMapStatusFailedMsg(1, "x" * 5000)
+    got = decode_msg(long.encode())
+    assert got.callback_id == 1 and len(got.reason) == 1024
+
+
+def test_heartbeat_roundtrip():
+    from sparkrdma_tpu.rpc.messages import HeartbeatMsg
+
+    ping = HeartbeatMsg(smid(4), seq=12, is_ack=False)
+    ack = HeartbeatMsg(smid(5), seq=12, is_ack=True)
+    assert decode_msg(ping.encode()) == ping
+    assert decode_msg(ack.encode()) == ack

@@ -1,7 +1,6 @@
 """End-to-end shuffle over loopback: the minimum slice of SURVEY.md §7 —
 write → publish → resolve → fetch → read across multiple executors."""
 
-import threading
 import time
 from collections import defaultdict
 

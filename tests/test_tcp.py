@@ -159,3 +159,64 @@ def test_tcp_multiprocess_shuffle():
             if p.is_alive():
                 p.terminate()
         driver.stop()
+
+
+def test_tcp_read_responses_ride_pooled_buffers():
+    """Remote TCP fetches land in pooled staging buffers and reach the
+    reader as zero-copy slices; the pool reclaims once consumed.
+    (Own ports: earlier tests' listeners can linger in TIME_WAIT.)"""
+    import gc
+
+    import numpy as np
+
+    driver_port = BASE_PORT + 800
+    conf = make_conf(driver_port)
+    driver = TpuShuffleManager(
+        conf, is_driver=True, network=TcpNetwork(),
+        port=driver_port, stage_to_device=False,
+    )
+    executors = [
+        TpuShuffleManager(
+            make_conf(driver_port), is_driver=False, network=TcpNetwork(),
+            port=driver_port + 100 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == 2 for e in executors):
+            break
+        time.sleep(0.01)
+    part = HashPartitioner(2)
+    handle = driver.register_shuffle(9, 1, part)
+    w = executors[1].get_writer(handle, 0)
+    w.write([(f"k{i}", b"x" * 200) for i in range(500)])
+    w.stop(True)
+    maps_by_host = {executors[1].local_smid: [0]}
+
+    captured = []
+    from sparkrdma_tpu.transport.channel import Channel
+
+    orig = Channel._complete
+
+    def spy(self, listener, result):
+        if isinstance(result, list):
+            captured.extend(result)
+        return orig(self, listener, result)
+
+    Channel._complete = spy
+    try:
+        reader = executors[0].get_reader(handle, 0, 2, maps_by_host)
+        out = list(reader.read())
+    finally:
+        Channel._complete = orig
+    assert len(out) == 500
+    blocks = [b for b in captured if isinstance(b, np.ndarray)]
+    assert blocks, "remote blocks should be pooled-buffer views"
+    assert all(not b.flags.writeable for b in blocks)
+    del blocks, captured
+    gc.collect()
+    assert executors[0].staging_pool.stats()["in_use"] == 0
+    for m in executors + [driver]:
+        m.stop()

@@ -2,13 +2,10 @@
 (SURVEY.md §2 rows RdmaNode/RdmaChannel; §5 failure detection)."""
 
 import threading
-import time
-
 import pytest
 
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.transport import (
-    Channel,
     ChannelType,
     FnCompletionListener,
     LoopbackNetwork,
@@ -88,7 +85,8 @@ def test_one_sided_read(net):
     b.register_block_store(7, BytesBlockStore(payload))
     ch = a.get_channel(b.address, ChannelType.READ_REQUESTOR, network.connect)
     result, done = [], threading.Event()
-    locs = [BlockLocation(0, 16, 7), BlockLocation(256, 32, 7), BlockLocation(4000, 8, 7)]
+    locs = [BlockLocation(0, 16, 7), BlockLocation(256, 32, 7),
+            BlockLocation(4000, 8, 7)]
     ch.read_blocks(locs, FnCompletionListener(lambda r: (result.append(r), done.set())))
     wait_for(done)
     blocks = result[0]
@@ -111,7 +109,8 @@ def test_read_unknown_mkey_fails(net):
 
 def test_connect_refused_and_retries(net):
     network, make_node = net
-    a = make_node(9000, conf=TpuShuffleConf({"spark.shuffle.tpu.maxConnectionAttempts": 2}))
+    a = make_node(9000, conf=TpuShuffleConf(
+        {"spark.shuffle.tpu.maxConnectionAttempts": 2}))
     with pytest.raises(TransportError, match="could not connect"):
         a.get_channel(("127.0.0.1", 9999), ChannelType.RPC_REQUESTOR, network.connect)
 

@@ -1,6 +1,5 @@
 """Wire-format round-trips for ids and locations (SURVEY.md §2, RdmaUtils)."""
 
-import pytest
 
 from sparkrdma_tpu.utils.types import (
     LOCATION_ENTRY_SIZE,
