@@ -41,7 +41,7 @@ def main():
     cap = wc._capacity(n_local, factor=4.0)
 
     def run():
-        (uniq, sums, n_unique, fill), _ = wc.count_device(
+        (uniq, sums, counts, n_unique, fill), _ = wc.count_device(
             keys, vals, valid, capacity=cap
         )
         return uniq, n_unique

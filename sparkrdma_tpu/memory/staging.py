@@ -175,21 +175,27 @@ class StagingPool:
         accounting is adjusted (numpy owns the pages)."""
         if size <= 0:
             raise ValueError(f"alloc size must be > 0: {size}")
-        if self._closed:
-            raise MemoryError("pool closed")
         if self.is_native:
-            ptr = _NATIVE.staging_alloc(self._handle, ctypes.c_uint64(size))
-            if not ptr:
-                raise MemoryError(
-                    f"staging pool budget exhausted allocating {size}B "
-                    f"(budget {self.max_bytes}B)"
-                )
-            cap = _NATIVE.staging_block_size(
-                self._handle, ctypes.c_void_p(ptr)
-            )
-            raw = (ctypes.c_uint8 * cap).from_address(ptr)
+            # closed-check, alloc, and the live-count publication happen
+            # under ONE lock hold: close() destroys the native pool when
+            # _gc_live == 0, so a gap here would let it free the handle
+            # mid-allocation
             with self._lock:
+                if self._closed or self._handle is None:
+                    raise MemoryError("pool closed")
+                ptr = _NATIVE.staging_alloc(
+                    self._handle, ctypes.c_uint64(size)
+                )
+                if not ptr:
+                    raise MemoryError(
+                        f"staging pool budget exhausted allocating {size}B "
+                        f"(budget {self.max_bytes}B)"
+                    )
+                cap = _NATIVE.staging_block_size(
+                    self._handle, ctypes.c_void_p(ptr)
+                )
                 self._gc_live += 1
+            raw = (ctypes.c_uint8 * cap).from_address(ptr)
 
             def _ret(pool=self, address=ptr):
                 # runs when raw (kept alive by every slice's base chain)
@@ -217,17 +223,11 @@ class StagingPool:
             weakref.finalize(raw, _ret)
             return np.frombuffer(raw, dtype=np.uint8)
         # python fallback: fresh numpy memory, GC frees it to the OS
+        if self._closed:
+            raise MemoryError("pool closed")
         cls = self._round_class(size)
         with self._lock:
-            self._tick += 1
-            self._total_allocs += 1
-            if self.max_bytes and self._owned + cls > self.max_bytes:
-                self._py_trim(0)
-                if self._owned + cls > self.max_bytes:
-                    self._failed += 1
-                    raise MemoryError(
-                        f"staging pool budget exhausted allocating {size}B"
-                    )
+            self._py_reserve(size, cls)
             self._owned += cls
             self._in_use += cls
         view = np.empty(cls, dtype=np.uint8)
@@ -320,23 +320,32 @@ class StagingPool:
             c <<= 1
         return c
 
+    def _py_reserve(self, size: int, cls: int) -> None:
+        """Account one allocation and ensure budget headroom for a NEW
+        ``cls``-sized block (lock held; shared by _py_alloc and the
+        python alloc_gc path so the trim/budget policy lives once)."""
+        self._tick += 1
+        self._total_allocs += 1
+        self._last_use[cls] = self._tick
+        if self.max_bytes and self._owned + cls > self.max_bytes:
+            self._py_trim(0)
+            if self._owned + cls > self.max_bytes:
+                self._failed += 1
+                raise MemoryError(
+                    f"staging pool budget exhausted allocating {size}B"
+                )
+
     def _py_alloc(self, size: int) -> StagingBuffer:
         cls = self._round_class(size)
         with self._lock:
-            self._tick += 1
-            self._total_allocs += 1
-            self._last_use[cls] = self._tick
             lst = self._free_lists.setdefault(cls, [])
             if lst:
+                self._tick += 1
+                self._total_allocs += 1
+                self._last_use[cls] = self._tick
                 view = lst.pop()
             else:
-                if self.max_bytes and self._owned + cls > self.max_bytes:
-                    self._py_trim(0)
-                    if self._owned + cls > self.max_bytes:
-                        self._failed += 1
-                        raise MemoryError(
-                            f"staging pool budget exhausted allocating {size}B"
-                        )
+                self._py_reserve(size, cls)
                 view = np.zeros(cls, dtype=np.uint8)
                 self._owned += cls
             self._in_use += cls

@@ -83,7 +83,9 @@ class KeyedAggregator(ExchangeModel):
         uniq_h, sums_h, counts_h, mins_h, maxs_h = rows
         out: Dict[int, KeyStats] = {}
         for d in range(self.n_devices):
-            for i in range(nu[d]):
+            # results live at run-end positions: extract by counts > 0
+            (idx,) = (counts_h[d] > 0).nonzero()
+            for i in idx:
                 out[int(uniq_h[d, i])] = KeyStats(
                     int(sums_h[d, i]), int(counts_h[d, i]),
                     int(mins_h[d, i]), int(maxs_h[d, i]),

@@ -43,12 +43,14 @@ def make_count_step(mesh: Mesh, n_local: int, capacity: int):
         sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
         flat_k = jnp.where(flat_m > 0, flat_k, sentinel)
         flat_v = jnp.where(flat_m > 0, flat_v, jnp.zeros((), v.dtype))
-        uniq, sums, _cnts, n_unique = reduce_by_key_local(flat_k, flat_v, flat_m)
-        return uniq, sums, n_unique[None], max_fill[None]
+        uniq, sums, cnts, n_unique = reduce_by_key_local(
+            flat_k, flat_v, flat_m
+        )
+        return uniq, sums, cnts, n_unique[None], max_fill[None]
 
     mapped = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=(spec, spec, spec, spec),
+        out_specs=(spec,) * 5,
     )
     return jax.jit(mapped)
 
@@ -83,9 +85,11 @@ class WordCounter(ExchangeModel):
         rows, nu = self._run_padded_keyed(keys, vals, make_count_step)
         if rows is None:
             return {}
-        uniq_h, sums_h = rows
+        uniq_h, sums_h, counts_h = rows
         out: Dict[int, int] = {}
         for d in range(self.n_devices):
-            for k, s in zip(uniq_h[d, : nu[d]], sums_h[d, : nu[d]]):
+            # results live at run-end positions: extract by counts > 0
+            mask = counts_h[d] > 0
+            for k, s in zip(uniq_h[d][mask], sums_h[d][mask]):
                 out[int(k)] = int(s)
         return out

@@ -70,6 +70,11 @@ class Node:
         self._dispatcher = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix=f"node-{address[0]}:{address[1]}"
         )
+        # bulk work (read service) runs on its OWN pool so multi-MB
+        # block serves can never starve control-plane traffic — a
+        # starved heartbeat ack would get a healthy executor pruned
+        self._bulk_pool: Optional[ThreadPoolExecutor] = None
+        self._bulk_lock = threading.Lock()
         self._stopped = threading.Event()
 
     # -- receive dispatch ---------------------------------------------------
@@ -111,6 +116,22 @@ class Node:
     def submit(self, fn, *args):
         """Run fn on the dispatcher (async completion delivery)."""
         return self._dispatcher.submit(fn, *args)
+
+    def submit_bulk(self, fn, *args):
+        """Run bulk data-plane work (block serving) on the dedicated
+        bulk pool, created on first use."""
+        pool = self._bulk_pool
+        if pool is None:
+            with self._bulk_lock:
+                if self._bulk_pool is None:
+                    self._bulk_pool = ThreadPoolExecutor(
+                        max_workers=2,
+                        thread_name_prefix=(
+                            f"bulk-{self.address[0]}:{self.address[1]}"
+                        ),
+                    )
+                pool = self._bulk_pool
+        return pool.submit(fn, *args)
 
     # -- block stores (registered memory domains) ---------------------------
     def register_block_store(self, mkey: int, store: BlockStore) -> None:
@@ -207,6 +228,10 @@ class Node:
             with ThreadPoolExecutor(max_workers=min(8, len(channels))) as pool:
                 list(pool.map(lambda c: c.stop(), channels))
         self._dispatcher.shutdown(wait=True)
+        with self._bulk_lock:
+            bulk, self._bulk_pool = self._bulk_pool, None
+        if bulk is not None:
+            bulk.shutdown(wait=True)
         with self._block_store_lock:
             self._block_stores.clear()
 

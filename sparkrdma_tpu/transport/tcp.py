@@ -182,7 +182,13 @@ class TcpChannel(Channel):
                 if opcode == OP_RPC:
                     self.node.dispatch_frame(self, payload)
                 elif opcode == OP_READ_REQ:
-                    self._serve_read(payload)
+                    # serve OFF the reader thread: one large read must
+                    # not head-of-line-block further frames on this
+                    # channel (the reference's CQ model has no such
+                    # serialization — the NIC serves reads).  Bulk pool,
+                    # not the dispatcher: multi-MB serves must never
+                    # starve heartbeat/RPC dispatch
+                    self.node.submit_bulk(self._serve_read, payload)
                 else:
                     raise TransportError(f"unknown opcode {opcode}")
         except BaseException as e:
