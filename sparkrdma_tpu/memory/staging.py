@@ -120,7 +120,11 @@ class StagingPool:
     def __init__(self, max_bytes: int = 0, force_python: bool = False):
         self.max_bytes = max_bytes
         self.is_native = _NATIVE is not None and not force_python
-        self._lock = threading.Lock()
+        # RLock: a cyclic-GC pass triggered INSIDE a locked region can
+        # run an alloc_gc finalizer on the same thread, which takes
+        # this lock again — re-entrant entry is safe (counter updates;
+        # destroy needs _closed, impossible mid-alloc)
+        self._lock = threading.RLock()
         self._closed = False
         # outstanding alloc_gc buffers: close() must DEFER destroying
         # the native pool until the last one is collected (destroying

@@ -230,23 +230,53 @@ class TileExchange:
 
     # -- host-driven byte exchange ------------------------------------------
     def exchange_bytes(
-        self, streams: Sequence[Sequence[bytes]]
+        self, streams: Sequence[Sequence[bytes]],
+        lengths: Optional[np.ndarray] = None,
     ):
         """Move ``streams[s][d]`` → ``out[d][s]``.  Single-host (every
         destination addressable) returns plain ``[D][S]`` lists; on a
         multi-host mesh the return is a :class:`HostLocalStreams` whose
         remote destination rows raise on access (each process holds
-        only its own devices' shards)."""
+        only its own devices' shards).
+
+        Multi-host contract: every process must call with the SAME
+        ``lengths`` matrix (the plan's tile/round shapes derive from
+        it — divergent shapes would compile different programs and
+        deadlock the collective), but only needs real data for its own
+        sources' rows; remote sources' streams may be empty — their
+        shards are not addressable here and never read."""
         D = self.n_devices
         if len(streams) != D or any(len(row) != D for row in streams):
             raise ValueError(
                 f"streams must be [{D}][{D}], got "
                 f"[{len(streams)}][{[len(r) for r in streams]}]"
             )
-        lengths = np.array(
-            [[len(streams[s][d]) for d in range(D)] for s in range(D)],
-            dtype=np.int64,
-        )
+        if lengths is None:
+            lengths = np.array(
+                [[len(streams[s][d]) for d in range(D)] for s in range(D)],
+                dtype=np.int64,
+            )
+        else:
+            lengths = np.asarray(lengths, dtype=np.int64)
+            if lengths.shape != (D, D):
+                raise ValueError(
+                    f"lengths must be [{D}, {D}], got {lengths.shape}"
+                )
+            proc = jax.process_index()
+            for s in range(D):
+                # only sources on ANOTHER process may omit their data
+                # (their shards are not addressable here); a local
+                # empty row with a nonzero length is a caller bug that
+                # would silently exchange zeros
+                src_local = self.devices[s].process_index == proc
+                for d in range(D):
+                    n = len(streams[s][d])
+                    if (n or src_local) and n != int(lengths[s, d]):
+                        raise ValueError(
+                            f"stream [{s}][{d}] is {n}B but lengths says "
+                            f"{int(lengths[s, d])}B (only REMOTE sources "
+                            f"may pass empty rows)"
+                        )
         plan = self.plan(lengths)
         out: List[List[bytearray]] = [
             [bytearray() for _ in range(D)] for _ in range(D)
@@ -270,6 +300,12 @@ class TileExchange:
                 for s in range(D):
                     out[d][s] += local[s].tobytes()
 
+        multi = jax.process_count() > 1
+        if multi:
+            local_rows = np.array([
+                i for i, dev in enumerate(self.devices)
+                if dev.process_index == jax.process_index()
+            ])
         for r in range(plan.rounds):
             lo, hi = plan.round_slice(r)
             mat = np.zeros((D, D, plan.tile_bytes), dtype=np.uint8)
@@ -278,7 +314,15 @@ class TileExchange:
                     chunk = streams[s][d][lo:hi]
                     if chunk:
                         mat[s, d, : len(chunk)] = np.frombuffer(chunk, np.uint8)
-            garr = jax.device_put(mat, sharding)
+            if multi:
+                # multi-controller: a process may only place its own
+                # devices' shards (device_put of a global array would
+                # reject the non-addressable ones)
+                garr = jax.make_array_from_process_local_data(
+                    sharding, mat[local_rows], (D, D, plan.tile_bytes)
+                )
+            else:
+                garr = jax.device_put(mat, sharding)
             inflight.append(fn(garr))
             self.rounds_executed += 1
             if len(inflight) >= self.max_rounds_in_flight:

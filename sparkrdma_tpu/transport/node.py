@@ -120,6 +120,8 @@ class Node:
     def submit_bulk(self, fn, *args):
         """Run bulk data-plane work (block serving) on the dedicated
         bulk pool, created on first use."""
+        if self._stopped.is_set():
+            raise TransportError(f"{self}: stopped")
         pool = self._bulk_pool
         if pool is None:
             with self._bulk_lock:

@@ -16,10 +16,11 @@ Mapping to the reference (RdmaNode.java / RdmaChannel.java):
   ordering and (via its window) flow control, so the software credit
   scheme of the loopback backend is not re-implemented here.
 - OP_READ_REQ/RESP is the one-sided READ class: the acceptor serves
-  registered-memory reads directly on the connection's reader thread —
-  the application's receive listener is never involved, preserving the
+  registered-memory reads on the node's dedicated bulk pool — the
+  application's receive listener is never involved, preserving the
   "remote CPU does not run app code to serve reads" split (the NIC's
-  role in RdmaChannel.java:441-474; here a dedicated service thread).
+  role in RdmaChannel.java:441-474; here dedicated service threads,
+  kept off both the reader loop and the control-plane dispatcher).
 
 Framing: every message is ``1B opcode + 4B LE length + payload``.
 Read requests carry ``8B req_id + 4B count + count × (8B address,
@@ -227,9 +228,11 @@ class TcpChannel(Channel):
             self._release_budget()
 
     def _serve_read(self, payload: bytes) -> None:
-        """The one-sided READ service: answered here on the reader
-        thread from the node's registered block stores — never via the
-        application receive listener."""
+        """The one-sided READ service: runs on the node's bulk pool
+        (posted by the reader loop) against the registered block
+        stores — never via the application receive listener, and never
+        on the reader thread itself (a large serve must not
+        head-of-line-block the channel)."""
         req_id, count = _REQ_HDR.unpack_from(payload, 0)
         try:
             locs = []

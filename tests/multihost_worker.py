@@ -84,6 +84,42 @@ def main() -> None:
         expect = mat[:, d]
         assert (col == expect).all(), (d, col, expect)
 
+    # the byte-exchange engine itself across the process boundary: this
+    # process supplies data only for ITS sources (remote rows empty),
+    # every process agrees on the lengths matrix, and the host-local
+    # result guards remote destination rows
+    from sparkrdma_tpu.parallel.exchange import (
+        HostLocalStreams,
+        NonAddressableStreamError,
+        TileExchange,
+    )
+
+    def payload(s, d):
+        return bytes([(7 * s + 3 * d + 1) % 251]) * (100 * (s + d + 1))
+
+    lengths = np.array(
+        [[100 * (s + d + 1) for d in range(D)] for s in range(D)],
+        dtype=np.int64,
+    )
+    streams = [
+        [payload(s, d) if s in local else b"" for d in range(D)]
+        for s in range(D)
+    ]
+    ex = TileExchange(mesh, tile_bytes=1 << 10)
+    res = ex.exchange_bytes(streams, lengths=lengths)
+    assert isinstance(res, HostLocalStreams), type(res)
+    assert res.addressable == frozenset(local), res.addressable
+    for d, row in res.items():
+        for s in range(D):
+            assert row[s] == payload(s, d), (s, d)
+    remote = next(i for i in range(D) if i not in local)
+    try:
+        res[remote]
+    except NonAddressableStreamError:
+        pass
+    else:
+        raise AssertionError("remote destination row did not raise")
+
     print(f"proc {pid}: multihost collectives OK", flush=True)
 
 
