@@ -232,6 +232,7 @@ class TileExchange:
     def exchange_bytes(
         self, streams: Sequence[Sequence[bytes]],
         lengths: Optional[np.ndarray] = None,
+        local_sources: Optional[frozenset] = None,
     ):
         """Move ``streams[s][d]`` → ``out[d][s]``.  Single-host (every
         destination addressable) returns plain ``[D][S]`` lists; on a
@@ -244,7 +245,13 @@ class TileExchange:
         it — divergent shapes would compile different programs and
         deadlock the collective), but only needs real data for its own
         sources' rows; remote sources' streams may be empty — their
-        shards are not addressable here and never read."""
+        shards are not addressable here and never read.
+
+        ``local_sources`` names the source rows THIS caller vouches for
+        (default: the devices of this process).  Bulk-synchronous
+        callers that represent a single executor on a shared mesh pass
+        just their own row — empty rows outside the set are legal, an
+        empty row INSIDE it with a nonzero length is a caller bug."""
         D = self.n_devices
         if len(streams) != D or any(len(row) != D for row in streams):
             raise ValueError(
@@ -262,20 +269,24 @@ class TileExchange:
                 raise ValueError(
                     f"lengths must be [{D}, {D}], got {lengths.shape}"
                 )
-            proc = jax.process_index()
+            if local_sources is None:
+                proc = jax.process_index()
+                local_sources = frozenset(
+                    s for s, dev in enumerate(self.devices)
+                    if dev.process_index == proc
+                )
             for s in range(D):
-                # only sources on ANOTHER process may omit their data
-                # (their shards are not addressable here); a local
-                # empty row with a nonzero length is a caller bug that
-                # would silently exchange zeros
-                src_local = self.devices[s].process_index == proc
+                # only sources this caller does NOT vouch for may omit
+                # their data; a vouched-for empty row with a nonzero
+                # length is a caller bug that would silently exchange
+                # zeros
                 for d in range(D):
                     n = len(streams[s][d])
-                    if (n or src_local) and n != int(lengths[s, d]):
+                    if (n or s in local_sources) and n != int(lengths[s, d]):
                         raise ValueError(
                             f"stream [{s}][{d}] is {n}B but lengths says "
-                            f"{int(lengths[s, d])}B (only REMOTE sources "
-                            f"may pass empty rows)"
+                            f"{int(lengths[s, d])}B (only rows outside "
+                            f"local_sources may be empty)"
                         )
         plan = self.plan(lengths)
         out: List[List[bytearray]] = [
@@ -338,7 +349,7 @@ class TileExchange:
             for d in range(D)
         ]
         if self.verify_integrity:
-            self._verify(streams, result, filled_dsts)
+            self._verify(streams, result, filled_dsts, local_sources)
         if len(filled_dsts) < D:
             # multi-host: only this process's destination rows hold
             # data — hand back a guarded view so a remote row fails
@@ -346,7 +357,8 @@ class TileExchange:
             return HostLocalStreams(result, frozenset(filled_dsts))
         return result
 
-    def _verify(self, streams, result, filled_dsts) -> None:
+    def _verify(self, streams, result, filled_dsts,
+                local_sources=None) -> None:
         """End-to-end integrity: a chip/link fault inside a collective
         corrupts silently (no per-channel CQ error to observe), so
         received streams are compared against what the source enqueued
@@ -357,7 +369,7 @@ class TileExchange:
         this process — for a cross-host pair neither endpoint holds
         both byte strings (verifying those would need the CRC to ride
         the exchange)."""
-        local_srcs = {
+        local_srcs = local_sources if local_sources is not None else {
             i for i, dev in enumerate(self.devices)
             if dev.process_index == jax.process_index()
         }

@@ -30,7 +30,16 @@ type  class                                  direction
  5    FetchMapStatusResponseMsg              driver → executor
  6    FetchMapStatusFailedMsg                driver → executor
  7    HeartbeatMsg                           driver ↔ executor
+ 8    FetchExchangePlanMsg                   executor → driver
+ 9    ExchangePlanMsg                        driver → executor
 ====  =====================================  ===========================
+
+Types 8-9 carry the BULK-SYNCHRONOUS collective shuffle plan: after the
+map phase, every participating host asks the driver for the globally
+agreed (src host × dst host) stream-length matrix plus its own
+destination manifest, so all hosts can launch ONE symmetric collective
+exchange (SPMD needs identical shapes everywhere — SURVEY.md §7
+"pull → collective inversion" across hosts).
 """
 
 from __future__ import annotations
@@ -483,6 +492,107 @@ class HeartbeatMsg(RpcMsg):
         return HeartbeatMsg(smid, seq, bool(ack))
 
 
+@dataclass(frozen=True)
+class FetchExchangePlanMsg(RpcMsg):
+    """Host asks the driver for the bulk-exchange plan of one shuffle
+    (answered once EVERY registered map has published — the barrier of
+    the bulk-synchronous mode)."""
+
+    requester: ShuffleManagerId
+    shuffle_id: int
+    callback_id: int
+
+    MSG_TYPE = 8
+
+    def _payload(self) -> bytes:
+        buf = bytearray()
+        self.requester.write(buf)
+        buf += struct.pack("<ii", self.shuffle_id, self.callback_id)
+        return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return self.requester.serialized_length() + 8
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "FetchExchangePlanMsg":
+        smid, off = ShuffleManagerId.read(view, 0)
+        shuffle_id, callback_id = struct.unpack_from("<ii", view, off)
+        return FetchExchangePlanMsg(smid, shuffle_id, callback_id)
+
+
+@dataclass(frozen=True)
+class ExchangePlanMsg(RpcMsg):
+    """The driver's bulk-exchange plan: the canonical host order, the
+    full (src × dst) stream-length matrix every host must agree on, and
+    the requester's destination manifest — for each source host, the
+    (map_id, reduce_id, length) blocks concatenated into that source's
+    stream toward the requester, in order."""
+
+    callback_id: int
+    hosts: Tuple[ShuffleManagerId, ...]          # canonical order
+    lengths: Tuple[int, ...]                     # row-major [E * E]
+    manifest: Tuple[Tuple[Tuple[int, int, int], ...], ...]  # [E][blocks]
+
+    MSG_TYPE = 9
+
+    def __init__(self, callback_id, hosts, lengths, manifest):
+        object.__setattr__(self, "callback_id", callback_id)
+        object.__setattr__(self, "hosts", tuple(hosts))
+        object.__setattr__(self, "lengths", tuple(int(x) for x in lengths))
+        object.__setattr__(
+            self, "manifest",
+            tuple(tuple(tuple(b) for b in row) for row in manifest),
+        )
+        e = len(self.hosts)
+        if len(self.lengths) != e * e or len(self.manifest) != e:
+            raise ValueError(
+                f"plan shape mismatch: {e} hosts, {len(self.lengths)} "
+                f"lengths, {len(self.manifest)} manifest rows"
+            )
+
+    def _payload(self) -> bytes:
+        buf = bytearray(struct.pack("<ii", self.callback_id, len(self.hosts)))
+        for h in self.hosts:
+            h.write(buf)
+        for x in self.lengths:
+            buf += struct.pack("<q", x)
+        for row in self.manifest:
+            buf += struct.pack("<i", len(row))
+            for map_id, reduce_id, length in row:
+                buf += struct.pack("<iiq", map_id, reduce_id, length)
+        return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return (
+            8
+            + sum(h.serialized_length() for h in self.hosts)
+            + 8 * len(self.lengths)
+            + sum(4 + 16 * len(row) for row in self.manifest)
+        )
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "ExchangePlanMsg":
+        callback_id, e = struct.unpack_from("<ii", view, 0)
+        off = 8
+        hosts = []
+        for _ in range(e):
+            h, off = ShuffleManagerId.read(view, off)
+            hosts.append(h)
+        lengths = struct.unpack_from(f"<{e * e}q", view, off) if e else ()
+        off += 8 * e * e
+        manifest = []
+        for _ in range(e):
+            (cnt,) = struct.unpack_from("<i", view, off)
+            off += 4
+            row = []
+            for _ in range(cnt):
+                m, r, n = struct.unpack_from("<iiq", view, off)
+                off += 16
+                row.append((m, r, n))
+            manifest.append(tuple(row))
+        return ExchangePlanMsg(callback_id, hosts, lengths, manifest)
+
+
 MSG_TYPES: Dict[int, Type[RpcMsg]] = {
     cls.MSG_TYPE: cls
     for cls in (
@@ -493,5 +603,7 @@ MSG_TYPES: Dict[int, Type[RpcMsg]] = {
         FetchMapStatusResponseMsg,
         FetchMapStatusFailedMsg,
         HeartbeatMsg,
+        FetchExchangePlanMsg,
+        ExchangePlanMsg,
     )
 }

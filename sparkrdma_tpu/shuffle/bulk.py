@@ -1,0 +1,260 @@
+"""Bulk-synchronous collective shuffle: the multi-host data plane.
+
+The in-process collective plane (parallel/collective_read.py) batches
+reader fetches into all_to_all rounds opportunistically; across HOSTS
+that requires every process to launch identical collectives, so this
+module runs the exchange bulk-synchronously instead — the natural mode
+for mesh-resident SPMD jobs (SURVEY.md §7 "pull → collective
+inversion"):
+
+1. map phase: every executor writes + publishes normally (the TCP
+   control plane carries publishes to the driver across processes),
+2. barrier: each host asks the driver for the exchange PLAN
+   (FetchExchangePlanMsg); the driver answers once every registered map
+   has published — with the canonical host order, the full
+   (src × dst) stream-length matrix, and the requester's destination
+   manifest,
+3. one collective: every host concatenates its local blocks into
+   per-destination streams and calls ``TileExchange.exchange_bytes``
+   with the agreed lengths — all processes compile the same programs
+   and the bytes ride ICI/DCN,
+4. each host slices its destination row by the manifest and feeds the
+   blocks to the serializer.
+
+Partition ownership is ``reduce_id % n_hosts`` over the plan's
+canonical host order — the bulk-mode convention the driver and every
+executor share.
+
+The reference has no analog mode (its reducers pull asynchronously);
+this is the TPU-native answer to scaling the shuffle the way NCCL/MPI
+backends scale — symmetric collectives instead of per-pair streams.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from sparkrdma_tpu.parallel.exchange import TileExchange
+from sparkrdma_tpu.rpc.messages import FetchExchangePlanMsg
+from sparkrdma_tpu.shuffle.reader import MetadataFetchFailedError
+
+
+class BulkShuffleSession:
+    """In-process contribution barrier: when several participating
+    executors share ONE process (tests, local[*] mode), their rows must
+    ride a single collective — each contributes its source row, the
+    last contributor runs the exchange, everyone shares the result.
+
+    Across processes this object is unnecessary: the collective itself
+    is the barrier (each process fills only its own addressable rows).
+    """
+
+    def __init__(self, exchange: TileExchange, n_hosts: int):
+        self.exchange = exchange
+        self.n_hosts = n_hosts
+        self._cv = threading.Condition()
+        self._rows = {}
+        self._lengths = None
+        # results keyed by ROUND generation: a waiter descheduled
+        # across a whole subsequent round must still read its own
+        # round's outcome, not the latest
+        self._results = {}
+        self._gen = 0
+
+    def run(self, me: int, row: List[bytes], lengths: np.ndarray):
+        """Contribute source row ``me``; blocks until every host
+        contributed and the one exchange ran.  Returns the shared
+        result."""
+        with self._cv:
+            gen = self._gen
+            if self._lengths is None:
+                self._lengths = np.asarray(lengths)
+            elif not np.array_equal(self._lengths, lengths):
+                raise ValueError(
+                    "contributors disagree on the lengths matrix"
+                )
+            if me in self._rows:
+                raise ValueError(f"row {me} contributed twice")
+            self._rows[me] = row
+            if len(self._rows) == self.n_hosts:
+                E = self.n_hosts
+                streams = [[b""] * E for _ in range(E)]
+                for s, r in self._rows.items():
+                    streams[s] = list(r)
+                try:
+                    self._results[gen] = (
+                        self.exchange.exchange_bytes(
+                            streams, lengths=self._lengths,
+                            local_sources=frozenset(self._rows),
+                        ),
+                        None,
+                    )
+                except BaseException as e:
+                    self._results[gen] = (None, e)
+                self._rows = {}
+                self._lengths = None
+                self._gen += 1
+                # keep only recent rounds (waiters of gen and gen-1
+                # may still be draining)
+                for g in [g for g in self._results if g < gen - 1]:
+                    del self._results[g]
+                self._cv.notify_all()
+            else:
+                while self._gen == gen:
+                    if not self._cv.wait(timeout=120):
+                        raise TimeoutError(
+                            "bulk exchange barrier: not every host "
+                            "contributed within 120s"
+                        )
+            result, error = self._results[gen]
+            if error is not None:
+                raise error
+            return result
+
+
+class BulkExchangeReader:
+    """Runs steps 2-4 for one executor (one per participating host)."""
+
+    def __init__(self, manager, exchange: Optional[TileExchange] = None,
+                 mesh=None, session: Optional[BulkShuffleSession] = None):
+        self.manager = manager
+        self.session = session
+        if session is not None:
+            self.exchange = session.exchange
+        elif exchange is not None:
+            self.exchange = exchange
+        else:
+            self.exchange = TileExchange(
+                mesh, tile_bytes=manager.conf.exchange_tile_bytes,
+                max_rounds_in_flight=(
+                    manager.conf.exchange_max_rounds_in_flight
+                ),
+            )
+
+    # -- step 2: the plan barrier -------------------------------------------
+    def _fetch_plan(self, shuffle_id: int):
+        mgr = self.manager
+        event = threading.Event()
+        box = {}
+
+        def on_plan(plan):
+            box["plan"] = plan
+            event.set()
+
+        def on_failed(reason):
+            box["error"] = reason
+            event.set()
+
+        cb_id = mgr.register_plan_callback(on_plan, on_failed)
+        try:
+            mgr._send_msg(
+                mgr._driver_channel(),
+                FetchExchangePlanMsg(mgr.local_smid, shuffle_id, cb_id),
+                on_failure=lambda e: (
+                    box.setdefault("error", str(e)), event.set()
+                ),
+            )
+            timeout = mgr.conf.partition_location_fetch_timeout_ms / 1000.0
+            if not event.wait(timeout):
+                raise MetadataFetchFailedError(
+                    mgr.local_smid.host, shuffle_id,
+                    f"no exchange plan within {timeout:.0f}s",
+                )
+        finally:
+            mgr.unregister_plan_callback(cb_id)
+        if "error" in box:
+            raise MetadataFetchFailedError(
+                mgr.local_smid.host, shuffle_id, str(box["error"])
+            )
+        return box["plan"]
+
+    # -- steps 3-4: exchange + consume --------------------------------------
+    def read(self, shuffle_id: int) -> Iterator:
+        """Blocking bulk read of this host's partitions: the plan
+        barrier and the collective exchange run EAGERLY in this call
+        (a lazily-deferred exchange would leave every other
+        participant blocked in the collective); the returned iterator
+        only deserializes.  Yields records."""
+        mgr = self.manager
+        plan = self._fetch_plan(shuffle_id)
+        hosts = list(plan.hosts)
+        E = len(hosts)
+        try:
+            me = hosts.index(mgr.local_smid)
+        except ValueError:
+            raise MetadataFetchFailedError(
+                mgr.local_smid.host, shuffle_id,
+                "this host is not in the exchange plan "
+                "(did it hello the driver?)",
+            )
+        lengths = np.asarray(plan.lengths, np.int64).reshape(E, E)
+
+        # my source streams: local blocks concatenated per destination
+        # in the canonical order (map_id asc, reduce_id asc, empties
+        # skipped) — the exact order the driver's plan assumed.  A host
+        # that ran no map tasks still participates (the collective
+        # needs every member) with all-empty source streams.
+        my_maps = mgr.resolver.map_ids(shuffle_id)
+        streams: List[List[bytes]] = [[b""] * E for _ in range(E)]
+        if my_maps:
+            num_parts = mgr.resolver.num_partitions(shuffle_id)
+            for d in range(E):
+                parts = []
+                for map_id in my_maps:
+                    for r in range(d, num_parts, E):
+                        blk = mgr.resolver.get_local_block(
+                            shuffle_id, map_id, r
+                        )
+                        if len(blk):
+                            parts.append(
+                                blk if isinstance(blk, bytes)
+                                else bytes(blk)
+                            )
+                streams[me][d] = b"".join(parts)
+        for d in range(E):
+            if len(streams[me][d]) != int(lengths[me, d]):
+                raise MetadataFetchFailedError(
+                    mgr.local_smid.host, shuffle_id,
+                    f"local stream to dst {d} is "
+                    f"{len(streams[me][d])}B, plan says "
+                    f"{int(lengths[me, d])}B",
+                )
+
+        if self.session is not None:
+            result = self.session.run(me, streams[me], lengths)
+        else:
+            import jax
+
+            dev = self.exchange.devices[me]
+            if (jax.process_count() > 1
+                    and dev.process_index != jax.process_index()):
+                # exchange_bytes only stages THIS process's device
+                # rows: a mesh whose device order disagrees with the
+                # canonical host order would silently exchange zeros
+                raise MetadataFetchFailedError(
+                    mgr.local_smid.host, shuffle_id,
+                    f"mesh device {me} (this host's canonical row) "
+                    f"belongs to process {dev.process_index}, not this "
+                    f"process {jax.process_index()} — order the mesh "
+                    f"devices like the plan's host order",
+                )
+            result = self.exchange.exchange_bytes(
+                streams, lengths=lengths, local_sources=frozenset({me}),
+            )
+        row = result[me]
+
+        deser = mgr.serializer.deserialize
+
+        def _records():
+            for s in range(E):
+                data = row[s]
+                off = 0
+                for _map_id, _reduce_id, n in plan.manifest[s]:
+                    block = data[off : off + n]
+                    off += n
+                    yield from deser(block)
+
+        return _records()

@@ -33,6 +33,8 @@ from sparkrdma_tpu.memory.staging import StagingPool
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
+    ExchangePlanMsg,
+    FetchExchangePlanMsg,
     FetchMapStatusFailedMsg,
     FetchMapStatusMsg,
     FetchMapStatusResponseMsg,
@@ -166,6 +168,19 @@ class _FetchCallback:
             self.on_error(reason)
 
 
+class _PlanCallback:
+    """Registry entry for a pending bulk-exchange plan request
+    (shuffle/bulk.py); shares the callback id space and the negative
+    FetchMapStatusFailed path with _FetchCallback."""
+
+    def __init__(self, on_plan: Callable, on_error: Callable[[str], None]):
+        self.on_plan = on_plan
+        self.on_error = on_error
+
+    def on_failed(self, reason: str) -> None:
+        self.on_error(reason)
+
+
 class TpuShuffleManager:
     """One per process.  ``network`` supplies the transport connector
     (LoopbackNetwork in-process; a real fabric connector on a pod)."""
@@ -260,6 +275,11 @@ class TpuShuffleManager:
         # shuffle -> host smid -> map_id -> table
         self._outputs: Dict[int, Dict[ShuffleManagerId, Dict[int, MapTaskOutput]]] = {}
         self._outputs_lock = threading.Lock()
+        # pending bulk-exchange plan requests (driver): shuffle_id →
+        # [(msg, reply channel)], answered once every map published
+        self._plan_waiters: Dict[int, List] = {}
+        self._plan_cache: Dict[int, tuple] = {}
+        self._plan_lock = threading.Lock()
         self._fetch_pool = (
             ThreadPoolExecutor(max_workers=8, thread_name_prefix="drv-fetch")
             if is_driver
@@ -352,6 +372,10 @@ class TpuShuffleManager:
             self._handle_fetch_failed(msg)
         elif isinstance(msg, HeartbeatMsg):
             self._handle_heartbeat(msg, channel)
+        elif isinstance(msg, FetchExchangePlanMsg):
+            self._handle_fetch_plan(msg, channel)
+        elif isinstance(msg, ExchangePlanMsg):
+            self._handle_exchange_plan(msg)
 
     # -- heartbeat / failure detection ---------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -499,6 +523,7 @@ class TpuShuffleManager:
             msg.total_num_partitions,
         )
         mto.put_range(msg.first_reduce_id, msg.last_reduce_id, msg.entries)
+        self._maybe_answer_plans(msg.shuffle_id)
 
     def _handle_fetch_status(self, msg: FetchMapStatusMsg, channel: Channel) -> None:
         assert self.is_driver, "fetch-status must only reach the driver"
@@ -560,9 +585,16 @@ class TpuShuffleManager:
 
         # chain on the fill futures instead of blocking a pool thread, so
         # a straggler map can never starve answerable requests
-        remaining = [t for t in mtos.values() if not t.fill_future.done()]
+        self._when_all_filled(mtos.values(), answer)
+
+    def _when_all_filled(self, mtos, fn) -> None:
+        """Run ``fn`` on the fetch pool once every table's fill future
+        is done (completed OR failed) — chained, never blocking a pool
+        thread.  Shared by the pull path (fetch-status) and the bulk
+        plan barrier."""
+        remaining = [t for t in mtos if not t.fill_future.done()]
         if not remaining:
-            self._fetch_pool.submit(answer)
+            self._fetch_pool.submit(fn)
             return
         countdown = {"n": len(remaining)}
         lock = threading.Lock()
@@ -572,10 +604,146 @@ class TpuShuffleManager:
                 countdown["n"] -= 1
                 last = countdown["n"] == 0
             if last:
-                self._fetch_pool.submit(answer)
+                self._fetch_pool.submit(fn)
 
         for t in remaining:
             t.fill_future.add_done_callback(on_done)
+
+    # -- bulk-exchange plan (shuffle/bulk.py) --------------------------------
+    def _handle_fetch_plan(self, msg: FetchExchangePlanMsg,
+                           channel: Channel) -> None:
+        assert self.is_driver, "fetch-plan must only reach the driver"
+        if msg.shuffle_id not in self._shuffle_num_maps:
+            try:
+                self._send_msg(
+                    channel.reply_channel(),
+                    FetchMapStatusFailedMsg(
+                        msg.callback_id,
+                        f"shuffle {msg.shuffle_id} not registered on driver",
+                    ),
+                )
+            except Exception:
+                logger.exception("plan failure reply failed")
+            return
+        with self._plan_lock:
+            self._plan_waiters.setdefault(msg.shuffle_id, []).append(
+                (msg, channel)
+            )
+        self._maybe_answer_plans(msg.shuffle_id)
+
+    def _maybe_answer_plans(self, shuffle_id: int) -> None:
+        """Answer pending plan requests once EVERY registered map has
+        published and filled (the bulk-synchronous barrier)."""
+        if not self.is_driver:
+            return
+        num_maps = self._shuffle_num_maps.get(shuffle_id)
+        if num_maps is None:
+            return
+        with self._plan_lock:
+            if not self._plan_waiters.get(shuffle_id):
+                return
+        with self._outputs_lock:
+            mtos = [
+                m for bm in self._outputs.get(shuffle_id, {}).values()
+                for m in bm.values()
+            ]
+        if len(mtos) < num_maps:
+            return  # more publishes coming; re-checked on each publish
+
+        def answer_all():
+            with self._plan_lock:
+                waiters = self._plan_waiters.pop(shuffle_id, [])
+            if not waiters:
+                return
+            plan = self._get_or_build_plan(shuffle_id, num_maps)
+            for msg, channel in waiters:
+                if isinstance(plan, str):
+                    reply: RpcMsg = FetchMapStatusFailedMsg(
+                        msg.callback_id, plan
+                    )
+                else:
+                    hosts, flat, full_manifest, idx = plan
+                    me = idx.get(msg.requester)
+                    if me is None:
+                        reply = FetchMapStatusFailedMsg(
+                            msg.callback_id,
+                            f"requester {msg.requester.host}:"
+                            f"{msg.requester.port} is not in the plan's "
+                            f"host set",
+                        )
+                    else:
+                        reply = ExchangePlanMsg(
+                            msg.callback_id, hosts, flat,
+                            [row[me] for row in full_manifest],
+                        )
+                try:
+                    self._send_msg(channel.reply_channel(), reply)
+                except Exception:
+                    logger.exception("plan reply failed")
+
+        self._when_all_filled(mtos, answer_all)
+
+    def _get_or_build_plan(self, shuffle_id: int, num_maps: int):
+        """Build (once) and cache the shuffle's exchange plan so every
+        requester sees ONE membership snapshot — divergent host sets
+        would compile different collectives and deadlock (SPMD).
+        Returns (hosts, flat_lengths, manifest[s][d], idx) or an error
+        string.  Re-validates the barrier: fills may have FAILED or
+        maps been pruned (executor loss) since the publish count
+        passed."""
+        with self._plan_lock:
+            cached = self._plan_cache.get(shuffle_id)
+        if cached is not None:
+            return cached
+        with self._outputs_lock:
+            snapshot = {
+                h: dict(bm)
+                for h, bm in self._outputs.get(shuffle_id, {}).items()
+            }
+        mtos = [m for bm in snapshot.values() for m in bm.values()]
+        if len(mtos) < num_maps:
+            return (
+                f"maps lost before the plan was built "
+                f"({len(mtos)}/{num_maps} remain — executor removed?)"
+            )
+        failed = [
+            m for m in mtos
+            if m.fill_future.done() and m.fill_future.exception() is not None
+        ]
+        if failed:
+            return (
+                f"{len(failed)} map table(s) failed before publish "
+                f"completed (executor removed)"
+            )
+        hosts = sorted(self.executors, key=lambda s: (s.host, s.port))
+        E = len(hosts)
+        idx = {h: i for i, h in enumerate(hosts)}
+        num_parts = self._shuffle_partitions[shuffle_id]
+        lengths = [[0] * E for _ in range(E)]
+        # manifest[s][d]: (map, reduce, length) blocks of src s → dst d
+        manifest = [[[] for _ in range(E)] for _ in range(E)]
+        for host, by_map in snapshot.items():
+            s = idx.get(host)
+            if s is None:
+                return (
+                    f"publisher {host.host}:{host.port} is not a "
+                    f"registered executor (bulk mode needs stable "
+                    f"membership)"
+                )
+            for map_id in sorted(by_map):
+                mto = by_map[map_id]
+                for r in range(num_parts):
+                    loc = mto.get_location(r)
+                    if loc.is_empty or loc.length == 0:
+                        continue
+                    d = r % E
+                    lengths[s][d] += loc.length
+                    manifest[s][d].append((map_id, r, loc.length))
+        flat = [lengths[s][d] for s in range(E) for d in range(E)]
+        plan = (tuple(hosts), flat, manifest, idx)
+        with self._plan_lock:
+            self._plan_cache.setdefault(shuffle_id, plan)
+            return self._plan_cache[shuffle_id]
 
     # -- executor handlers ---------------------------------------------------
     def _handle_fetch_response(self, msg: FetchMapStatusResponseMsg) -> None:
@@ -593,6 +761,27 @@ class TpuShuffleManager:
         if cb is None:
             return  # reader already gone (timeout fired / task ended)
         cb.on_failed(msg.reason)
+
+    def _handle_exchange_plan(self, msg: ExchangePlanMsg) -> None:
+        with self._callbacks_lock:
+            cb = self._callbacks.get(msg.callback_id)
+        if cb is None or not isinstance(cb, _PlanCallback):
+            logger.warning("plan response for unknown callback %d",
+                           msg.callback_id)
+            return
+        cb.on_plan(msg)
+
+    def register_plan_callback(self, on_plan: Callable,
+                               on_error: Callable[[str], None]) -> int:
+        with self._callbacks_lock:
+            cb_id = self._next_callback_id
+            self._next_callback_id += 1
+            self._callbacks[cb_id] = _PlanCallback(on_plan, on_error)
+        return cb_id
+
+    def unregister_plan_callback(self, cb_id: int) -> None:
+        with self._callbacks_lock:
+            self._callbacks.pop(cb_id, None)
 
     def register_fetch_callback(
         self, on_locations: Callable[[List[BlockLocation]], None],
@@ -664,6 +853,8 @@ class TpuShuffleManager:
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self.resolver.remove_shuffle(shuffle_id)
+        with self._plan_lock:
+            self._plan_cache.pop(shuffle_id, None)
         with self._outputs_lock:
             self._outputs.pop(shuffle_id, None)
         self._shuffle_partitions.pop(shuffle_id, None)

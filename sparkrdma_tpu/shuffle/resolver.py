@@ -409,6 +409,20 @@ class ShuffleBlockResolver:
             return b""
         return seg.read(loc.address, loc.length)
 
+    def num_partitions(self, shuffle_id: int) -> int:
+        with self._lock:
+            sd = self._shuffles.get(shuffle_id)
+        if sd is None:
+            raise KeyError(f"shuffle {shuffle_id} has no committed outputs")
+        return sd.num_partitions
+
+    def map_ids(self, shuffle_id: int) -> List[int]:
+        """This executor's committed map ids for one shuffle, sorted
+        (the canonical order of the bulk-exchange stream builder)."""
+        with self._lock:
+            sd = self._shuffles.get(shuffle_id)
+            return sorted(sd.outputs.keys()) if sd else []
+
     def get_map_output(self, shuffle_id: int, map_id: int) -> Optional[MapTaskOutput]:
         with self._lock:
             sd = self._shuffles.get(shuffle_id)

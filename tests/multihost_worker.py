@@ -26,6 +26,29 @@ from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
+
+    # ---- bulk-shuffle control plane (REAL sockets, created before the
+    # jax rendezvous so the driver is listening when executors hello)
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+    from sparkrdma_tpu.transport import TcpNetwork
+
+    driver_port = int(port) + 31
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "60s",
+        "spark.shuffle.tpu.connectTimeout": "10s",
+    })
+    NUM_PARTS = 8
+    part = HashPartitioner(NUM_PARTS)
+    driver = None
+    if pid == 0:
+        driver = TpuShuffleManager(
+            conf, is_driver=True, network=TcpNetwork(), port=driver_port,
+        )
+        driver.register_shuffle(70, 2, part)
+
     multihost.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=2,
@@ -119,6 +142,63 @@ def main() -> None:
         pass
     else:
         raise AssertionError("remote destination row did not raise")
+
+    # ---- the FULL bulk-synchronous shuffle across processes: TCP
+    # control plane (hello/publish/plan) + one cross-process collective
+    # (shuffle/bulk.py) — one executor per process, mesh = one device
+    # per process
+    import time
+
+    from jax.sharding import Mesh as _Mesh
+
+    from sparkrdma_tpu.shuffle.bulk import BulkExchangeReader
+
+    ex_mgr = TpuShuffleManager(
+        conf, is_driver=False, network=TcpNetwork(),
+        port=driver_port + 10 + pid, executor_id=str(pid),
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline and len(ex_mgr._peers) < 2:
+        time.sleep(0.02)
+    assert len(ex_mgr._peers) == 2, "announce did not reach both executors"
+
+    from sparkrdma_tpu.shuffle.manager import ShuffleHandle
+
+    handle = ShuffleHandle(70, 2, part)
+    records = [(f"p{pid}-k{j}", (pid, j)) for j in range(60)]
+    w = ex_mgr.get_writer(handle, pid)
+    w.write(records)
+    w.stop(True)
+
+    # one mesh device per process, ordered by process index — both
+    # processes derive the identical mesh
+    per_proc = {}
+    for dev in jax.devices():
+        per_proc.setdefault(dev.process_index, dev)
+    mesh2 = _Mesh(
+        np.array([per_proc[i] for i in sorted(per_proc)]), (EXCHANGE_AXIS,)
+    )
+    reader = BulkExchangeReader(
+        ex_mgr, TileExchange(mesh2, tile_bytes=1 << 12)
+    )
+    mine = list(reader.read(70))
+
+    # my canonical index: executors sorted by (host, port) — ports are
+    # driver_port+10+pid, so index == pid
+    all_records = [
+        (f"p{q}-k{j}", (q, j)) for q in range(2) for j in range(60)
+    ]
+    expect = [
+        (k, v) for k, v in all_records
+        if part.partition(k) % 2 == pid
+    ]
+    assert sorted(mine) == sorted(expect), (
+        f"proc {pid}: got {len(mine)} records, want {len(expect)}"
+    )
+
+    ex_mgr.stop()
+    if driver is not None:
+        driver.stop()
 
     print(f"proc {pid}: multihost collectives OK", flush=True)
 

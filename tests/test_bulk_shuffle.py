@@ -1,0 +1,166 @@
+"""Bulk-synchronous collective shuffle (shuffle/bulk.py): map phase →
+plan barrier → ONE symmetric exchange → consume.
+
+Single-process here (loopback control plane, multi-device mesh, a
+BulkShuffleSession as the in-process contribution barrier); the
+cross-PROCESS version runs inside tests/multihost_worker.py over a real
+TCP control plane and a multi-controller mesh, where the collective
+itself is the barrier.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.parallel.exchange import TileExchange
+from sparkrdma_tpu.parallel.mesh import make_mesh
+from sparkrdma_tpu.shuffle.bulk import BulkExchangeReader, BulkShuffleSession
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.shuffle.reader import MetadataFetchFailedError
+from sparkrdma_tpu.transport import LoopbackNetwork
+
+
+@pytest.fixture()
+def cluster(devices):
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": 43500,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "15s",
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=43600 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(3)
+    ]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == 3 for e in executors):
+            break
+        time.sleep(0.01)
+    yield net, conf, driver, executors
+    for m in executors + [driver]:
+        m.stop()
+
+
+def _bulk_read_all(executors, shuffle_id, mesh):
+    """All hosts read concurrently through one shared session (the
+    in-process stand-in for per-process collective participation)."""
+    session = BulkShuffleSession(
+        TileExchange(mesh, tile_bytes=1 << 12), len(executors)
+    )
+    results = {}
+    errors = {}
+
+    def run(e):
+        try:
+            results[e.executor_id] = list(
+                BulkExchangeReader(e, session=session).read(shuffle_id)
+            )
+        except BaseException as err:
+            errors[e.executor_id] = err
+
+    threads = [
+        threading.Thread(target=run, args=(e,), daemon=True)
+        for e in executors
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    return results
+
+
+def test_bulk_shuffle_e2e(cluster):
+    net, conf, driver, executors = cluster
+    E = len(executors)
+    num_maps, num_parts = 6, 9
+    part = HashPartitioner(num_parts)
+    handle = driver.register_shuffle(60, num_maps, part)
+    records_per_map = [
+        [(f"k{j}", (m, j)) for j in range(40)] for m in range(num_maps)
+    ]
+    for m, records in enumerate(records_per_map):
+        w = executors[m % E].get_writer(handle, m)
+        w.write(records)
+        w.stop(True)
+
+    results = _bulk_read_all(executors, 60, make_mesh(E))
+
+    # canonical host order = sorted by (host, port); every record landed
+    # on the host owning its partition, and nothing was lost
+    hosts = sorted(
+        (e.local_smid for e in executors), key=lambda s: (s.host, s.port)
+    )
+    got = []
+    for e in executors:
+        mine = results[e.executor_id]
+        my_index = hosts.index(e.local_smid)
+        for k, _v in mine:
+            assert part.partition(k) % E == my_index
+        got.extend(mine)
+    expect = [kv for recs in records_per_map for kv in recs]
+    assert sorted(map(repr, got)) == sorted(map(repr, expect))
+
+
+def test_bulk_plan_unregistered_shuffle_fails_fast(cluster):
+    net, conf, driver, executors = cluster
+    reader = BulkExchangeReader(
+        executors[0], TileExchange(make_mesh(3), tile_bytes=1 << 12)
+    )
+    t0 = time.monotonic()
+    with pytest.raises(MetadataFetchFailedError, match="not registered"):
+        list(reader.read(999))
+    assert time.monotonic() - t0 < 5
+
+
+def test_bulk_plan_waits_for_all_maps(cluster):
+    """The plan is a BARRIER: it must not answer until every registered
+    map published."""
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(6)
+    handle = driver.register_shuffle(61, 2, part)
+    w = executors[0].get_writer(handle, 0)
+    w.write([("a", 1)])
+    w.stop(True)
+    # map 1 not yet written: the plan request must stay pending
+    box = {}
+    mesh = make_mesh(3)
+
+    def run():
+        try:
+            box["out"] = _bulk_read_all(executors, 61, mesh)
+        except BaseException as e:
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    assert not box, "plan answered before all maps published"
+    w = executors[1].get_writer(handle, 1)
+    w.write([("b", 2)])
+    w.stop(True)
+    t.join(timeout=60)
+    assert "out" in box, box.get("err")
+    got = [kv for mine in box["out"].values() for kv in mine]
+    assert sorted(got) == [("a", 1), ("b", 2)]
+
+
+def test_bulk_empty_partitions(cluster):
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(7)
+    handle = driver.register_shuffle(62, 2, part)
+    for m, recs in enumerate([[], [("x", 1)]]):
+        w = executors[m].get_writer(handle, m)
+        w.write(recs)
+        w.stop(True)
+    results = _bulk_read_all(executors, 62, make_mesh(3))
+    got = [kv for mine in results.values() for kv in mine]
+    assert got == [("x", 1)]
