@@ -88,6 +88,15 @@ class TpuShuffleContext:
                 flush_ms=self.conf.exchange_flush_ms,
             )
         else:
+            if self.conf.read_plane == "bulk":
+                import jax
+
+                n_dev = len(jax.devices())
+                if num_executors > n_dev:
+                    raise ValueError(
+                        f"bulk read plane: {num_executors} executors "
+                        f"need {num_executors} mesh devices, have {n_dev}"
+                    )
             self.network = LoopbackNetwork()
         self.driver = TpuShuffleManager(
             self.conf, is_driver=True, network=self.network,
@@ -219,18 +228,87 @@ class TpuShuffleContext:
         ])
         mbh = dict(maps_by_host)
 
-        def reduce_task(pid: int) -> List[Tuple[Any, Any]]:
-            ex = self.executors[pid % E]
-            reader = ex.get_reader(handle, pid, pid + 1, mbh)
-            return list(reader.read())
+        if self.conf.read_plane == "bulk":
+            out = self._bulk_reduce(handle, shuffle_id)
+        else:
+            def reduce_task(pid: int) -> List[Tuple[Any, Any]]:
+                ex = self.executors[pid % E]
+                reader = ex.get_reader(handle, pid, pid + 1, mbh)
+                return list(reader.read())
 
-        out = self._run_tasks([
-            (p % E, (lambda p=p: reduce_task(p)))
-            for p in range(partitioner.num_partitions)
-        ])
+            out = self._run_tasks([
+                (p % E, (lambda p=p: reduce_task(p)))
+                for p in range(partitioner.num_partitions)
+            ])
         self.driver.unregister_shuffle(shuffle_id)
         for ex in self.executors:
             ex.unregister_shuffle(shuffle_id)
+        return out
+
+    def _bulk_reduce(self, handle, shuffle_id: int) -> List[List]:
+        """readPlane=bulk: one plan barrier + ONE symmetric collective
+        moves every stream (shuffle/bulk.py), then the read-side
+        aggregate/sort stage runs per partition — the columnar
+        vectorized kernels when the serializer supports them, exactly
+        like the pull readers.  Executor order == canonical host order
+        (ascending ports), so partition p belongs to executor p % E
+        exactly like the pull path above."""
+        from sparkrdma_tpu.parallel.exchange import TileExchange
+        from sparkrdma_tpu.parallel.mesh import make_mesh
+        from sparkrdma_tpu.shuffle.bulk import (
+            BulkExchangeReader,
+            BulkShuffleSession,
+        )
+        from sparkrdma_tpu.shuffle.reader import (
+            postprocess_column_batches,
+            postprocess_records,
+        )
+
+        E = len(self.executors)
+        session = BulkShuffleSession(
+            TileExchange.from_conf(self.conf, make_mesh(E)), E
+        )
+
+        def bulk_task(i: int):
+            ex = self.executors[i]
+            reader = BulkExchangeReader(ex, session=session)
+            agg = handle.aggregator
+            columnar = getattr(
+                ex.serializer, "supports_columns", False
+            ) and (agg is None or isinstance(agg, ColumnarAggregator))
+            try:
+                if columnar:
+                    deser = ex.serializer.deserialize_columns
+                    per_part: Dict[int, list] = {}
+                    for rid, block in reader.read_partitioned_blocks(
+                        shuffle_id
+                    ):
+                        per_part.setdefault(rid, []).extend(deser(block))
+                    return {
+                        p: list(postprocess_column_batches(bs, handle))
+                        for p, bs in per_part.items()
+                    }
+                parts = reader.read_partitioned(shuffle_id)
+                return {
+                    p: list(postprocess_records(iter(recs), handle))
+                    for p, recs in parts.items()
+                }
+            except BaseException as e:
+                # poison the barrier: peers fail NOW instead of riding
+                # out the 120s contribution timeout (and ctx.stop()
+                # hanging on their pool threads)
+                session.abort(e)
+                raise
+
+        results = self._run_tasks([
+            (i, (lambda i=i: bulk_task(i))) for i in range(E)
+        ])
+        out: List[List] = [
+            [] for _ in range(handle.partitioner.num_partitions)
+        ]
+        for res in results:
+            for p, recs in res.items():
+                out[p] = recs
         return out
 
     def stop(self) -> None:

@@ -222,9 +222,11 @@ class TpuShuffleConf:
     @property
     def read_plane(self) -> str:
         """Bulk fetch plane: ``host`` (loopback/TCP one-sided byte
-        reads) or ``collective`` (fetches between mesh-resident
-        executors ride all_to_all tile rounds over ICI — the
-        SURVEY §7 "one-sided READ pull model" inversion)."""
+        reads), ``collective`` (fetches between mesh-resident executors
+        batch into all_to_all tile rounds over ICI — the SURVEY §7
+        "one-sided READ pull model" inversion), or ``bulk``
+        (bulk-synchronous: ONE plan barrier + ONE symmetric collective
+        per shuffle, the multi-host mode — shuffle/bulk.py)."""
         return str(self.get("readPlane", "host")).lower()
 
     @property

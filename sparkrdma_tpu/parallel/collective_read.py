@@ -399,6 +399,13 @@ class ExchangeCoordinator:
                         take_lo * ROW_BYTES : take_hi * ROW_BYTES
                     ] = local[s, : take_hi - take_lo].reshape(-1)
 
+        from sparkrdma_tpu.utils.trace import get_tracer
+
+        get_tracer().instant(
+            "collective.batch",
+            requests=len(batch), rounds=rounds, c_rows=c_rows,
+            payload_bytes=sum(sum(r.lengths) for r in batch),
+        )
         # slice per-request blocks out of the accumulated streams
         for (s, d), reqs in by_pair.items():
             stream = out_streams[(s, d)]
@@ -564,4 +571,7 @@ class CollectiveNetwork(LoopbackNetwork):
         return super().connect(src, peer, channel_type)
 
     def stop(self) -> None:
+        stats = self.coordinator.stats()
+        if stats["batches_executed"]:
+            logger.info("collective read plane at stop: %s", stats)
         self.coordinator.stop()

@@ -63,12 +63,25 @@ class BulkShuffleSession:
         # round's outcome, not the latest
         self._results = {}
         self._gen = 0
+        self._aborted = None  # sticky: a failed participant poisons all
+
+    def abort(self, error: BaseException) -> None:
+        """A participant failed before contributing: poison the
+        session so waiters (and future contributors) fail immediately
+        instead of riding out the barrier timeout."""
+        with self._cv:
+            self._aborted = error
+            self._cv.notify_all()
 
     def run(self, me: int, row: List[bytes], lengths: np.ndarray):
         """Contribute source row ``me``; blocks until every host
         contributed and the one exchange ran.  Returns the shared
         result."""
         with self._cv:
+            if self._aborted is not None:
+                raise RuntimeError(
+                    "bulk exchange aborted by a failed participant"
+                ) from self._aborted
             gen = self._gen
             if self._lengths is None:
                 self._lengths = np.asarray(lengths)
@@ -103,12 +116,16 @@ class BulkShuffleSession:
                     del self._results[g]
                 self._cv.notify_all()
             else:
-                while self._gen == gen:
+                while self._gen == gen and self._aborted is None:
                     if not self._cv.wait(timeout=120):
                         raise TimeoutError(
                             "bulk exchange barrier: not every host "
                             "contributed within 120s"
                         )
+                if self._aborted is not None:
+                    raise RuntimeError(
+                        "bulk exchange aborted by a failed participant"
+                    ) from self._aborted
             result, error = self._results[gen]
             if error is not None:
                 raise error
@@ -171,13 +188,34 @@ class BulkExchangeReader:
             )
         return box["plan"]
 
+    def _run_exchange(self, shuffle_id: int, me: int, streams, lengths):
+        if self.session is not None:
+            return self.session.run(me, streams[me], lengths)
+        import jax
+
+        dev = self.exchange.devices[me]
+        if (jax.process_count() > 1
+                and dev.process_index != jax.process_index()):
+            # exchange_bytes only stages THIS process's device rows: a
+            # mesh whose device order disagrees with the canonical host
+            # order would silently exchange zeros
+            raise MetadataFetchFailedError(
+                self.manager.local_smid.host, shuffle_id,
+                f"mesh device {me} (this host's canonical row) "
+                f"belongs to process {dev.process_index}, not this "
+                f"process {jax.process_index()} — order the mesh "
+                f"devices like the plan's host order",
+            )
+        return self.exchange.exchange_bytes(
+            streams, lengths=lengths, local_sources=frozenset({me}),
+        )
+
     # -- steps 3-4: exchange + consume --------------------------------------
-    def read(self, shuffle_id: int) -> Iterator:
-        """Blocking bulk read of this host's partitions: the plan
-        barrier and the collective exchange run EAGERLY in this call
-        (a lazily-deferred exchange would leave every other
-        participant blocked in the collective); the returned iterator
-        only deserializes.  Yields records."""
+    def _exchange_rows(self, shuffle_id: int):
+        """Plan barrier + stream build + ONE collective exchange; all
+        EAGER (a lazily-deferred exchange would leave every other
+        participant blocked in the collective).  Returns (plan, E,
+        row) where row[s] is the received stream from source s."""
         mgr = self.manager
         plan = self._fetch_plan(shuffle_id)
         hosts = list(plan.hosts)
@@ -223,30 +261,21 @@ class BulkExchangeReader:
                     f"{int(lengths[me, d])}B",
                 )
 
-        if self.session is not None:
-            result = self.session.run(me, streams[me], lengths)
-        else:
-            import jax
+        from sparkrdma_tpu.utils.trace import get_tracer
 
-            dev = self.exchange.devices[me]
-            if (jax.process_count() > 1
-                    and dev.process_index != jax.process_index()):
-                # exchange_bytes only stages THIS process's device
-                # rows: a mesh whose device order disagrees with the
-                # canonical host order would silently exchange zeros
-                raise MetadataFetchFailedError(
-                    mgr.local_smid.host, shuffle_id,
-                    f"mesh device {me} (this host's canonical row) "
-                    f"belongs to process {dev.process_index}, not this "
-                    f"process {jax.process_index()} — order the mesh "
-                    f"devices like the plan's host order",
-                )
-            result = self.exchange.exchange_bytes(
-                streams, lengths=lengths, local_sources=frozenset({me}),
-            )
-        row = result[me]
+        with get_tracer().span(
+            "shuffle.bulk.exchange", shuffle=shuffle_id, hosts=E,
+            payload_bytes=int(lengths.sum()),
+        ):
+            result = self._run_exchange(shuffle_id, me, streams, lengths)
+        return plan, E, result[me]
 
-        deser = mgr.serializer.deserialize
+    def read(self, shuffle_id: int) -> Iterator:
+        """Blocking bulk read of this host's partitions (the exchange
+        runs eagerly in this call; the returned iterator only
+        deserializes).  Yields records."""
+        plan, E, row = self._exchange_rows(shuffle_id)
+        deser = self.manager.serializer.deserialize
 
         def _records():
             for s in range(E):
@@ -258,3 +287,32 @@ class BulkExchangeReader:
                     yield from deser(block)
 
         return _records()
+
+    def read_partitioned(self, shuffle_id: int) -> dict:
+        """Like :meth:`read` but returns ``{reduce_id: [records]}`` for
+        every partition this host owns — the shape the job layer's
+        per-partition reduce tasks want."""
+        deser = self.manager.serializer.deserialize
+        out: dict = {}
+        for reduce_id, block in self.read_partitioned_blocks(shuffle_id):
+            out.setdefault(reduce_id, []).extend(deser(block))
+        return out
+
+    def read_partitioned_blocks(self, shuffle_id: int):
+        """Lowest-level consumption: yields (reduce_id, raw block
+        bytes) pairs after the exchange — lets columnar consumers feed
+        blocks straight to ``deserialize_columns`` (the vectorized
+        path) instead of per-record tuples.  The exchange runs eagerly
+        before the first yield."""
+        plan, E, row = self._exchange_rows(shuffle_id)
+
+        def _blocks():
+            for s in range(E):
+                data = row[s]
+                off = 0
+                for _map_id, reduce_id, n in plan.manifest[s]:
+                    block = data[off : off + n]
+                    off += n
+                    yield reduce_id, block
+
+        return _blocks()

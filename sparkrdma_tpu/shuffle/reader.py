@@ -340,50 +340,13 @@ class ShuffleReader:
         of the unsafe-row analog.  Yields (key, value) pairs where
         group_by_key values are numpy arrays (the columnar stand-in for
         the tuple plane's lists)."""
-        from sparkrdma_tpu.utils.columns import (
-            combine_columns,
-            concat_batches,
-            group_columns,
-            stable_key_order,
-        )
-
         deser = self.manager.serializer.deserialize_columns
         batches = []
-        total = 0
         for data in self._iter_block_bytes():
             for b in deser(data):
                 self.metrics.records_read += len(b)
-                total += len(b)
                 batches.append(b)
-        if total == 0:
-            return iter(())
-        agg = self.handle.aggregator
-        if agg is not None and agg.kind != "group":
-            # reduce each block first (key-sorted blocks reduce with no
-            # sort), then combine the shrunken remainders
-            reduced = [combine_columns(b, agg.kind) for b in batches]
-            batch = combine_columns(concat_batches(reduced), agg.kind)
-            # combine output is key-sorted, so key_ordering holds too
-            return iter(zip(batch.keys.tolist(), batch.vals.tolist()))
-        if agg is not None:
-            if all(b.key_sorted for b in batches):
-                from sparkrdma_tpu.utils.columns import merge_sorted_groups
-
-                per = [group_columns(b) for b in batches if len(b)]
-                entries = sum(len(uk) for uk, _ in per)
-                # per-key merge beats concat+gather only while the
-                # Python loop stays small next to the moved bytes
-                if entries <= max(1 << 15, total // 8):
-                    return merge_sorted_groups(per)
-            uk, groups = group_columns(concat_batches(batches))
-            return iter(zip(uk.tolist(), groups))
-        batch = concat_batches(batches)
-        if self.handle.key_ordering:
-            order = stable_key_order(batch.keys)
-            return iter(zip(
-                batch.keys[order].tolist(), batch.vals[order].tolist()
-            ))
-        return iter(batch)
+        return postprocess_column_batches(batches, self.handle)
 
     def read(self) -> Iterator[Record]:
         """Full read path: fetch → deserialize → aggregate → sort
@@ -395,23 +358,72 @@ class ShuffleReader:
             agg is None or isinstance(agg, ColumnarAggregator)
         ):
             return self._read_columnar()
-        records = self._iter_raw()
-        if agg is not None:
-            combined: Dict[Any, Any] = {}
-            if self.handle.map_side_combine:
-                # records are (key, combiner) pairs already
-                for k, c in records:
-                    combined[k] = (
-                        agg.merge_combiners(combined[k], c)
-                        if k in combined else c
-                    )
-            else:
-                for k, v in records:
-                    combined[k] = (
-                        agg.merge_value(combined[k], v)
-                        if k in combined else agg.create_combiner(v)
-                    )
-            records = iter(combined.items())
-        if self.handle.key_ordering:
-            records = iter(sorted(records, key=lambda kv: kv[0]))
-        return records
+        return postprocess_records(self._iter_raw(), self.handle)
+
+
+def postprocess_column_batches(batches, handle) -> Iterator[Record]:
+    """The columnar aggregate/sort stage on deserialized ColumnBatch
+    lists — shared by the pull reader and the bulk-exchange plane."""
+    from sparkrdma_tpu.utils.columns import (
+        combine_columns,
+        concat_batches,
+        group_columns,
+        stable_key_order,
+    )
+
+    total = sum(len(b) for b in batches)
+    if total == 0:
+        return iter(())
+    agg = handle.aggregator
+    if agg is not None and agg.kind != "group":
+        # reduce each block first (key-sorted blocks reduce with no
+        # sort), then combine the shrunken remainders
+        reduced = [combine_columns(b, agg.kind) for b in batches]
+        batch = combine_columns(concat_batches(reduced), agg.kind)
+        # combine output is key-sorted, so key_ordering holds too
+        return iter(zip(batch.keys.tolist(), batch.vals.tolist()))
+    if agg is not None:
+        if all(b.key_sorted for b in batches):
+            from sparkrdma_tpu.utils.columns import merge_sorted_groups
+
+            per = [group_columns(b) for b in batches if len(b)]
+            entries = sum(len(uk) for uk, _ in per)
+            # per-key merge beats concat+gather only while the
+            # Python loop stays small next to the moved bytes
+            if entries <= max(1 << 15, total // 8):
+                return merge_sorted_groups(per)
+        uk, groups = group_columns(concat_batches(batches))
+        return iter(zip(uk.tolist(), groups))
+    batch = concat_batches(batches)
+    if handle.key_ordering:
+        order = stable_key_order(batch.keys)
+        return iter(zip(
+            batch.keys[order].tolist(), batch.vals[order].tolist()
+        ))
+    return iter(batch)
+
+
+def postprocess_records(records: Iterator[Record], handle) -> Iterator[Record]:
+    """The read-side aggregate → sort stage on plain record iterators
+    (RdmaShuffleReader.scala:82-113) — shared by the pull reader's
+    generic path and the bulk-exchange reader."""
+    agg = handle.aggregator
+    if agg is not None:
+        combined: Dict[Any, Any] = {}
+        if handle.map_side_combine:
+            # records are (key, combiner) pairs already
+            for k, c in records:
+                combined[k] = (
+                    agg.merge_combiners(combined[k], c)
+                    if k in combined else c
+                )
+        else:
+            for k, v in records:
+                combined[k] = (
+                    agg.merge_value(combined[k], v)
+                    if k in combined else agg.create_combiner(v)
+                )
+        records = iter(combined.items())
+    if handle.key_ordering:
+        records = iter(sorted(records, key=lambda kv: kv[0]))
+    return records

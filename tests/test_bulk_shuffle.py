@@ -164,3 +164,92 @@ def test_bulk_empty_partitions(cluster):
     results = _bulk_read_all(executors, 62, make_mesh(3))
     got = [kv for mine in results.values() for kv in mine]
     assert got == [("x", 1)]
+
+
+def test_bulk_read_plane_via_context(devices):
+    """readPlane=bulk through the high-level Dataset API: wide ops run
+    the map phase normally, then ONE plan barrier + ONE symmetric
+    collective replaces the per-partition pull readers."""
+    from sparkrdma_tpu.api import TpuShuffleContext
+
+    data = [(i % 17, i) for i in range(3000)]
+
+    def run(conf, port):
+        with TpuShuffleContext(
+            num_executors=3, conf=conf, base_port=port,
+            stage_to_device=False,
+        ) as ctx:
+            ds = ctx.parallelize(data, num_slices=6)
+            return (
+                sorted(
+                    ds.reduce_by_key(lambda a, b: a + b, num_partitions=6)
+                    .collect()
+                ),
+                sorted(ds.sort_by_key(num_partitions=6).collect()),
+            )
+
+    bulk_conf = TpuShuffleConf()
+    bulk_conf.set("readPlane", "bulk")
+    host = run(TpuShuffleConf(), 44500)
+    bulk = run(bulk_conf, 44700)
+    assert host == bulk
+
+
+def test_bulk_columnar_fast_path(devices):
+    """serializer=columnar + readPlane=bulk keeps the vectorized
+    columnar read-side kernels (no per-record Python loop)."""
+    import numpy as np
+
+    from sparkrdma_tpu.api import TpuShuffleContext
+
+    conf = TpuShuffleConf()
+    conf.set("readPlane", "bulk")
+    conf.set("serializer", "columnar")
+    with TpuShuffleContext(
+        num_executors=3, conf=conf, base_port=45200,
+        stage_to_device=False,
+    ) as ctx:
+        n = 5000
+        keys = np.arange(n, dtype=np.int64) % 97
+        vals = np.arange(n, dtype=np.int64)
+        got = dict(
+            ctx.parallelize_columns(keys, vals, num_slices=6)
+            .reduce_by_key("sum", num_partitions=6)
+            .collect()
+        )
+        expect = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            expect[k] = expect.get(k, 0) + v
+        assert got == expect
+
+
+def test_bulk_session_abort_unblocks_waiters():
+    """A participant failing before contribution poisons the barrier —
+    waiters fail immediately, not at the 120s timeout."""
+    import numpy as np
+
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+    from sparkrdma_tpu.shuffle.bulk import BulkShuffleSession
+
+    session = BulkShuffleSession(
+        TileExchange(make_mesh(2), tile_bytes=1 << 12), 2
+    )
+    lengths = np.zeros((2, 2), np.int64)
+    box = {}
+
+    def waiter():
+        try:
+            session.run(0, [b"", b""], lengths)
+        except BaseException as e:
+            box["err"] = e
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    session.abort(RuntimeError("participant 1 exploded"))
+    t.join(timeout=10)
+    assert not t.is_alive(), "waiter still blocked after abort"
+    assert "participant 1 exploded" in repr(box["err"].__cause__)
+    # the poison is sticky for late contributors too
+    with pytest.raises(RuntimeError, match="aborted"):
+        session.run(1, [b"", b""], lengths)
