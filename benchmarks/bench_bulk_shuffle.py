@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Bulk-synchronous collective shuffle end to end (readPlane=bulk).
+
+Same record job as ``bench_collective_shuffle`` (shared workload from
+benchmarks/common.py) but on the bulk-synchronous plane: the map phase
+publishes normally, then ONE plan barrier + ONE symmetric
+``exchange_bytes`` moves every stream (shuffle/bulk.py) — the
+multi-host scaling mode.  Needs ≥4 mesh devices; on the single-chip
+bench host it re-execs onto a spoofed 8-device CPU mesh, so the number
+gauges the plane's overhead, not TPU silicon.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from benchmarks.common import (
+        ROCE_LINE_RATE_GBPS,
+        canonical_record_workload,
+        emit,
+        ensure_multidevice,
+        time_group_by_key,
+    )
+
+    ensure_multidevice(__file__)
+
+    from sparkrdma_tpu.api import TpuShuffleContext
+    from sparkrdma_tpu.conf import TpuShuffleConf
+
+    n_records, payload, n_keys = 1_000_000, 64, 512
+    keys, vals = canonical_record_workload(n_records, payload, n_keys)
+    conf = TpuShuffleConf()
+    conf.set("serializer", "columnar")
+    conf.set("readPlane", "bulk")
+    conf.set("exchangeTileBytes", "16m")
+
+    with TpuShuffleContext(
+        num_executors=4, conf=conf, stage_to_device=False
+    ) as ctx:
+        best = time_group_by_key(ctx, keys, vals, n_keys)
+
+    gbps = n_records * payload / best / 1e9
+    emit(
+        f"bulk-plane groupByKey end-to-end throughput "
+        f"({n_records} x {payload}B records, plan barrier + one "
+        f"symmetric collective)",
+        gbps, "GB/s", gbps / ROCE_LINE_RATE_GBPS,
+    )
+
+
+if __name__ == "__main__":
+    main()

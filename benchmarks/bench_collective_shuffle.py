@@ -1,67 +1,42 @@
 #!/usr/bin/env python
 """Integrated record-plane shuffle over the COLLECTIVE read plane.
 
-BASELINE config 2's round-2 form: the same groupByKey/reduceByKey record
-job as ``bench_local_baseline``, but with map outputs committed into
-per-device HBM arenas and every remote fetch executed as pack +
-``all_to_all`` tile rounds over the mesh (parallel/collective_read.py) —
-the write → publish → resolve → exchange → read integration standing in
-for the reference's commit → publish → FetchMapStatus → scatter RDMA
-READ pipeline (RdmaShuffleFetcherIterator.scala:162-171,
-RdmaChannel.java:441-474).
+BASELINE config 2's round-2 form: the same groupByKey record job as
+``bench_local_baseline`` (shared workload from benchmarks/common.py),
+but with map outputs committed into per-device HBM arenas and every
+remote fetch executed as pack + ``all_to_all`` tile rounds over the
+mesh (parallel/collective_read.py) — the write → publish → resolve →
+exchange → read integration standing in for the reference's commit →
+publish → FetchMapStatus → scatter RDMA READ pipeline
+(RdmaShuffleFetcherIterator.scala:162-171, RdmaChannel.java:441-474).
 
-Needs ≥4 mesh devices; on the single-chip bench host it re-execs itself
-onto a spoofed 8-device CPU mesh (the same harness the test suite and
-the driver's dryrun use), so the number gauges the integrated plane's
-overhead, not TPU silicon.
+Needs ≥4 mesh devices; on the single-chip bench host it re-execs onto
+a spoofed 8-device CPU mesh, so the number gauges the integrated
+plane's overhead, not TPU silicon.
 """
 
 import os
-import subprocess
 import sys
 
-_SPOOF_ENV = "SPARKRDMA_TPU_BENCH_SPOOFED"
-
-
-def _respawn_spoofed() -> int:
-    env = dict(os.environ)
-    env[_SPOOF_ENV] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    return subprocess.call([sys.executable, os.path.abspath(__file__)], env=env)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    import time
+    from benchmarks.common import (
+        ROCE_LINE_RATE_GBPS,
+        canonical_record_workload,
+        emit,
+        ensure_multidevice,
+        time_group_by_key,
+    )
 
-    import jax
-    import numpy as np
-
-    if os.environ.get(_SPOOF_ENV):
-        jax.config.update("jax_platforms", "cpu")
-    if len(jax.devices()) < 4:
-        if os.environ.get(_SPOOF_ENV):
-            raise RuntimeError("spoofed respawn still has <4 devices")
-        sys.exit(_respawn_spoofed())
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from benchmarks.common import ROCE_LINE_RATE_GBPS, emit
+    ensure_multidevice(__file__)
 
     from sparkrdma_tpu.api import TpuShuffleContext
     from sparkrdma_tpu.conf import TpuShuffleConf
 
-    n_records = 1_000_000
-    payload = 64
-    n_keys = 512
-    reps = 3
-
-    rng = np.random.default_rng(0)
-    keys = rng.integers(0, n_keys, n_records).astype(np.int64)
-    vals = np.frombuffer(rng.bytes(n_records * payload), dtype=f"S{payload}")
+    n_records, payload, n_keys = 1_000_000, 64, 512
+    keys, vals = canonical_record_workload(n_records, payload, n_keys)
     conf = TpuShuffleConf()
     conf.set("serializer", "columnar")
     conf.set("readPlane", "collective")
@@ -75,15 +50,7 @@ def main():
     conf.set("exchangeFlush", "10ms")
 
     with TpuShuffleContext(num_executors=4, conf=conf) as ctx:
-        ds = ctx.parallelize_columns(keys, vals, num_slices=8)
-        out = ds.group_by_key(num_partitions=8).collect()  # warm + check
-        assert len(out) == n_keys, f"expected {n_keys} groups, got {len(out)}"
-        assert sum(len(vs) for _, vs in out) == n_records
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            ds.group_by_key(num_partitions=8).collect()
-            best = min(best, time.perf_counter() - t0)
+        best = time_group_by_key(ctx, keys, vals, n_keys)
         stats = ctx.network.coordinator.stats()
         assert stats["rounds_executed"] > 0, "collective plane never ran"
         assert stats["fallback_blocks"] == 0, "collective plane fell back"

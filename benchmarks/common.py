@@ -56,3 +56,67 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 3),
     }), flush=True)
+
+
+# -- spoofed-mesh scaffolding for multi-device record-plane benches ---------
+
+SPOOF_ENV = "SPARKRDMA_TPU_BENCH_SPOOFED"
+
+
+def ensure_multidevice(script_path: str, min_devices: int = 4) -> None:
+    """Benches that need a multi-device mesh call this FIRST: on the
+    single-chip bench host it re-execs the script onto a spoofed
+    8-device CPU mesh (the same harness the test suite and the
+    driver's dryrun use) and exits with the child's status."""
+    import os
+    import subprocess
+    import sys
+
+    import jax as _jax
+
+    if os.environ.get(SPOOF_ENV):
+        _jax.config.update("jax_platforms", "cpu")
+    if len(_jax.devices()) >= min_devices:
+        return
+    if os.environ.get(SPOOF_ENV):
+        raise RuntimeError(
+            f"spoofed respawn still has <{min_devices} devices"
+        )
+    env = dict(os.environ)
+    env[SPOOF_ENV] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.exit(subprocess.call(
+        [sys.executable, os.path.abspath(script_path)], env=env
+    ))
+
+
+def canonical_record_workload(n_records: int = 1_000_000, payload: int = 64,
+                              n_keys: int = 512, seed: int = 0):
+    """The shared record-plane workload (keys, S-payload vals) so the
+    cross-plane BASELINE comparison benchmarks identical data."""
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, n_records).astype(np.int64)
+    vals = np.frombuffer(
+        rng.bytes(n_records * payload), dtype=f"S{payload}"
+    )
+    return keys, vals
+
+
+def time_group_by_key(ctx, keys, vals, n_keys: int, reps: int = 3) -> float:
+    """Warm + verify + best-of-reps seconds for a groupByKey of the
+    canonical workload through a context."""
+    ds = ctx.parallelize_columns(keys, vals, num_slices=8)
+    out = ds.group_by_key(num_partitions=8).collect()
+    assert len(out) == n_keys, f"expected {n_keys} groups, got {len(out)}"
+    assert sum(len(vs) for _, vs in out) == len(keys)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ds.group_by_key(num_partitions=8).collect()
+        best = min(best, time.perf_counter() - t0)
+    return best

@@ -870,6 +870,28 @@ class TpuShuffleManager:
                 self._executors.remove(smid)
             self._removed.add(smid)
         self._last_ack.pop(smid, None)
+        # bulk-mode plan waiters can never be satisfied once a member is
+        # lost (stable membership is the mode's contract): answer them
+        # negatively NOW so readers fail fast instead of timing out
+        with self._plan_lock:
+            doomed_waiters = [
+                (sid, w) for sid, ws in self._plan_waiters.items()
+                for w in ws
+            ]
+            self._plan_waiters.clear()
+            self._plan_cache.clear()
+        for sid, (msg, channel) in doomed_waiters:
+            try:
+                self._send_msg(
+                    channel.reply_channel(),
+                    FetchMapStatusFailedMsg(
+                        msg.callback_id,
+                        f"executor {smid.host}:{smid.port} lost while "
+                        f"awaiting the exchange plan of shuffle {sid}",
+                    ),
+                )
+            except Exception:
+                logger.exception("plan-failure reply failed")
         with self._outputs_lock:
             doomed: List[MapTaskOutput] = []
             for by_host in self._outputs.values():

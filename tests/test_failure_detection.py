@@ -181,3 +181,45 @@ def test_loss_after_publish_still_fails_data_plane(cluster):
         list(reader.read())
     assert time.monotonic() - t0 < 10
     net.heal(victim.node.address)
+
+
+def test_executor_loss_fails_bulk_plan_waiters_promptly(cluster):
+    """Bulk mode needs stable membership: losing a member while plan
+    requests are pending must answer them negatively immediately."""
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+    from sparkrdma_tpu.shuffle.bulk import BulkExchangeReader
+
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(4)
+    handle = driver.register_shuffle(55, 2, part)
+    # only map 0 publishes; the victim never runs map 1, so the plan
+    # barrier cannot pass until failure detection kicks in
+    w = executors[0].get_writer(handle, 0)
+    w.write([("a", 1)])
+    w.stop(True)
+    victim = executors[2]
+    reader = BulkExchangeReader(
+        executors[0], TileExchange(make_mesh(3), tile_bytes=1 << 12)
+    )
+    t0 = time.monotonic()
+    import threading
+
+    box = {}
+
+    def run():
+        try:
+            box["out"] = list(reader.read(55))
+        except BaseException as e:
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not box, "plan answered before the barrier could pass"
+    net.partition(victim.node.address)
+    t.join(timeout=15)
+    assert "err" in box, box
+    assert isinstance(box["err"], MetadataFetchFailedError)
+    assert time.monotonic() - t0 < 15
+    net.heal(victim.node.address)
