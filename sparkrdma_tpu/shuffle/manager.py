@@ -279,6 +279,12 @@ class TpuShuffleManager:
         # [(msg, reply channel)], answered once every map published
         self._plan_waiters: Dict[int, List] = {}
         self._plan_cache: Dict[int, tuple] = {}
+        # bulk plans are only valid for the membership they were
+        # registered under: every executor REMOVAL bumps the epoch and
+        # dooms shuffles registered before it (additions are safe — the
+        # cached snapshot keeps all requesters consistent)
+        self._membership_epoch = 0
+        self._shuffle_epoch: Dict[int, int] = {}
         self._plan_lock = threading.Lock()
         self._fetch_pool = (
             ThreadPoolExecutor(max_workers=8, thread_name_prefix="drv-fetch")
@@ -613,22 +619,38 @@ class TpuShuffleManager:
     def _handle_fetch_plan(self, msg: FetchExchangePlanMsg,
                            channel: Channel) -> None:
         assert self.is_driver, "fetch-plan must only reach the driver"
-        if msg.shuffle_id not in self._shuffle_num_maps:
+        def reply_failed(reason: str) -> None:
             try:
                 self._send_msg(
                     channel.reply_channel(),
-                    FetchMapStatusFailedMsg(
-                        msg.callback_id,
-                        f"shuffle {msg.shuffle_id} not registered on driver",
-                    ),
+                    FetchMapStatusFailedMsg(msg.callback_id, reason),
                 )
             except Exception:
                 logger.exception("plan failure reply failed")
+
+        if msg.shuffle_id not in self._shuffle_num_maps:
+            reply_failed(
+                f"shuffle {msg.shuffle_id} not registered on driver"
+            )
             return
         with self._plan_lock:
-            self._plan_waiters.setdefault(msg.shuffle_id, []).append(
-                (msg, channel)
+            stale = (
+                self._shuffle_epoch.get(msg.shuffle_id)
+                != self._membership_epoch
             )
+            if not stale:
+                self._plan_waiters.setdefault(msg.shuffle_id, []).append(
+                    (msg, channel)
+                )
+        if stale:
+            # membership changed since registration: the barrier may
+            # never pass and any earlier plan is invalid — fail fast
+            # (the job layer re-registers and retries the stage)
+            reply_failed(
+                f"membership changed since shuffle {msg.shuffle_id} was "
+                f"registered (executor lost) — retry the stage"
+            )
+            return
         self._maybe_answer_plans(msg.shuffle_id)
 
     def _maybe_answer_plans(self, shuffle_id: int) -> None:
@@ -692,6 +714,12 @@ class TpuShuffleManager:
         maps been pruned (executor loss) since the publish count
         passed."""
         with self._plan_lock:
+            if (self._shuffle_epoch.get(shuffle_id)
+                    != self._membership_epoch):
+                return (
+                    "membership changed since shuffle registration "
+                    "(executor lost) — retry the stage"
+                )
             cached = self._plan_cache.get(shuffle_id)
         if cached is not None:
             return cached
@@ -742,6 +770,14 @@ class TpuShuffleManager:
         flat = [lengths[s][d] for s in range(E) for d in range(E)]
         plan = (tuple(hosts), flat, manifest, idx)
         with self._plan_lock:
+            if (self._shuffle_epoch.get(shuffle_id)
+                    != self._membership_epoch):
+                # an executor was removed while we built: this plan's
+                # host set is already invalid — do NOT reinstate it
+                return (
+                    "membership changed while the exchange plan was "
+                    "being built (executor lost) — retry the stage"
+                )
             self._plan_cache.setdefault(shuffle_id, plan)
             return self._plan_cache[shuffle_id]
 
@@ -815,6 +851,8 @@ class TpuShuffleManager:
         )
         self._shuffle_partitions[shuffle_id] = partitioner.num_partitions
         self._shuffle_num_maps[shuffle_id] = num_maps
+        with self._plan_lock:
+            self._shuffle_epoch[shuffle_id] = self._membership_epoch
         return handle
 
     def get_writer(self, handle: ShuffleHandle, map_id: int) -> ShuffleWriter:
@@ -855,6 +893,7 @@ class TpuShuffleManager:
         self.resolver.remove_shuffle(shuffle_id)
         with self._plan_lock:
             self._plan_cache.pop(shuffle_id, None)
+            self._shuffle_epoch.pop(shuffle_id, None)
         with self._outputs_lock:
             self._outputs.pop(shuffle_id, None)
         self._shuffle_partitions.pop(shuffle_id, None)
@@ -874,6 +913,7 @@ class TpuShuffleManager:
         # lost (stable membership is the mode's contract): answer them
         # negatively NOW so readers fail fast instead of timing out
         with self._plan_lock:
+            self._membership_epoch += 1
             doomed_waiters = [
                 (sid, w) for sid, ws in self._plan_waiters.items()
                 for w in ws

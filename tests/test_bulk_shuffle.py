@@ -253,3 +253,89 @@ def test_bulk_session_abort_unblocks_waiters():
     # the poison is sticky for late contributors too
     with pytest.raises(RuntimeError, match="aborted"):
         session.run(1, [b"", b""], lengths)
+
+
+def test_bulk_concurrent_shuffles(cluster):
+    """Two bulk shuffles in flight at once: per-shuffle plan waiters,
+    caches, and sessions must not cross."""
+    net, conf, driver, executors = cluster
+    E = len(executors)
+    mesh = make_mesh(E)
+    handles = {}
+    for sid, nparts in ((63, 5), (64, 8)):
+        part = HashPartitioner(nparts)
+        handles[sid] = driver.register_shuffle(sid, E, part)
+        for m in range(E):
+            w = executors[m].get_writer(handles[sid], m)
+            w.write([((sid, f"k{m}-{j}"), j) for j in range(25)])
+            w.stop(True)
+
+    out = {}
+    errs = {}
+
+    def run(sid):
+        try:
+            out[sid] = _bulk_read_all(executors, sid, mesh)
+        except BaseException as e:
+            errs[sid] = e
+
+    threads = [
+        threading.Thread(target=run, args=(sid,), daemon=True)
+        for sid in handles
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    for sid in handles:
+        got = sorted(
+            kv for mine in out[sid].values() for kv in mine
+        )
+        want = sorted(
+            ((sid, f"k{m}-{j}"), j)
+            for m in range(E) for j in range(25)
+        )
+        assert got == want, sid
+
+
+def test_bulk_matches_pull_fuzz(cluster):
+    """Seeded equivalence: random shuffles produce identical results on
+    the bulk plane and the per-partition pull readers."""
+    import random
+
+    net, conf, driver, executors = cluster
+    E = len(executors)
+    mesh = make_mesh(E)
+    rng = random.Random(11)
+    for trial in range(4):
+        sid = 70 + trial
+        num_maps = rng.randint(1, 5)
+        nparts = rng.randint(1, 10)
+        part = HashPartitioner(nparts)
+        handle = driver.register_shuffle(sid, num_maps, part)
+        records_per_map = [
+            [(rng.randint(0, 20), rng.random()) for _ in
+             range(rng.randint(0, 60))]
+            for _ in range(num_maps)
+        ]
+        maps_by_host = {}
+        for m, recs in enumerate(records_per_map):
+            ex = executors[m % E]
+            w = ex.get_writer(handle, m)
+            w.write(recs)
+            w.stop(True)
+            maps_by_host.setdefault(ex.local_smid, []).append(m)
+
+        bulk = sorted(
+            kv
+            for mine in _bulk_read_all(executors, sid, mesh).values()
+            for kv in mine
+        )
+        pull = []
+        for p in range(nparts):
+            reader = executors[p % E].get_reader(
+                handle, p, p + 1, maps_by_host
+            )
+            pull.extend(reader.read())
+        assert bulk == sorted(pull), (trial, sid)

@@ -223,3 +223,33 @@ def test_executor_loss_fails_bulk_plan_waiters_promptly(cluster):
     assert isinstance(box["err"], MetadataFetchFailedError)
     assert time.monotonic() - t0 < 15
     net.heal(victim.node.address)
+
+
+def test_post_loss_bulk_plan_request_fails_fast(cluster):
+    """A plan request arriving AFTER the loss (maps pruned, barrier can
+    never pass again) must fail immediately via the membership epoch,
+    not ride out the location timeout."""
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+    from sparkrdma_tpu.shuffle.bulk import BulkExchangeReader
+
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(4)
+    handle = driver.register_shuffle(56, 2, part)
+    for m in range(2):
+        w = executors[m].get_writer(handle, m)
+        w.write([(f"k{m}", m)])
+        w.stop(True)
+    victim = executors[1]
+    net.partition(victim.node.address)
+    _await(lambda: victim.local_smid not in driver.executors,
+           msg="prune")
+    # request arrives only AFTER the removal
+    reader = BulkExchangeReader(
+        executors[0], TileExchange(make_mesh(3), tile_bytes=1 << 12)
+    )
+    t0 = time.monotonic()
+    with pytest.raises(MetadataFetchFailedError, match="membership"):
+        list(reader.read(56))
+    assert time.monotonic() - t0 < 5
+    net.heal(victim.node.address)
