@@ -14,8 +14,12 @@ stage's validity column (unmatched rows ride along as ROLE_INVALID and
 can never join or aggregate), so stage outputs stay device-resident
 with static shapes and only a one-element fence touches the host —
 the SQL-engine pattern of keeping exchanges on the fabric end to end.
-Reported as fact-row bytes through the full 3-stage pipeline per second
-per chip.
+
+Two variants are reported: the 3-stage pipeline above, and the fused
+2-stage pipeline where stages 2+3 run as ONE sort
+(models/join_aggregate.py — the group key here is a pure function of
+the stage-2 join key, the fusion precondition).  Reported as fact-row
+bytes through the full pipeline per second per chip.
 """
 
 import sys
@@ -121,6 +125,46 @@ def main():
         f"TPC-DS q64/q72-shaped 2-join+aggregate device pipeline per "
         f"chip ({n_fact} fact rows, {D} chip(s))",
         gbps_chip, "GB/s/chip", gbps_chip / ROCE_LINE_RATE_GBPS,
+    )
+
+    # fused variant: stages 2+3 in ONE sort (join_aggregate.py); the
+    # group key (join key % 1024) is a pure function of the join key
+    from sparkrdma_tpu.models.join_aggregate import (
+        make_broadcast_join_aggregate_step,
+    )
+
+    def gk_fn(ku):
+        return ku % jnp.asarray(1024, ku.dtype)
+
+    def val_fn(ku, fact_pay_u, dim_val_u):
+        return jax.lax.bitcast_convert_type(
+            fact_pay_u ^ dim_val_u, jnp.int32
+        )
+
+    step23 = make_broadcast_join_aggregate_step(
+        mesh, m1 // D, n_dim2, gk_fn, val_fn
+    )
+
+    def pipeline_fused():
+        sk1, spay1, fval1, found1, fill1 = step1(
+            lk, lv, l_valid, rk1, rv1, r1_valid
+        )
+        gk, sums, counts, mins, maxs, _n = step23(
+            spay1, fval1, found1, rk2, rv2, r2_valid
+        )
+        return counts, fill1
+
+    counts_f, fill1_f = pipeline_fused()
+    assert int(np.max(np.asarray(fill1_f))) <= cap1, "stage-1 overflow"
+    total_f = int(np.asarray(counts_f).sum())
+    assert total_f == total, (total_f, total)
+
+    dt_f = time_iters(lambda: pipeline_fused()[0], iters=5)
+    gbps_f = n_fact * 8 / dt_f / 1e9 / D
+    emit(
+        f"TPC-DS pipeline, fused join+aggregate (ONE sort for stages "
+        f"2+3) per chip ({n_fact} fact rows, {D} chip(s))",
+        gbps_f, "GB/s/chip", gbps_f / ROCE_LINE_RATE_GBPS,
     )
 
 

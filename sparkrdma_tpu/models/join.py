@@ -55,7 +55,7 @@ from sparkrdma_tpu.models._base import (
 )
 from sparkrdma_tpu.ops.partition import (
     hash_partition_ids,
-    partition_to_buckets,
+    partition_to_buckets_dropping,
 )
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
@@ -119,20 +119,16 @@ def _pack_sides(lk, lv, l_valid, rk, rv, r_valid):
     return ku, role, pay
 
 
-def _probe_packed(ku, role, pay):
-    """Sort-merge probe over a packed (key, role, payload) stream.
-
-    One unstable sort keyed (key, role) groups each key's run with its
-    dimension row first; a log-step forward fill then propagates the
-    latest dimension (key, value) rightward — a fact row matches iff
-    the filled dimension key equals its own (runs with no dimension row
-    inherit a previous run's fill, which the key test rejects; invalid
-    rows never fill and never match).  Returns ``(keys_u, fact_pay,
-    dim_pay, found)`` with found = 1 exactly on matched fact rows.
+def _probe_fill(sk, srole, spay):
+    """Log-step forward fill over an already (key, role)-sorted packed
+    stream: propagate each (unique-keyed) dimension row's (key, value)
+    rightward; a fact row matches iff the filled dimension key equals
+    its own (runs with no dimension row inherit a previous run's fill,
+    which the key test rejects; invalid rows never fill and never
+    match).  Returns ``(dim_val, found)`` with found a bool mask true
+    exactly on matched fact rows.  Shared with the fused
+    join+aggregate (models/join_aggregate.py), whose sort key differs.
     """
-    sk, srole, spay = jax.lax.sort(
-        (ku, role, pay), num_keys=2, is_stable=False
-    )
     m = int(sk.shape[0])
     flag = srole == _ROLE_DIM
     fkey = sk
@@ -147,9 +143,23 @@ def _probe_packed(ku, role, pay):
         fval = jnp.where(need, pv, fval)
         flag = flag | pf
         s <<= 1
-    found = (
-        (srole == _ROLE_FACT) & flag & (fkey == sk)
-    ).astype(jnp.int32)
+    found = (srole == _ROLE_FACT) & flag & (fkey == sk)
+    return fval, found
+
+
+def _probe_packed(ku, role, pay):
+    """Sort-merge probe over a packed (key, role, payload) stream.
+
+    One unstable sort keyed (key, role) groups each key's run with its
+    dimension row first, then the :func:`_probe_fill` forward fill
+    matches fact rows.  Returns ``(keys_u, fact_pay, dim_pay, found)``
+    with found = 1 exactly on matched fact rows.
+    """
+    sk, srole, spay = jax.lax.sort(
+        (ku, role, pay), num_keys=2, is_stable=False
+    )
+    fval, found_b = _probe_fill(sk, srole, spay)
+    found = found_b.astype(jnp.int32)
     fval = jnp.where(found > 0, fval, jnp.zeros((), fval.dtype))
     return sk, spay, fval, found
 
@@ -169,11 +179,11 @@ def make_hash_join_step(mesh: Mesh, n_left: int, n_right: int,
             eku, erole, epay = ku, role, pay
             fill = jnp.int32(0)
         else:
-            my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
+            # padding rides the trash bucket (consumes no real
+            # capacity, excluded from overflow accounting)
             ids = hash_partition_ids(ku, D)
-            ids = jnp.where(role != _ROLE_INVALID, ids, my)
-            (bk, br, bp), counts = partition_to_buckets(
-                ids, (ku, role, pay), D, capacity,
+            (bk, br, bp), counts = partition_to_buckets_dropping(
+                ids, role != _ROLE_INVALID, (ku, role, pay), D, capacity,
                 fill_values=(
                     jnp.zeros((), ku.dtype), jnp.uint32(_ROLE_INVALID),
                     jnp.zeros((), pay.dtype),

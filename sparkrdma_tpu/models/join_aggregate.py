@@ -1,0 +1,215 @@
+"""Fused broadcast-join + aggregation: one sort where q64/q72 plans more.
+
+TPC-DS q64/q72 physical plans end in ``fact ⋈ dim → aggregate``.  Run
+naively that is two full-length sorts back to back — the broadcast
+probe's (key, role) sort and the aggregation's group-key sort (the
+read-path combine of RdmaShuffleReader.scala:82-97) — and the sorts are
+where the time goes on TPU (join.py module docs).
+
+Whenever the aggregation's group key is a pure function of the JOIN key
+(group by the join key itself, its bucket, a date part, ... — the
+common star-schema shape), the two groupings are compatible: sorting
+the packed stream by ``(group_key, join_key, role)`` groups equal join
+keys contiguously *inside* contiguous group-key runs.  ONE sort then
+serves both stages:
+
+  sort (gk, key, role, payload)          # 4 operands, 3 sort keys
+  → log-step forward fill of dim rows    # the join probe (join.py)
+  → per-gk-run sum/count via global cumsum + run-end diffs
+  → per-gk-run min/max via log-step segmented scans (ops/segment.py)
+
+versus the unfused ``make_broadcast_join_step`` + ``make_aggregate_step``
+pair's two 3-operand sorts.  Outputs use the same run-end layout as
+``aggregate_by_key_local`` (extract where ``counts > 0``).
+
+Multi-device: each device aggregates its local packed shard; a group
+key can surface on several devices, so per-device rows are PARTIAL
+aggregates — the host wrapper merges them (sum/count add, min/max
+combine), the same final-merge contract as Spark's two-phase
+aggregation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.models._base import ExchangeModel
+from sparkrdma_tpu.models.aggregate import KeyStats
+from sparkrdma_tpu.models.join import (
+    _ROLE_INVALID,
+    _as_columns,
+    _pack_sides,
+    _pad_to,
+    _probe_fill,
+)
+from sparkrdma_tpu.ops.segment import (
+    _ff_run_carry,
+    _prev_end,
+    segmented_scan,
+)
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+# group-key / aggregation-value hooks both receive UNSIGNED transport
+# columns (join.py _pay_u views); agg_val_fn picks the output dtype and
+# min/max identities follow it
+GroupKeyFn = Callable[[jax.Array], jax.Array]
+AggValFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def _minmax_identities(dtype):
+    dt = np.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.array(jnp.inf, dt), jnp.array(-jnp.inf, dt)
+    return jnp.array(jnp.iinfo(dt).max, dt), jnp.array(jnp.iinfo(dt).min, dt)
+
+
+@functools.lru_cache(maxsize=16)
+def make_broadcast_join_aggregate_step(
+    mesh: Mesh,
+    n_left: int,
+    n_right_total: int,
+    group_key_fn: GroupKeyFn,
+    agg_val_fn: AggValFn,
+):
+    """Jitted fused step: fact side sharded [D*n_left], dimension side
+    replicated; returns per-device run-end partial aggregates
+    ``(gk, sums, counts, mins, maxs, n_groups)``.
+
+    ``group_key_fn(key_u)`` must depend ONLY on the join key (that is
+    the fusion precondition); ``agg_val_fn(key_u, fact_pay_u,
+    dim_val_u)`` builds the aggregated value per matched fact row.
+
+    Both hooks key the compile cache BY IDENTITY: pass module-level
+    functions (or hold a reference), not fresh per-call lambdas — a
+    new lambda each call re-traces and re-jits the whole step.
+    """
+    spec = P(EXCHANGE_AXIS)
+
+    def body(lk, lv, l_valid, rk, rv, r_valid):
+        ku, role, pay = _pack_sides(lk, lv, l_valid, rk, rv, r_valid)
+        gk = group_key_fn(ku).astype(ku.dtype)
+        # invalid rows ride a sentinel group so they sort to the global
+        # tail and can never delimit or join a real group's run
+        gmax = jnp.array(jnp.iinfo(gk.dtype).max, gk.dtype)
+        gk = jnp.where(role != _ROLE_INVALID, gk, gmax)
+        sgk, sk, srole, spay = jax.lax.sort(
+            (gk, ku, role, pay), num_keys=3, is_stable=False
+        )
+        dim_val, found = _probe_fill(sk, srole, spay)
+        v = agg_val_fn(sk, spay, dim_val)
+        id_min, id_max = _minmax_identities(v.dtype)
+        mi = found.astype(jnp.int32)
+        vz = jnp.where(found, v, jnp.zeros((), v.dtype))
+        # group-run boundaries on the group key alone
+        is_last = jnp.concatenate([sgk[1:] != sgk[:-1], jnp.ones(1, bool)])
+        heads = jnp.concatenate([jnp.ones(1, bool), sgk[1:] != sgk[:-1]])
+        csum_v = jnp.cumsum(vz)
+        csum_m = jnp.cumsum(mi)
+        flag, (fv, fm) = _ff_run_carry(is_last, (csum_v, csum_m))
+        prev_v, prev_m = _prev_end(flag, (fv, fm))
+        counts = jnp.where(is_last, csum_m - prev_m, 0).astype(jnp.int32)
+        # the sentinel-group tail never counts: found is 0 there
+        real = counts > 0
+        counts = jnp.where(real, counts, 0)
+        sums = jnp.where(real, csum_v - prev_v, 0).astype(v.dtype)
+        mins = segmented_scan(
+            jnp.where(found, v, id_min), heads, jnp.minimum, id_min
+        )
+        maxs = segmented_scan(
+            jnp.where(found, v, id_max), heads, jnp.maximum, id_max
+        )
+        mins = jnp.where(real, mins, 0).astype(v.dtype)
+        maxs = jnp.where(real, maxs, 0).astype(v.dtype)
+        out_gk = jnp.where(real, sgk, gmax)
+        n_groups = jnp.sum(real.astype(jnp.int32))
+        return out_gk, sums, counts, mins, maxs, n_groups[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, P(None), P(None), P(None)),
+        out_specs=(spec,) * 6,
+    )
+    return jax.jit(mapped)
+
+
+class BroadcastJoinAggregator(ExchangeModel):
+    """Host-facing fused ``fact ⋈ dim → aggregateByKey`` for group keys
+    derived from the join key.  Returns ``{group_key: KeyStats}`` over
+    matched fact rows (inner-join semantics: unmatched facts aggregate
+    nowhere)."""
+
+    def join_aggregate(
+        self,
+        fact_keys,
+        fact_vals,
+        dim_keys,
+        dim_vals,
+        group_key_fn: Optional[GroupKeyFn] = None,
+        agg_val_fn: Optional[AggValFn] = None,
+    ) -> Dict[int, KeyStats]:
+        if group_key_fn is None:
+            group_key_fn = _identity_group_key
+        if agg_val_fn is None:
+            agg_val_fn = _dim_value_agg
+        lk, lv = _as_columns(fact_keys, fact_vals)
+        rk, rv = _as_columns(dim_keys, dim_vals)
+        D = self.n_devices
+        lk, lv, l_valid, nl = _pad_to(lk, lv, D)
+        r_valid = jnp.ones(rk.shape[0], jnp.int32)
+        step = make_broadcast_join_aggregate_step(
+            self.mesh, nl // D, rk.shape[0], group_key_fn, agg_val_fn
+        )
+        rep = NamedSharding(self.mesh, P(None))
+        gk, sums, counts, mins, maxs, _n = step(
+            jax.device_put(lk, self.sharding),
+            jax.device_put(lv, self.sharding),
+            jax.device_put(l_valid, self.sharding),
+            jax.device_put(jnp.asarray(rk), rep),
+            jax.device_put(jnp.asarray(rv), rep),
+            jax.device_put(r_valid, rep),
+        )
+        # merge per-device PARTIAL rows (two-phase aggregation's final
+        # combine): sums/counts add, mins/maxs combine.  Group keys are
+        # computed in the unsigned transport domain; report them in the
+        # join-key dtype's domain (same-width signed reinterpretation,
+        # the _mask_output contract) so negative join keys round-trip
+        gk_h = np.asarray(gk)
+        signed = np.dtype(f"i{gk_h.dtype.itemsize}")
+        gk_h = gk_h.view(signed).astype(np.dtype(lk.dtype), copy=False)
+        sums_h, counts_h = np.asarray(sums), np.asarray(counts)
+        mins_h, maxs_h = np.asarray(mins), np.asarray(maxs)
+        out: Dict[int, KeyStats] = {}
+        (idx,) = (counts_h > 0).nonzero()
+        for i in idx:
+            key = int(gk_h[i])
+            prev = out.get(key)
+            if prev is None:
+                out[key] = KeyStats(
+                    int(sums_h[i]), int(counts_h[i]),
+                    int(mins_h[i]), int(maxs_h[i]),
+                )
+            else:
+                out[key] = KeyStats(
+                    prev.sum + int(sums_h[i]),
+                    prev.count + int(counts_h[i]),
+                    min(prev.min, int(mins_h[i])),
+                    max(prev.max, int(maxs_h[i])),
+                )
+        return out
+
+
+def _identity_group_key(key_u):
+    return key_u
+
+
+def _dim_value_agg(key_u, fact_pay_u, dim_val_u):
+    # default: aggregate the joined dimension value, reinterpreted as
+    # the signed width (int32/int64 transport parity)
+    it = jnp.int64 if dim_val_u.dtype.itemsize == 8 else jnp.int32
+    return jax.lax.bitcast_convert_type(dim_val_u, it)

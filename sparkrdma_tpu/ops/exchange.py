@@ -15,7 +15,10 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from sparkrdma_tpu.ops.partition import hash_partition_ids, partition_to_buckets
+from sparkrdma_tpu.ops.partition import (
+    hash_partition_ids,
+    partition_to_buckets_dropping,
+)
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
 
@@ -29,8 +32,11 @@ def hash_exchange(
     """Hash-partition local (keys, vals, valid) columns into n_devices
     buckets of ``capacity`` and all_to_all them to their owners.
 
-    Padding (valid == 0) is routed to this device's own bucket so it can
-    never displace real records elsewhere; bucket fill slots carry
+    Padding (valid == 0) is routed to a TRASH bucket (id = n_devices)
+    that is never exchanged, so it consumes zero real capacity and can
+    never displace a real record or signal a false overflow — routing
+    it to the home bucket (round 1) overflowed on heavily padded
+    streams such as post-join validity masks.  Bucket fill slots carry
     (dtype-max key, 0 value, 0 valid).
 
     Returns (keys', vals', valid', max_fill): flat [D * capacity] local
@@ -43,11 +49,9 @@ def hash_exchange(
     """
     if n_devices == 1:
         return keys, vals, valid, jnp.int32(0)
-    my = jax.lax.axis_index(EXCHANGE_AXIS).astype(jnp.int32)
     ids = hash_partition_ids(keys, n_devices)
-    ids = jnp.where(valid > 0, ids, my)
-    (bk, bv, bm), counts = partition_to_buckets(
-        ids, (keys, vals, valid), n_devices, capacity,
+    (bk, bv, bm), counts = partition_to_buckets_dropping(
+        ids, valid > 0, (keys, vals, valid), n_devices, capacity,
         fill_values=(
             jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype),
             jnp.zeros((), vals.dtype),

@@ -140,6 +140,30 @@ def partition_to_buckets(
     return tuple(bucketed), counts
 
 
+def partition_to_buckets_dropping(
+    part_ids: jax.Array,
+    keep: jax.Array,
+    values: Tuple[jax.Array, ...],
+    n_parts: int,
+    capacity: int,
+    fill_values: Optional[Tuple] = None,
+) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+    """:func:`partition_to_buckets` with a TRASH bucket: rows whose
+    ``keep`` (bool) is false route to bucket id ``n_parts``, which is
+    sliced off the outputs and the counts.  Dropped rows therefore
+    consume zero real capacity and can neither displace a real record
+    nor signal a false overflow — routing padding to a real (home)
+    bucket overflowed on heavily padded streams (post-join validity
+    masks).  The slice happens BEFORE any exchange and the trash
+    bucket is excluded from overflow accounting by construction.
+    """
+    ids = jnp.where(keep, part_ids.astype(jnp.int32), jnp.int32(n_parts))
+    bucketed, counts = partition_to_buckets(
+        ids, values, n_parts + 1, capacity, fill_values
+    )
+    return tuple(b[:n_parts] for b in bucketed), counts[:n_parts]
+
+
 def _window_copy(sorted_arr: jax.Array, starts: jax.Array,
                  n_parts: int, capacity: int) -> jax.Array:
     """Copy n_parts contiguous windows [starts[p], starts[p]+capacity)

@@ -22,6 +22,30 @@ import jax
 import jax.numpy as jnp
 
 
+def segmented_scan(vals, heads, op, identity):
+    """Inclusive segmented scan: ``out[i]`` combines ``vals`` with
+    ``op`` from the nearest segment head at or before ``i`` through
+    ``i``.  ``heads`` is a bool column marking segment starts (position
+    0 need not be flagged — out-of-range acts as a boundary).
+
+    Log-step (Hillis–Steele) like the forward fills in this module:
+    ~log2(n) passes of shift + where, no gathers.  ``identity`` is
+    ``op``'s neutral element (0 for add, dtype max for min, ...).
+    """
+    n = int(vals.shape[0])
+    x = vals
+    f = heads
+    ident = jnp.full((1,), identity, vals.dtype)
+    s = 1
+    while s < n:
+        px = jnp.concatenate([jnp.broadcast_to(ident, (s,)), x[:-s]])
+        pf = jnp.concatenate([jnp.ones(s, bool), f[:-s]])
+        x = jnp.where(f, x, op(px, x))
+        f = f | pf
+        s <<= 1
+    return x
+
+
 def _ff_run_carry(is_last, columns):
     """Log-step forward fill of ``columns`` from run-END positions:
     after the fill, position i holds each column's value at the latest

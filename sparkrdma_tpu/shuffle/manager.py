@@ -524,6 +524,19 @@ class TpuShuffleManager:
 
     def _handle_publish(self, msg: PublishMapTaskOutputMsg) -> None:
         assert self.is_driver, "publish must only reach the driver"
+        with self._executors_lock:
+            tombstoned = msg.shuffle_manager_id in self._removed
+        if tombstoned:
+            # an in-flight publish racing the executor's prune must not
+            # resurrect its outputs (they are unreachable: fetch-status
+            # fails fast for tombstoned hosts, and a later duplicate
+            # prune no longer re-clears state)
+            logger.warning(
+                "dropping publish from removed executor %s (shuffle=%d "
+                "map=%d)", msg.shuffle_manager_id, msg.shuffle_id,
+                msg.map_id,
+            )
+            return
         mto = self._get_or_create_mto(
             msg.shuffle_id, msg.shuffle_manager_id, msg.map_id,
             msg.total_num_partitions,
@@ -905,10 +918,17 @@ class TpuShuffleManager:
         executor get their futures failed so driver-side fetch-status
         waits unblock immediately instead of timing out."""
         with self._executors_lock:
-            if smid in self._executors:
+            was_member = smid in self._executors
+            if was_member:
                 self._executors.remove(smid)
             self._removed.add(smid)
         self._last_ack.pop(smid, None)
+        if not was_member:
+            # duplicate prune (heartbeat timeout racing a send-failure
+            # callback): membership did not change again, so do NOT
+            # bump the epoch — that would doom shuffles registered
+            # after the first prune and clear valid waiters/plans
+            return
         # bulk-mode plan waiters can never be satisfied once a member is
         # lost (stable membership is the mode's contract): answer them
         # negatively NOW so readers fail fast instead of timing out

@@ -253,3 +253,45 @@ def test_post_loss_bulk_plan_request_fails_fast(cluster):
         list(reader.read(56))
     assert time.monotonic() - t0 < 5
     net.heal(victim.node.address)
+
+
+def test_duplicate_prune_does_not_bump_epoch(cluster):
+    """A heartbeat-timeout prune racing a send-failure callback calls
+    remove_executor twice; the second call must not bump the membership
+    epoch (it would doom shuffles registered after the first prune) nor
+    re-clear plan waiters/cache (code-review finding)."""
+    net, conf, driver, executors = cluster
+    victim = executors[2]
+    net.partition(victim.node.address)
+    _await(lambda: victim.local_smid not in driver.executors, msg="prune")
+    epoch = driver._membership_epoch
+    driver.remove_executor(victim.local_smid)  # duplicate (raced) prune
+    assert driver._membership_epoch == epoch
+    net.heal(victim.node.address)
+
+
+def test_publish_from_tombstoned_executor_dropped(cluster):
+    """An in-flight publish racing its executor's prune must not
+    resurrect the dead executor's outputs on the driver."""
+    from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
+    from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+
+    net, conf, driver, executors = cluster
+    part = HashPartitioner(2)
+    driver.register_shuffle(77, 1, part)
+    victim = executors[0]
+    net.partition(victim.node.address)
+    _await(lambda: victim.local_smid not in driver.executors, msg="prune")
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    mto = MapTaskOutput(2)
+    mto.put(0, BlockLocation(1, 8, 3))
+    mto.put(1, BlockLocation(9, 8, 3))
+    msg = PublishMapTaskOutputMsg(
+        victim.local_smid, shuffle_id=77, map_id=0,
+        total_num_partitions=2, first_reduce_id=0, last_reduce_id=1,
+        entries=mto.get_range_bytes(0, 1),
+    )
+    driver._handle_publish(msg)
+    assert victim.local_smid not in driver.maps_by_host(77)
+    net.heal(victim.node.address)

@@ -463,3 +463,85 @@ print("OK")
     assert out.returncode == 0 and "OK" in out.stdout, (
         out.stdout + out.stderr
     )
+
+
+def _join_aggregate_oracle(fk, fv, dk, dv, gk_fn, val_fn):
+    """numpy oracle for the fused broadcast-join + aggregate."""
+    lookup = dict(zip(dk.tolist(), dv.tolist()))
+    groups = {}
+    for k, pv in zip(fk.tolist(), fv.tolist()):
+        if k not in lookup:
+            continue
+        g = gk_fn(k)
+        v = val_fn(k, pv, lookup[k])
+        s, c, mn, mx = groups.get(g, (0, 0, None, None))
+        groups[g] = (
+            s + v, c + 1,
+            v if mn is None else min(mn, v),
+            v if mx is None else max(mx, v),
+        )
+    return groups
+
+
+def test_broadcast_join_aggregate_fused(mesh, devices):
+    import jax.numpy as jnp
+
+    from sparkrdma_tpu.models.join_aggregate import BroadcastJoinAggregator
+
+    fk, fv, dk, dv, _ = _join_case(11, 4096, 300, 1000)
+    # negative dim values exercise min/max over the signed decode
+    dv = dv - (1 << 29)
+
+    def gk_fn(ku):
+        return ku % jnp.asarray(17, ku.dtype)
+
+    def val_fn(ku, fact_pay_u, dim_val_u):
+        import jax.lax as lax
+
+        return lax.bitcast_convert_type(
+            fact_pay_u, jnp.int32
+        ) ^ lax.bitcast_convert_type(dim_val_u, jnp.int32)
+
+    agg = BroadcastJoinAggregator(mesh)
+    got = agg.join_aggregate(fk, fv, dk, dv, gk_fn, val_fn)
+    want = _join_aggregate_oracle(
+        fk, fv, dk, dv, lambda k: k % 17, lambda k, a, b: a ^ b
+    )
+    assert set(got) == set(want)
+    for g, (s, c, mn, mx) in want.items():
+        st = got[g]
+        # sums wrap in int32 (JVM Int parity, models/aggregate.py)
+        assert (st.sum - s) % (1 << 32) == 0, (g, st, s)
+        assert (st.count, st.min, st.max) == (c, mn, mx), (g, st)
+
+
+def test_broadcast_join_aggregate_defaults_and_edge_keys(mesh, devices):
+    from sparkrdma_tpu.models.join_aggregate import BroadcastJoinAggregator
+
+    imax = np.iinfo(np.int32).max
+    # default hooks: group by the join key, aggregate the dim value;
+    # imax fact key must not match padding, unmatched key 9 drops out
+    fk = np.array([1, 1, 2, imax, 9], np.int32)
+    fv = np.array([10, 11, 20, 30, 90], np.int32)
+    dk = np.array([1, 2], np.int32)
+    dv = np.array([-5, 7], np.int32)
+    agg = BroadcastJoinAggregator(mesh)
+    got = agg.join_aggregate(fk, fv, dk, dv)
+    assert set(got) == {1, 2}
+    assert got[1] == (-10, 2, -5, -5)
+    assert got[2] == (7, 1, 7, 7)
+
+
+def test_broadcast_join_aggregate_negative_keys(mesh, devices):
+    # group keys must come back in the signed join-key domain, not the
+    # unsigned transport view (code-review finding)
+    from sparkrdma_tpu.models.join_aggregate import BroadcastJoinAggregator
+
+    fk = np.array([-5, -5, 3], np.int32)
+    fv = np.array([1, 2, 3], np.int32)
+    dk = np.array([-5, 3], np.int32)
+    dv = np.array([100, 200], np.int32)
+    got = BroadcastJoinAggregator(mesh).join_aggregate(fk, fv, dk, dv)
+    assert set(got) == {-5, 3}
+    assert got[-5] == (200, 2, 100, 100)
+    assert got[3] == (200, 1, 200, 200)
