@@ -97,12 +97,12 @@ def main():
     r2_valid = jax.device_put(np.ones(n_dim2, np.int32), rep)
 
     def pipeline():
-        sk1, spay1, fval1, found1, fill1 = step1(
+        sk1, spay1, fval1, found1, _isf1, fill1 = step1(
             lk, lv, l_valid, rk1, rv1, r1_valid
         )
         # stage 2: join key = the fk2 payload, value = dim1's value,
         # validity = stage 1's found mask (no compaction)
-        sk2, spay2, fval2, found2 = step2(
+        sk2, spay2, fval2, found2, _isf2 = step2(
             spay1, fval1, found1, rk2, rv2, r2_valid
         )
         k3, v3 = prep3(sk2, spay2, fval2, found2)
@@ -146,7 +146,7 @@ def main():
     )
 
     def pipeline_fused():
-        sk1, spay1, fval1, found1, fill1 = step1(
+        sk1, spay1, fval1, found1, _isf1, fill1 = step1(
             lk, lv, l_valid, rk1, rv1, r1_valid
         )
         gk, sums, counts, mins, maxs, _n = step23(

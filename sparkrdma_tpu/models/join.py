@@ -42,7 +42,7 @@ hard part does not arise).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -161,7 +161,8 @@ def _probe_packed(ku, role, pay):
     fval, found_b = _probe_fill(sk, srole, spay)
     found = found_b.astype(jnp.int32)
     fval = jnp.where(found > 0, fval, jnp.zeros((), fval.dtype))
-    return sk, spay, fval, found
+    is_fact = (srole == _ROLE_FACT).astype(jnp.int32)
+    return sk, spay, fval, found, is_fact
 
 
 @functools.lru_cache(maxsize=16)
@@ -199,11 +200,11 @@ def make_hash_join_step(mesh: Mesh, n_left: int, n_right: int,
                 bp, EXCHANGE_AXIS, split_axis=0, concat_axis=0
             ).reshape(-1)
             fill = jnp.max(counts).astype(jnp.int32)
-        sk, spay, fval, found = _probe_packed(eku, erole, epay)
-        return sk, spay, fval, found, fill[None]
+        sk, spay, fval, found, is_fact = _probe_packed(eku, erole, epay)
+        return sk, spay, fval, found, is_fact, fill[None]
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec,) * 6, out_specs=(spec,) * 5
+        body, mesh=mesh, in_specs=(spec,) * 6, out_specs=(spec,) * 6
     )
     return jax.jit(mapped)
 
@@ -220,23 +221,34 @@ def make_broadcast_join_step(mesh: Mesh, n_left: int, n_right_total: int):
     mapped = jax.shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec, P(None), P(None), P(None)),
-        out_specs=(spec,) * 4,
+        out_specs=(spec,) * 5,
     )
     return jax.jit(mapped)
 
 
+#: join variants (Spark/SQL parity): inner keeps matched fact rows with
+#: the dim value; left_outer keeps EVERY fact row plus a matched mask;
+#: semi keeps matched fact rows without the dim value (left-semi,
+#: TPC-DS q16); anti keeps the UNmatched fact rows (left-anti, q94).
+JOIN_HOWS = ("inner", "left_outer", "semi", "anti")
+
+
 class HashJoiner(ExchangeModel):
-    """Exchange-shuffle inner join of (fact_keys, fact_vals) with a
-    unique-keyed (dim_keys, dim_vals)."""
+    """Exchange-shuffle join of (fact_keys, fact_vals) with a
+    unique-keyed (dim_keys, dim_vals); ``how`` picks the variant
+    (:data:`JOIN_HOWS`)."""
 
     def __init__(self, mesh: Optional[Mesh] = None,
                  capacity_factor: float = 1.6):
         super().__init__(mesh, capacity_factor)
 
-    def join(self, fact_keys, fact_vals, dim_keys, dim_vals
-             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Returns (keys, fact_vals, dim_vals) for every matching fact
-        row (input order not preserved)."""
+    def join(self, fact_keys, fact_vals, dim_keys, dim_vals,
+             how: str = "inner"):
+        """inner → (keys, fact_vals, dim_vals) for matching fact rows;
+        left_outer → (keys, fact_vals, dim_vals, matched) for ALL fact
+        rows (dim_vals is 0 where unmatched); semi/anti → (keys,
+        fact_vals) for matched/unmatched fact rows.  Input order is not
+        preserved."""
         lk, lv = _as_columns(fact_keys, fact_vals)
         rk, rv = _as_columns(dim_keys, dim_vals)
         D = self.n_devices
@@ -253,20 +265,22 @@ class HashJoiner(ExchangeModel):
             # one capacity for the fused fact+dim stream
             cap = self._capacity((nl + nr) // D, factor)
             step = make_hash_join_step(self.mesh, nl // D, nr // D, cap)
-            sk, spay, fval, found, fill = step(*placed)
+            sk, spay, fval, found, is_fact, fill = step(*placed)
             overflowed = int(np.max(np.asarray(fill))) > cap
-            return (sk, spay, fval, found), overflowed
+            return (sk, spay, fval, found, is_fact), overflowed
 
-        sk, spay, fval, found = self._retry_with_factor(attempt)
-        return _mask_output(sk, spay, fval, found, lk.dtype, lv.dtype,
-                            rv.dtype)
+        sk, spay, fval, found, is_fact = self._retry_with_factor(attempt)
+        return _mask_output(sk, spay, fval, found, is_fact,
+                            lk.dtype, lv.dtype, rv.dtype, how)
 
 
 class BroadcastJoiner(ExchangeModel):
-    """Broadcast inner join: dimension side replicated to every device."""
+    """Broadcast join: dimension side replicated to every device;
+    ``how`` picks the variant (:data:`JOIN_HOWS`)."""
 
-    def join(self, fact_keys, fact_vals, dim_keys, dim_vals
-             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def join(self, fact_keys, fact_vals, dim_keys, dim_vals,
+             how: str = "inner"):
+        """Same output contract as :meth:`HashJoiner.join`."""
         lk, lv = _as_columns(fact_keys, fact_vals)
         rk, rv = _as_columns(dim_keys, dim_vals)
         D = self.n_devices
@@ -274,7 +288,7 @@ class BroadcastJoiner(ExchangeModel):
         r_valid = jnp.ones(rk.shape[0], jnp.int32)
         step = make_broadcast_join_step(self.mesh, nl // D, rk.shape[0])
         rep = NamedSharding(self.mesh, P(None))
-        sk, spay, fval, found = step(
+        sk, spay, fval, found, is_fact = step(
             jax.device_put(lk, self.sharding),
             jax.device_put(lv, self.sharding),
             jax.device_put(l_valid, self.sharding),
@@ -282,18 +296,33 @@ class BroadcastJoiner(ExchangeModel):
             jax.device_put(jnp.asarray(rv), rep),
             jax.device_put(r_valid, rep),
         )
-        return _mask_output(sk, spay, fval, found, lk.dtype, lv.dtype,
-                            rv.dtype)
+        return _mask_output(sk, spay, fval, found, is_fact,
+                            lk.dtype, lv.dtype, rv.dtype, how)
 
 
-def _mask_output(sk, spay, fval, found, key_dtype, lv_dtype, rv_dtype):
-    """Host-side inner-join filter: keep matched fact rows, restoring
-    the original dtypes from the unsigned transport views."""
+def _mask_output(sk, spay, fval, found, is_fact, key_dtype, lv_dtype,
+                 rv_dtype, how="inner"):
+    """Host-side join filter per variant, restoring the original dtypes
+    from the unsigned transport views."""
+    if how not in JOIN_HOWS:
+        raise ValueError(f"how must be one of {JOIN_HOWS}, got {how!r}")
     width = np.dtype(sk.dtype).itemsize
-    mask = np.asarray(found) > 0
+    found_h = np.asarray(found) > 0
+    if how == "inner":
+        mask = found_h
+    elif how in ("left_outer",):
+        mask = np.asarray(is_fact) > 0
+    elif how == "semi":
+        mask = found_h
+    else:  # anti: real fact rows with no dimension match
+        mask = (np.asarray(is_fact) > 0) & ~found_h
     keys = np.asarray(sk).astype(np.dtype(key_dtype))[mask]
     outl = np.asarray(_pay_from_u(spay, lv_dtype, width))[mask]
+    if how in ("semi", "anti"):
+        return keys, outl
     outv = np.asarray(_pay_from_u(fval, rv_dtype, width))[mask]
+    if how == "left_outer":
+        return keys, outl, outv, found_h[mask]
     return keys, outl, outv
 
 

@@ -545,3 +545,44 @@ def test_broadcast_join_aggregate_negative_keys(mesh, devices):
     assert set(got) == {-5, 3}
     assert got[-5] == (200, 2, 100, 100)
     assert got[3] == (200, 1, 200, 200)
+
+
+@pytest.mark.parametrize("joiner_cls", ["hash", "broadcast"])
+def test_join_variants_semi_anti_outer(joiner_cls, mesh, devices):
+    """left-semi (TPC-DS q16), left-anti (q94), and left-outer joins
+    against dict oracles."""
+    from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+
+    fk, fv, dk, dv, _ = _join_case(23, 5000, 250, 900)
+    lut = dict(zip(dk.tolist(), dv.tolist()))
+    j = (HashJoiner if joiner_cls == "hash" else BroadcastJoiner)(mesh)
+
+    matched = sorted(
+        (int(k), int(v)) for k, v in zip(fk, fv) if int(k) in lut
+    )
+    unmatched = sorted(
+        (int(k), int(v)) for k, v in zip(fk, fv) if int(k) not in lut
+    )
+
+    k, lv = j.join(fk, fv, dk, dv, how="semi")
+    assert sorted(zip(k.tolist(), lv.tolist())) == matched
+
+    k, lv = j.join(fk, fv, dk, dv, how="anti")
+    assert sorted(zip(k.tolist(), lv.tolist())) == unmatched
+
+    k, lv, rv, m = j.join(fk, fv, dk, dv, how="left_outer")
+    assert len(k) == len(fk)
+    got = sorted(
+        ((int(kk), int(vv), int(rr) if mm else None)
+         for kk, vv, rr, mm in zip(k, lv, rv, m)),
+        key=lambda t: (t[0], t[1]),
+    )
+    want = sorted(
+        ((int(kk), int(vv), lut.get(int(kk)))
+         for kk, vv in zip(fk, fv)),
+        key=lambda t: (t[0], t[1]),
+    )
+    assert got == want
+
+    with pytest.raises(ValueError, match="how"):
+        j.join(fk, fv, dk, dv, how="full_outer")
