@@ -74,6 +74,26 @@ class DeviceSegment:
             return view
         return bytes(np.asarray(self.array[offset:end]))
 
+    def read_many(self, spans):
+        """Serve many ``(offset, length)`` blocks with ONE device→host
+        transfer covering their union span (a per-block ``read`` costs
+        a device slice dispatch + host round-trip EACH — through the
+        real chip's tunnel that is milliseconds per block).  Host
+        segments keep the per-span zero-copy views."""
+        if not spans:
+            return []
+        lo = min(o for o, _l in spans)
+        hi = max(o + _l for o, _l in spans)
+        if lo < 0 or hi > self.nbytes:
+            raise TransportError(
+                f"read_many [{lo},{hi}) outside segment "
+                f"mkey={self.mkey} of {self.nbytes}B"
+            )
+        if isinstance(self.array, np.ndarray):
+            return [self.read(o, l) for o, l in spans]
+        buf = np.asarray(self.array[lo:hi])
+        return [bytes(buf[o - lo : o - lo + l]) for o, l in spans]
+
 
 class ArenaSpanSegment:
     """A registered span of the persistent per-device HBM arena
@@ -105,6 +125,21 @@ class ArenaSpanSegment:
                 f"of {self.nbytes}B"
             )
         return self.span.arena.read(self.span.offset + offset, length)
+
+    def read_many(self, spans):
+        """One arena read over the union span, sliced per block (see
+        DeviceSegment.read_many)."""
+        if not spans:
+            return []
+        lo = min(o for o, _l in spans)
+        hi = max(o + _l for o, _l in spans)
+        if lo < 0 or hi > self.nbytes:
+            raise TransportError(
+                f"read_many [{lo},{hi}) outside arena span "
+                f"mkey={self.mkey} of {self.nbytes}B"
+            )
+        buf = self.span.arena.read(self.span.offset + lo, hi - lo)
+        return [buf[o - lo : o - lo + l] for o, l in spans]
 
 
 class ArenaManager(BlockStore):

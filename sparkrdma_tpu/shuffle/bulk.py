@@ -239,19 +239,25 @@ class BulkExchangeReader:
         streams: List[List[bytes]] = [[b""] * E for _ in range(E)]
         if my_maps:
             num_parts = mgr.resolver.num_partitions(shuffle_id)
-            for d in range(E):
-                parts = []
-                for map_id in my_maps:
+            # one batched backing-store read per map output (every
+            # partition ships somewhere, so fetch each segment ONCE
+            # instead of a device round-trip per block), then deal the
+            # blocks out to their destination streams
+            parts_by_dst: List[List[bytes]] = [[] for _ in range(E)]
+            for map_id in my_maps:
+                blocks = mgr.resolver.get_local_blocks(
+                    shuffle_id, map_id, range(num_parts)
+                )
+                for d in range(E):
                     for r in range(d, num_parts, E):
-                        blk = mgr.resolver.get_local_block(
-                            shuffle_id, map_id, r
-                        )
+                        blk = blocks[r]
                         if len(blk):
-                            parts.append(
+                            parts_by_dst[d].append(
                                 blk if isinstance(blk, bytes)
                                 else bytes(blk)
                             )
-                streams[me][d] = b"".join(parts)
+            for d in range(E):
+                streams[me][d] = b"".join(parts_by_dst[d])
         for d in range(E):
             if len(streams[me][d]) != int(lengths[me, d]):
                 raise MetadataFetchFailedError(

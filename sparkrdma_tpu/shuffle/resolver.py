@@ -409,6 +409,31 @@ class ShuffleBlockResolver:
             return b""
         return seg.read(loc.address, loc.length)
 
+    def get_local_blocks(
+        self, shuffle_id: int, map_id: int, reduce_ids
+    ) -> List[bytes]:
+        """Serve many of one map output's partition blocks with ONE
+        backing-store read (``Segment.read_many`` batches the
+        device→host transfer — the bulk plane reads every partition of
+        every map, and a per-block fetch pays a device round-trip
+        each).  Empty partitions come back as ``b""``."""
+        with self._lock:
+            sd = self._shuffles.get(shuffle_id)
+            entry = sd.outputs.get(map_id) if sd else None
+        if entry is None:
+            raise KeyError(
+                f"no committed output for shuffle={shuffle_id} map={map_id}"
+            )
+        mto, seg = entry
+        locs = [mto.get_location(r) for r in reduce_ids]
+        spans = [
+            (loc.address, loc.length) for loc in locs if not loc.is_empty
+        ]
+        blocks = iter(seg.read_many(spans))
+        return [
+            b"" if loc.is_empty else next(blocks) for loc in locs
+        ]
+
     def num_partitions(self, shuffle_id: int) -> int:
         with self._lock:
             sd = self._shuffles.get(shuffle_id)
