@@ -461,10 +461,44 @@ class Dataset:
         ds = Dataset(self.ctx, parts)
         return ds._shuffled(RangePartitioner(n, sample), key_ordering=True)
 
-    def join(self, other: "Dataset",
-             num_partitions: Optional[int] = None) -> "Dataset":
-        """Inner equi-join: (k, v) ⋈ (k, w) → (k, (v, w)) — the exchange
-        shuffle of the reference's SQL workloads (BASELINE configs)."""
+    def combine_by_key(self, create_combiner, merge_value, merge_combiners,
+                       num_partitions: Optional[int] = None) -> "Dataset":
+        """The general combiner (Spark combineByKey; the reference's
+        read-path Aggregator, RdmaShuffleReader.scala:82-97):
+        map-side combine with ``create_combiner``/``merge_value``,
+        reduce-side merge with ``merge_combiners``."""
+        n = num_partitions or self.num_partitions
+        agg = Aggregator(
+            create_combiner=create_combiner,
+            merge_value=merge_value,
+            merge_combiners=merge_combiners,
+        )
+        return self._shuffled(
+            HashPartitioner(n), aggregator=agg, map_side_combine=True
+        )
+
+    def count_by_key(self) -> Dict[Any, int]:
+        """Action: {key: occurrence count} (one reduce_by_key pass)."""
+        return dict(
+            self.map(lambda kv: (kv[0], 1))
+            .reduce_by_key(lambda a, b: a + b)
+            .collect()
+        )
+
+    def distinct(self, num_partitions: Optional[int] = None) -> "Dataset":
+        """Distinct elements via a hash-partitioned nil-value shuffle
+        (co-locates duplicates, keeps one per partition)."""
+        n = num_partitions or self.num_partitions
+        keyed = self.map(lambda x: (x, None))
+        return (
+            keyed.reduce_by_key(lambda a, b: a, num_partitions=n)
+            .map(lambda kv: kv[0])
+        )
+
+    def _cogrouped(self, other: "Dataset",
+                   num_partitions: Optional[int] = None) -> "Dataset":
+        """(k, ([vs], [ws])) — both sides tagged and grouped in ONE
+        shuffle (the cogroup narrow dependency)."""
         n = num_partitions or max(self.num_partitions, other.num_partitions)
         tagged = Dataset(
             self.ctx,
@@ -473,14 +507,52 @@ class Dataset:
         )
         grouped = tagged.group_by_key(n)
 
-        def emit(part):
+        def split(part):
             out = []
             for k, tagged_vals in part:
                 left = [v for t, v in tagged_vals if t == 0]
                 right = [w for t, w in tagged_vals if t == 1]
-                for v in left:
-                    for w in right:
-                        out.append((k, (v, w)))
+                out.append((k, (left, right)))
             return out
 
-        return grouped.map_partitions(emit)
+        return grouped.map_partitions(split)
+
+    def cogroup(self, other: "Dataset",
+                num_partitions: Optional[int] = None) -> "Dataset":
+        """Spark cogroup: (k, ([vs], [ws])) for every key on either
+        side."""
+        return self._cogrouped(other, num_partitions)
+
+    def join(self, other: "Dataset",
+             num_partitions: Optional[int] = None,
+             how: str = "inner") -> "Dataset":
+        """Equi-join: (k, v) ⋈ (k, w) — the exchange shuffle of the
+        reference's SQL workloads (BASELINE configs).  ``how`` is
+        inner (→ (k, (v, w))), left_outer (w may be None), semi
+        (→ (k, v) where a match exists), or anti (→ (k, v) where
+        none does) — the record-plane analog of the device joins
+        (models/join.py JOIN_HOWS)."""
+        if how not in ("inner", "left_outer", "semi", "anti"):
+            raise ValueError(f"unsupported join how={how!r}")
+        cg = self._cogrouped(other, num_partitions)
+
+        def emit(part):
+            out = []
+            for k, (left, right) in part:
+                if how == "semi":
+                    if right:
+                        out.extend((k, v) for v in left)
+                elif how == "anti":
+                    if not right:
+                        out.extend((k, v) for v in left)
+                elif how == "left_outer":
+                    for v in left:
+                        out.extend(
+                            (k, (v, w)) for w in (right or [None])
+                        )
+                else:
+                    for v in left:
+                        out.extend((k, (v, w)) for w in right)
+            return out
+
+        return cg.map_partitions(emit)

@@ -99,3 +99,57 @@ def test_device_aggregate_and_join_via_context(ctx, devices):
         m = fk < 100
         assert len(jk) == m.sum()
         assert (jdv == jk * 2).all()
+
+
+def test_dataset_cogroup_distinct_count_by_key(ctx):
+    left = ctx.parallelize([(k % 5, k) for k in range(40)], num_slices=4)
+    right = ctx.parallelize([(k % 7, -k) for k in range(21)], num_slices=3)
+    cg = dict(left.cogroup(right, num_partitions=4).collect())
+    lpairs = [(k2 % 5, k2) for k2 in range(40)]
+    rpairs = [(k2 % 7, -k2) for k2 in range(21)]
+    for k, (vs, ws) in cg.items():
+        assert sorted(vs) == sorted(v for kk, v in lpairs if kk == k)
+        assert sorted(ws) == sorted(w for kk, w in rpairs if kk == k)
+    assert set(cg) == set(range(7))
+
+    d = ctx.parallelize([1, 2, 2, 3, 3, 3, 4] * 3, num_slices=4)
+    assert sorted(d.distinct(num_partitions=3).collect()) == [1, 2, 3, 4]
+
+    kv = ctx.parallelize([("a", 1), ("b", 2), ("a", 3)] * 5, num_slices=2)
+    assert kv.count_by_key() == {"a": 10, "b": 5}
+
+
+def test_dataset_join_variants(ctx):
+    left = ctx.parallelize([(1, "x"), (1, "y"), (2, "z"), (9, "q")],
+                           num_slices=2)
+    right = ctx.parallelize([(1, 10), (2, 20), (3, 30)], num_slices=2)
+    inner = sorted(left.join(right, num_partitions=3).collect())
+    assert inner == [(1, ("x", 10)), (1, ("y", 10)), (2, ("z", 20))]
+    louter = sorted(left.join(right, how="left_outer").collect())
+    assert louter == [
+        (1, ("x", 10)), (1, ("y", 10)), (2, ("z", 20)), (9, ("q", None))
+    ]
+    semi = sorted(left.join(right, how="semi").collect())
+    assert semi == [(1, "x"), (1, "y"), (2, "z")]
+    anti = sorted(left.join(right, how="anti").collect())
+    assert anti == [(9, "q")]
+    with pytest.raises(ValueError, match="how"):
+        left.join(right, how="cross")
+
+
+def test_dataset_combine_by_key(ctx):
+    kv = ctx.parallelize(
+        [(k % 3, v) for k, v in enumerate(range(30))], num_slices=4
+    )
+    # combiner tracks (sum, count) -> mean per key
+    out = dict(
+        kv.combine_by_key(
+            create_combiner=lambda v: (v, 1),
+            merge_value=lambda c, v: (c[0] + v, c[1] + 1),
+            merge_combiners=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            num_partitions=3,
+        ).collect()
+    )
+    for k in range(3):
+        vals = [v for i, v in enumerate(range(30)) if i % 3 == k]
+        assert out[k] == (sum(vals), len(vals))
