@@ -96,3 +96,74 @@ def test_fuzz_joins(mesh, devices):
             k, lv, rv = j.join(fk, fv, dk, dv)
             got = sorted(zip(k.tolist(), lv.tolist(), rv.tolist()))
             assert got == expect, f"case {i} {type(j).__name__}"
+
+
+def test_fuzz_join_variants(mesh, devices):
+    """semi/anti/left_outer joins fuzzed vs dict oracles across skew,
+    tiny sides, and key spaces with/without full dim coverage."""
+    from sparkrdma_tpu.models import BroadcastJoiner, HashJoiner
+    from tests.test_models import _join_case
+
+    rng = np.random.default_rng(900)
+    joiners = [HashJoiner(mesh), BroadcastJoiner(mesh)]
+    for i in range(6):
+        n_dim = int(rng.choice((1, 17, 500)))
+        n_fact = int(rng.choice((1, 64, 2048)))
+        fk, fv, dk, dv, _ = _join_case(
+            seed=900 + i, n_fact=n_fact, n_dim=n_dim, key_space=2 * n_dim
+        )
+        lut = set(dk.tolist())
+        matched = sorted(
+            (int(k), int(v)) for k, v in zip(fk, fv) if int(k) in lut
+        )
+        unmatched = sorted(
+            (int(k), int(v)) for k, v in zip(fk, fv) if int(k) not in lut
+        )
+        for j in joiners:
+            name = f"case {i} {type(j).__name__}"
+            k, lv = j.join(fk, fv, dk, dv, how="semi")
+            assert sorted(zip(k.tolist(), lv.tolist())) == matched, name
+            k, lv = j.join(fk, fv, dk, dv, how="anti")
+            assert sorted(zip(k.tolist(), lv.tolist())) == unmatched, name
+            k, lv, rv, m = j.join(fk, fv, dk, dv, how="left_outer")
+            assert len(k) == len(fk), name
+            assert int(m.sum()) == len(matched), name
+
+
+def test_fuzz_join_aggregate(mesh, devices):
+    """Fused broadcast-join+aggregate fuzzed vs a dict oracle (group
+    key = join key % P for random P, value = dim ^ fact)."""
+    import jax.numpy as jnp
+
+    from sparkrdma_tpu.models.join_aggregate import BroadcastJoinAggregator
+    from tests.test_models import _join_aggregate_oracle, _join_case
+
+    agg = BroadcastJoinAggregator(mesh)
+    rng = np.random.default_rng(1200)
+    for i in range(5):
+        n_dim = int(rng.choice((3, 50, 700)))
+        n_fact = int(rng.choice((8, 512, 3000)))
+        P = int(rng.choice((1, 7, 64)))
+        fk, fv, dk, dv, _ = _join_case(
+            seed=1200 + i, n_fact=n_fact, n_dim=n_dim, key_space=2 * n_dim
+        )
+
+        def gk_fn(ku, _P=P):
+            return ku % jnp.asarray(_P, ku.dtype)
+
+        def val_fn(ku, fp, dvu):
+            import jax.lax as lax
+
+            return lax.bitcast_convert_type(
+                fp, jnp.int32
+            ) ^ lax.bitcast_convert_type(dvu, jnp.int32)
+
+        got = agg.join_aggregate(fk, fv, dk, dv, gk_fn, val_fn)
+        want = _join_aggregate_oracle(
+            fk, fv, dk, dv, lambda k, _P=P: k % _P, lambda k, a, b: a ^ b
+        )
+        assert set(got) == set(want), f"case {i}"
+        for g, (s, c, mn, mx) in want.items():
+            st = got[g]
+            assert (st.sum - s) % (1 << 32) == 0, (i, g)
+            assert (st.count, st.min, st.max) == (c, mn, mx), (i, g)
