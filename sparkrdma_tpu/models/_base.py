@@ -108,14 +108,21 @@ class ExchangeModel:
             keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])
             vals = np.concatenate([vals, np.zeros(n_pad, vals.dtype)])
             valid[n:] = 0
+        # D == 1 with no padding: every slot is real, so the step can
+        # drop the validity operand from its sort (the sort is the
+        # step's whole cost on one chip)
+        fast = D == 1 and n_pad == 0
+        cols = (keys, vals) if fast else (keys, vals, valid)
         # place once: only the capacity changes between overflow retries
         placed = tuple(
-            jax.device_put(jnp.asarray(x), self.sharding)
-            for x in (keys, vals, valid)
+            jax.device_put(jnp.asarray(x), self.sharding) for x in cols
         )
 
         def run(cap):
-            step = make_step(self.mesh, (n + n_pad) // D, cap)
+            step = make_step(
+                self.mesh, (n + n_pad) // D, cap,
+                with_validity=not fast,
+            )
             *rows, n_unique, max_fill = step(*placed)
             return (rows, n_unique), max_fill
 

@@ -39,11 +39,33 @@ class KeyStats(NamedTuple):
 
 
 @functools.lru_cache(maxsize=16)
-def make_aggregate_step(mesh: Mesh, n_local: int, capacity: int):
+def make_aggregate_step(mesh: Mesh, n_local: int, capacity: int,
+                        with_validity: bool = True):
     """Jitted aggregateByKey step over global [D*n_local] columns
-    sharded on the mesh axis."""
+    sharded on the mesh axis.  ``with_validity=False`` is the D == 1
+    unpadded fast path (segment.py: drops the validity sort operand)."""
     D = len(list(mesh.devices.flat))
     spec = P(EXCHANGE_AXIS)
+
+    if not with_validity:
+        if D != 1:
+            raise ValueError(
+                "with_validity=False requires D == 1 (bucket fills on "
+                "a real exchange need the validity column)"
+            )
+
+        def body_nv(k, v):  # local [n_local], all slots real
+            uniq, sums, counts, mins, maxs, n_unique = (
+                aggregate_by_key_local(k, v, None)
+            )
+            return (uniq, sums, counts, mins, maxs, n_unique[None],
+                    jnp.zeros(1, jnp.int32))
+
+        mapped = jax.shard_map(
+            body_nv, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec,) * 7,
+        )
+        return jax.jit(mapped)
 
     def body(k, v, valid):  # local [n_local]
         # (hash_exchange is the identity for D == 1 — no padded sorts)

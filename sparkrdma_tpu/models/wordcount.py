@@ -27,11 +27,31 @@ from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
 
 
 @functools.lru_cache(maxsize=16)
-def make_count_step(mesh: Mesh, n_local: int, capacity: int):
-    """Jitted reduceByKey(+) step over global [D*n_local] key/value/valid
-    arrays sharded on the mesh axis."""
+def make_count_step(mesh: Mesh, n_local: int, capacity: int,
+                    with_validity: bool = True):
+    """Jitted reduceByKey(+) step over global [D*n_local] key/value
+    (/valid) arrays sharded on the mesh axis.  ``with_validity=False``
+    is the D == 1 unpadded fast path: every slot is real, so the
+    validity operand drops out of the reduction sort entirely."""
     D = len(list(mesh.devices.flat))
     spec = P(EXCHANGE_AXIS)
+
+    if not with_validity:
+        if D != 1:
+            raise ValueError(
+                "with_validity=False requires D == 1 (bucket fills on "
+                "a real exchange need the validity column)"
+            )
+
+        def body_nv(k, v):  # local [n_local], all slots real
+            uniq, sums, cnts, n_unique = reduce_by_key_local(k, v, None)
+            return uniq, sums, cnts, n_unique[None], jnp.zeros(1, jnp.int32)
+
+        mapped = jax.shard_map(
+            body_nv, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec,) * 5,
+        )
+        return jax.jit(mapped)
 
     def body(k, v, valid):  # local [n_local]
         # (hash_exchange is the identity for D == 1 — no padded sorts)

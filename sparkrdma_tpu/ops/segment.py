@@ -16,7 +16,7 @@ one chip).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -78,11 +78,14 @@ def _prev_end(flag, cols):
 
 
 def reduce_by_key_local(
-    keys: jax.Array, vals: jax.Array, valid: jax.Array
+    keys: jax.Array, vals: jax.Array, valid: Optional[jax.Array]
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Reduce (sum) values by key over one device's elements.
 
-    ``valid`` is an int32 0/1 indicator per slot.  Invalid slots must be
+    ``valid`` is an int32 0/1 indicator per slot — or ``None``, the
+    every-slot-real fast path that drops the validity operand from the
+    sort entirely (the D == 1 / unpadded case: one third less sort
+    traffic, and the sort IS the step's cost).  Invalid slots must be
     pre-masked to (key = dtype max, value = 0, valid = 0) so they all
     group into the single final run; REAL keys equal to the dtype max
     are still counted correctly because validity is tracked explicitly
@@ -97,12 +100,16 @@ def reduce_by_key_local(
       (n_unique positions match).
     """
     sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
-    m = valid.astype(jnp.int32)
-    # one sort groups runs; valids order before invalids within a run
-    ks, ms, vs = jax.lax.sort(
-        (keys, jnp.int32(1) - m, vals), num_keys=2, is_stable=False
-    )
-    ms = jnp.int32(1) - ms
+    if valid is None:
+        ks, vs = jax.lax.sort((keys, vals), num_keys=1, is_stable=False)
+        ms = jnp.ones(keys.shape[0], jnp.int32)
+    else:
+        m = valid.astype(jnp.int32)
+        # one sort groups runs; valids order before invalids in a run
+        ks, ms, vs = jax.lax.sort(
+            (keys, jnp.int32(1) - m, vals), num_keys=2, is_stable=False
+        )
+        ms = jnp.int32(1) - ms
     csum_v = jnp.cumsum(vs)
     csum_m = jnp.cumsum(ms)
     is_last = jnp.concatenate([ks[1:] != ks[:-1], jnp.ones(1, bool)])
@@ -118,7 +125,7 @@ def reduce_by_key_local(
 
 
 def aggregate_by_key_local(
-    keys: jax.Array, vals: jax.Array, valid: jax.Array
+    keys: jax.Array, vals: jax.Array, valid: Optional[jax.Array]
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Full keyed aggregation over one device's elements: sum, count,
     min, and max per distinct key in one pass (the device-side
@@ -126,8 +133,10 @@ def aggregate_by_key_local(
     RdmaShuffleReader.scala:82-97).
 
     Same masking contract as :func:`reduce_by_key_local` (invalid slots
-    pre-masked to key = dtype max, value = 0, valid = 0), and the same
-    run-end output layout: extract with ``counts > 0``.
+    pre-masked to key = dtype max, value = 0, valid = 0; ``valid=None``
+    is the every-slot-real fast path dropping the validity sort
+    operand), and the same run-end output layout: extract with
+    ``counts > 0``.
 
     Sums accumulate in the value dtype and wrap on overflow — the JVM
     Int/Long semantics Spark's reduceByKey(_+_) has.  (Widening to
@@ -142,15 +151,21 @@ def aggregate_by_key_local(
     forward fill as a next-value column.  No gathers, no second sort.
     """
     sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
-    m = valid.astype(jnp.int32)
-    inv = jnp.int32(1) - m
-    ks, inv_s, vs = jax.lax.sort(
-        (keys, inv, vals), num_keys=3, is_stable=False
-    )
-    ms = jnp.int32(1) - inv_s
+    if valid is None:
+        # values stay in the sort key (min/max ride run order)
+        ks, vs = jax.lax.sort((keys, vals), num_keys=2, is_stable=False)
+        ms = jnp.ones(keys.shape[0], jnp.int32)
+        bound = ks[1:] != ks[:-1]
+    else:
+        m = valid.astype(jnp.int32)
+        inv = jnp.int32(1) - m
+        ks, inv_s, vs = jax.lax.sort(
+            (keys, inv, vals), num_keys=3, is_stable=False
+        )
+        ms = jnp.int32(1) - inv_s
+        bound = (ks[1:] != ks[:-1]) | (inv_s[1:] != inv_s[:-1])
     csum_v = jnp.cumsum(vs)
     csum_m = jnp.cumsum(ms)
-    bound = (ks[1:] != ks[:-1]) | (inv_s[1:] != inv_s[:-1])
     is_last = jnp.concatenate([bound, jnp.ones(1, bool)])
     # the slot after a run's end opens the NEXT run = its min
     vs_next = jnp.concatenate([vs[1:], jnp.zeros(1, vs.dtype)])

@@ -586,3 +586,32 @@ def test_join_variants_semi_anti_outer(joiner_cls, mesh, devices):
 
     with pytest.raises(ValueError, match="how"):
         j.join(fk, fv, dk, dv, how="full_outer")
+
+
+def test_keyed_models_single_device_fast_path(devices):
+    """D == 1 with no padding engages the validity-free sort fast path
+    (with_validity=False); results must match the padded general path."""
+    from sparkrdma_tpu.models import KeyedAggregator, WordCounter
+
+    m1 = make_mesh(1)
+    rng = np.random.default_rng(55)
+    keys = rng.integers(0, 97, 4096, dtype=np.int32)  # even n: unpadded
+    vals = rng.integers(-500, 500, 4096, dtype=np.int32)
+    got = WordCounter(m1).count(keys, vals)
+    u = np.unique(keys)
+    assert got == {
+        int(k): int(vals[keys == k].sum()) for k in u
+    }
+    stats = KeyedAggregator(m1).aggregate(keys, vals)
+    for k in u:
+        sel = vals[keys == k]
+        st = stats[int(k)]
+        assert (st.sum, st.count, st.min, st.max) == (
+            int(sel.sum()), len(sel), int(sel.min()), int(sel.max())
+        )
+    # dtype-max key is a REAL key on the fast path too (no sentinel
+    # confusion when every slot is valid)
+    imax = np.iinfo(np.int32).max
+    keys2 = np.array([imax, imax, 7, 8], np.int32)
+    vals2 = np.array([1, 2, 3, 4], np.int32)
+    assert WordCounter(m1).count(keys2, vals2) == {imax: 3, 7: 3, 8: 4}

