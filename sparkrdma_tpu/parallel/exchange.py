@@ -138,9 +138,22 @@ class ExchangePlan:
             self.rounds = 0
             self.total_cols = 0
             return
-        # tile: lane-aligned, no larger than needed for a single round
-        tile = min(int(tile_bytes), max_len)
-        tile = max(TILE_ALIGN, (tile + TILE_ALIGN - 1) // TILE_ALIGN * TILE_ALIGN)
+        # tile: lane-aligned, no larger than needed for a single round,
+        # QUANTIZED to a power-of-two ladder of TILE_ALIGN units below
+        # the configured tile — the collective's compiled shape is
+        # (D, D, tile), so an exact-fit tile recompiles for every
+        # distinct stream size (20-40s per novel shape on a real chip);
+        # the ladder bounds distinct shapes to ~log2(tile_bytes/128)
+        # for ≤2x padding on sub-tile exchanges
+        cap = max(
+            TILE_ALIGN,
+            (int(tile_bytes) + TILE_ALIGN - 1) // TILE_ALIGN * TILE_ALIGN,
+        )
+        if max_len >= cap:
+            tile = cap
+        else:
+            units = (max_len + TILE_ALIGN - 1) // TILE_ALIGN
+            tile = min(cap, TILE_ALIGN * (1 << (units - 1).bit_length()))
         self.tile_bytes = tile
         self.rounds = math.ceil(max_len / tile)
         self.total_cols = self.rounds * tile

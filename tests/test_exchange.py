@@ -204,3 +204,30 @@ def test_exchange_bytes_single_host_stays_plain(devices):
     out = ex.exchange_bytes(streams)
     assert isinstance(out, list)
     assert all(out[d][s] == streams[s][d] for s in range(4) for d in range(4))
+
+
+def test_plan_tile_quantized_to_pow2_ladder():
+    """Sub-tile exchanges quantize the tile to a power-of-two ladder of
+    TILE_ALIGN units so the compiled collective shape repeats across
+    varying stream sizes (a 20-40s recompile per novel shape on chip)."""
+    from sparkrdma_tpu.parallel.exchange import TILE_ALIGN, ExchangePlan
+
+    def plan_for(max_len, conf_tile=4 << 20):
+        lengths = np.zeros((4, 4), np.int64)
+        lengths[0, 1] = max_len
+        return ExchangePlan(lengths, conf_tile)
+
+    seen = {plan_for(n).tile_bytes for n in range(1, 100_000, 777)}
+    # ~100k/128 distinct exact tiles collapse onto the pow2 ladder
+    assert len(seen) <= 11, seen
+    for t in seen:
+        assert t % TILE_ALIGN == 0
+        u = t // TILE_ALIGN
+        assert u & (u - 1) == 0, f"tile {t} not a pow2 of units"
+    # at/above the configured tile the shape is pinned to it
+    assert plan_for(4 << 20).tile_bytes == 4 << 20
+    assert plan_for(64 << 20).tile_bytes == 4 << 20
+    assert plan_for((4 << 20) + 1).rounds == 2
+    # rounds still cover the payload on the ladder
+    p = plan_for(100_001)
+    assert p.rounds * p.tile_bytes >= 100_001
