@@ -22,6 +22,29 @@ from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
 MAX_OVERFLOW_RETRIES = 6
 
 
+def quantize_padded_length(n: int, d: int) -> int:
+    """Smallest padded length ≥ n that is a multiple of ``d`` and sits
+    on an 8-steps-per-octave ladder (≤12.5% padding).
+
+    The SPMD steps compile per (n_local, capacity) shape, so feeding
+    exact input sizes compiles a fresh XLA program for every distinct
+    job size (20-40s per novel shape on a real chip).  Quantizing the
+    padded length collapses arbitrary sizes onto ~8 shapes per octave;
+    padding rides the existing validity column.  Inputs already on the
+    ladder (e.g. power-of-two benches) pad nothing and keep the
+    validity-free fast path.
+    """
+    if n <= 0:
+        return n
+    if n <= 8:
+        m = n
+    else:
+        k = (n - 1).bit_length()
+        step = 1 << max(0, k - 3)
+        m = (n + step - 1) // step * step
+    return (m + d - 1) // d * d
+
+
 def check_no_silent_truncation(**columns) -> None:
     """Reject int64 columns when jax_enable_x64 is off: jnp.asarray
     would silently truncate them to int32, colliding keys or corrupting
@@ -39,11 +62,23 @@ def check_no_silent_truncation(**columns) -> None:
 class ExchangeModel:
     """Base for host-facing drivers of capacity-bucketed SPMD steps."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, capacity_factor: float = 1.3):
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 capacity_factor: float = 1.3,
+                 quantize_shapes: bool = True):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = len(list(self.mesh.devices.flat))
         self.capacity_factor = capacity_factor
+        # quantize padded lengths onto the compile-shape ladder
+        # (quantize_padded_length); opt out for exact-shape control
+        self.quantize_shapes = quantize_shapes
         self.sharding = NamedSharding(self.mesh, P(EXCHANGE_AXIS))
+
+    def _padded_length(self, n: int) -> int:
+        """Padded total length for an n-row input: multiple of D, on
+        the compile-shape ladder when ``quantize_shapes``."""
+        if self.quantize_shapes:
+            return quantize_padded_length(n, self.n_devices)
+        return n + ((-n) % self.n_devices)
 
     def _capacity(self, n_local: int, factor: Optional[float] = None) -> int:
         """Per-bucket capacity: n_local/D scaled by the skew factor,
@@ -102,7 +137,7 @@ class ExchangeModel:
         if n == 0:
             return None, None
         D = self.n_devices
-        n_pad = (-n) % D
+        n_pad = self._padded_length(n) - n
         valid = np.ones(n + n_pad, np.int32)
         if n_pad:
             keys = np.concatenate([keys, np.zeros(n_pad, keys.dtype)])

@@ -252,8 +252,8 @@ class HashJoiner(ExchangeModel):
         lk, lv = _as_columns(fact_keys, fact_vals)
         rk, rv = _as_columns(dim_keys, dim_vals)
         D = self.n_devices
-        lk, lv, l_valid, nl = _pad_to(lk, lv, D)
-        rk, rv, r_valid, nr = _pad_to(rk, rv, D)
+        lk, lv, l_valid, nl = _pad_to(lk, lv, D, self.quantize_shapes)
+        rk, rv, r_valid, nr = _pad_to(rk, rv, D, self.quantize_shapes)
 
         # place inputs once: only the capacities change between retries
         placed = tuple(
@@ -284,7 +284,7 @@ class BroadcastJoiner(ExchangeModel):
         lk, lv = _as_columns(fact_keys, fact_vals)
         rk, rv = _as_columns(dim_keys, dim_vals)
         D = self.n_devices
-        lk, lv, l_valid, nl = _pad_to(lk, lv, D)
+        lk, lv, l_valid, nl = _pad_to(lk, lv, D, self.quantize_shapes)
         r_valid = jnp.ones(rk.shape[0], jnp.int32)
         step = make_broadcast_join_step(self.mesh, nl // D, rk.shape[0])
         rep = NamedSharding(self.mesh, P(None))
@@ -335,9 +335,16 @@ def _as_columns(keys, vals):
     return k, v
 
 
-def _pad_to(k, v, d):
+def _pad_to(k, v, d, quantize=True):
+    """Pad columns to a multiple of ``d`` on the compile-shape ladder
+    (models/_base.quantize_padded_length) with a validity column."""
+    from sparkrdma_tpu.models._base import quantize_padded_length
+
     n = k.shape[0]
-    n_pad = (-n) % d
+    total = (
+        quantize_padded_length(n, d) if quantize else n + ((-n) % d)
+    )
+    n_pad = total - n
     valid = np.ones(n + n_pad, np.int32)
     if n_pad:
         valid[n:] = 0

@@ -160,7 +160,7 @@ class BroadcastJoinAggregator(ExchangeModel):
         lk, lv = _as_columns(fact_keys, fact_vals)
         rk, rv = _as_columns(dim_keys, dim_vals)
         D = self.n_devices
-        lk, lv, l_valid, nl = _pad_to(lk, lv, D)
+        lk, lv, l_valid, nl = _pad_to(lk, lv, D, self.quantize_shapes)
         r_valid = jnp.ones(rk.shape[0], jnp.int32)
         step = make_broadcast_join_aggregate_step(
             self.mesh, nl // D, rk.shape[0], group_key_fn, agg_val_fn

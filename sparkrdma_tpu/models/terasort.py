@@ -252,10 +252,12 @@ class TeraSorter(ExchangeModel):
         n = keys.shape[0]
         if n == 0:
             return keys.copy(), vals.copy()
-        # pad to a multiple of D; padding is tracked by the validity
-        # column (NOT by key value), so max-valued real keys are safe
+        # pad to a multiple of D on the compile-shape ladder
+        # (_base.quantize_padded_length); padding is tracked by the
+        # validity column (NOT by key value), so max-valued real keys
+        # are safe
         D = self.n_devices
-        n_pad = (-n) % D
+        n_pad = self._padded_length(n) - n
         sentinel = np.array(np.iinfo(keys.dtype).max, keys.dtype)
         if n_pad:
             keys = np.concatenate([keys, np.full(n_pad, sentinel, keys.dtype)])

@@ -615,3 +615,24 @@ def test_keyed_models_single_device_fast_path(devices):
     keys2 = np.array([imax, imax, 7, 8], np.int32)
     vals2 = np.array([1, 2, 3, 4], np.int32)
     assert WordCounter(m1).count(keys2, vals2) == {imax: 3, 7: 3, 8: 4}
+
+
+def test_quantized_padded_lengths_collapse_shapes(mesh, devices):
+    """Arbitrary input sizes collapse onto the 8-steps-per-octave
+    compile-shape ladder (≤12.5% padding), and results stay exact."""
+    from sparkrdma_tpu.models._base import quantize_padded_length
+    from sparkrdma_tpu.models import WordCounter
+
+    sizes = {quantize_padded_length(n, 8) for n in range(1000, 100_000, 997)}
+    # ~100 distinct sizes collapse to ~8 per octave over ~7 octaves
+    assert len(sizes) <= 60, len(sizes)
+    for n in (1000, 99_001):
+        m = quantize_padded_length(n, 8)
+        assert m >= n and m % 8 == 0 and m <= n * 1.13 + 8
+
+    wc = WordCounter(mesh)
+    rng = np.random.default_rng(77)
+    keys = rng.integers(0, 31, 12_345, dtype=np.int32)  # off-ladder n
+    got = wc.count(keys)
+    u, c = np.unique(keys, return_counts=True)
+    assert got == dict(zip(u.tolist(), c.tolist()))
