@@ -24,23 +24,24 @@ MAX_OVERFLOW_RETRIES = 6
 
 def quantize_padded_length(n: int, d: int) -> int:
     """Smallest padded length ≥ n that is a multiple of ``d`` and sits
-    on an 8-steps-per-octave ladder (≤12.5% padding).
+    on a 16-steps-per-octave ladder (≤12.5% padding, worst case just
+    past an octave boundary where the step is 1/8 of n).
 
     The SPMD steps compile per (n_local, capacity) shape, so feeding
     exact input sizes compiles a fresh XLA program for every distinct
     job size (20-40s per novel shape on a real chip).  Quantizing the
-    padded length collapses arbitrary sizes onto ~8 shapes per octave;
+    padded length collapses arbitrary sizes onto ~16 shapes per octave;
     padding rides the existing validity column.  Inputs already on the
     ladder (e.g. power-of-two benches) pad nothing and keep the
     validity-free fast path.
     """
     if n <= 0:
         return n
-    if n <= 8:
+    if n <= 16:
         m = n
     else:
         k = (n - 1).bit_length()
-        step = 1 << max(0, k - 3)
+        step = 1 << max(0, k - 4)
         m = (n + step - 1) // step * step
     return (m + d - 1) // d * d
 

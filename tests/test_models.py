@@ -623,12 +623,12 @@ def test_quantized_padded_lengths_collapse_shapes(mesh, devices):
     from sparkrdma_tpu.models._base import quantize_padded_length
     from sparkrdma_tpu.models import WordCounter
 
-    sizes = {quantize_padded_length(n, 8) for n in range(1000, 100_000, 997)}
-    # ~100 distinct sizes collapse to ~8 per octave over ~7 octaves
-    assert len(sizes) <= 60, len(sizes)
-    for n in (1000, 99_001):
+    sizes = {quantize_padded_length(n, 8) for n in range(1000, 100_000, 97)}
+    # ~1000 distinct sizes collapse to ~16 per octave over ~7 octaves
+    assert len(sizes) <= 130, len(sizes)
+    for n in range(1000, 100_000, 97):
         m = quantize_padded_length(n, 8)
-        assert m >= n and m % 8 == 0 and m <= n * 1.13 + 8
+        assert m >= n and m % 8 == 0 and m <= n * 1.125 + 8, (n, m)
 
     wc = WordCounter(mesh)
     rng = np.random.default_rng(77)
