@@ -36,9 +36,14 @@ def main():
         (rng.zipf(1.3, n) % 100_000).astype(np.int32), wc.sharding
     )
     vals = jax.device_put(jnp.ones(n, jnp.int32), wc.sharding)
-    valid = jax.device_put(jnp.ones(n, jnp.int32), wc.sharding)
     n_local = n // wc.n_devices
     cap = wc._capacity(n_local, factor=4.0)
+    # valid=None: on one chip this engages the validity-free sort fast
+    # path; on a mesh the step builds the all-ones column itself
+    valid = (
+        None if wc.n_devices == 1
+        else jax.device_put(jnp.ones(n, jnp.int32), wc.sharding)
+    )
 
     def run():
         (uniq, sums, counts, n_unique, fill), _ = wc.count_device(

@@ -89,9 +89,15 @@ class WordCounter(ExchangeModel):
             raise ValueError(f"length {n} not divisible by D={self.n_devices}")
         n_local = n // self.n_devices
         cap = capacity or self._capacity(n_local)
-        step = make_count_step(self.mesh, n_local, cap)
         keys = jax.device_put(keys, self.sharding)
         vals = jax.device_put(vals, self.sharding)
+        if valid is None and self.n_devices == 1:
+            # every slot real on one device: validity-free sort
+            step = make_count_step(
+                self.mesh, n_local, cap, with_validity=False
+            )
+            return step(keys, vals), cap
+        step = make_count_step(self.mesh, n_local, cap)
         if valid is None:
             valid = jnp.ones(n, jnp.int32)
         valid = jax.device_put(valid, self.sharding)
