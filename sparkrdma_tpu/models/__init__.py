@@ -5,13 +5,17 @@ and reduce/sort fused into one jitted SPMD step instead of a CPU
 serializer + NIC pull loop."""
 
 from sparkrdma_tpu.models.aggregate import KeyedAggregator, KeyStats
-from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
+from sparkrdma_tpu.models.external_sort import ExternalTeraSorter
+from sparkrdma_tpu.models.join import JOIN_HOWS, BroadcastJoiner, HashJoiner
+from sparkrdma_tpu.models.join_aggregate import BroadcastJoinAggregator
 from sparkrdma_tpu.models.ring_attention import ring_attention, ulysses_attention
 from sparkrdma_tpu.models.terasort import TeraSorter, make_sort_step
 from sparkrdma_tpu.models.wordcount import WordCounter, make_count_step
 
 __all__ = [
     "TeraSorter", "make_sort_step", "WordCounter", "make_count_step",
-    "HashJoiner", "BroadcastJoiner", "ring_attention", "ulysses_attention",
+    "HashJoiner", "BroadcastJoiner", "JOIN_HOWS",
+    "BroadcastJoinAggregator", "ExternalTeraSorter",
+    "ring_attention", "ulysses_attention",
     "KeyedAggregator", "KeyStats",
 ]
