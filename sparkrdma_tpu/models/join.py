@@ -128,8 +128,22 @@ def _probe_fill(sk, srole, spay):
     match).  Returns ``(dim_val, found)`` with found a bool mask true
     exactly on matched fact rows.  Shared with the fused
     join+aggregate (models/join_aggregate.py), whose sort key differs.
+    Large TPU fills run as ONE Pallas pass (ops/scan_kernels.py)
+    instead of the log-step loop.
     """
+    from sparkrdma_tpu.ops.scan_kernels import (
+        MIN_KERNEL_ELEMS,
+        scan_flagged,
+        use_scan_kernels,
+    )
+
     m = int(sk.shape[0])
+    if m >= MIN_KERNEL_ELEMS and use_scan_kernels():
+        flag, (fkey, fval) = scan_flagged(
+            "fill", srole == _ROLE_DIM, (sk, spay)
+        )
+        found = (srole == _ROLE_FACT) & flag & (fkey == sk)
+        return fval, found
     flag = srole == _ROLE_DIM
     fkey = sk
     fval = spay

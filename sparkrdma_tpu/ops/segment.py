@@ -28,11 +28,23 @@ def segmented_scan(vals, heads, op, identity):
     ``i``.  ``heads`` is a bool column marking segment starts (position
     0 need not be flagged — out-of-range acts as a boundary).
 
-    Log-step (Hillis–Steele) like the forward fills in this module:
-    ~log2(n) passes of shift + where, no gathers.  ``identity`` is
-    ``op``'s neutral element (0 for add, dtype max for min, ...).
+    On TPU backends large scans run as ONE Pallas pass
+    (ops/scan_kernels.py: O(n) HBM traffic instead of the log-step's
+    O(n log n)); elsewhere the Hillis–Steele loop below — ~log2(n)
+    passes of shift + where, no gathers.  ``identity`` is ``op``'s
+    neutral element (0 for add, dtype max for min, ...).
     """
+    from sparkrdma_tpu.ops.scan_kernels import (
+        MIN_KERNEL_ELEMS,
+        scan_flagged,
+        use_scan_kernels,
+    )
+
     n = int(vals.shape[0])
+    kind = {jnp.add: "add", jnp.minimum: "min", jnp.maximum: "max"}.get(op)
+    if kind and n >= MIN_KERNEL_ELEMS and use_scan_kernels():
+        _f, (out,) = scan_flagged(kind, heads, (vals,))
+        return out
     x = vals
     f = heads
     ident = jnp.full((1,), identity, vals.dtype)
@@ -49,8 +61,22 @@ def segmented_scan(vals, heads, op, identity):
 def _ff_run_carry(is_last, columns):
     """Log-step forward fill of ``columns`` from run-END positions:
     after the fill, position i holds each column's value at the latest
-    run end AT OR BEFORE i (positions before the first end keep their
-    initial values, flagged False).  Returns (filled_flag, columns)."""
+    run end AT OR BEFORE i (positions before the first end keep
+    UNSPECIFIED values, flagged False — consumers mask by the flag).
+    Returns (filled_flag, columns).  Large TPU fills run as one Pallas
+    pass (ops/scan_kernels.py)."""
+    from sparkrdma_tpu.ops.scan_kernels import (
+        MIN_KERNEL_ELEMS,
+        scan_flagged,
+        use_scan_kernels,
+    )
+
+    if (
+        int(is_last.shape[0]) >= MIN_KERNEL_ELEMS
+        and use_scan_kernels()
+    ):
+        flag, cols = scan_flagged("fill", is_last, tuple(columns))
+        return flag, cols
     flag = is_last
     cols = list(columns)
     n = int(flag.shape[0])

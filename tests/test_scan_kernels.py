@@ -1,0 +1,126 @@
+"""Pallas one-pass scan kernels vs the jnp log-step references.
+
+Runs in interpret mode on the CPU harness; semantics must match the
+exact implementations they replace on TPU (ops/segment.py fills/scans,
+models/join.py probe fill) including the unspecified-before-first-flag
+contract (compared only under the returned flag mask).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkrdma_tpu.ops.scan_kernels import (
+    _BLOCK,
+    scan_flagged,
+)
+from sparkrdma_tpu.ops.segment import _ff_run_carry, segmented_scan
+
+
+def _sizes():
+    # within one block, exact block, crossing blocks, many blocks
+    return [1, 127, 128, 1000, _BLOCK, _BLOCK + 1, 3 * _BLOCK + 4097]
+
+
+@pytest.mark.parametrize("n", _sizes())
+def test_fill_matches_run_carry(n):
+    rng = np.random.default_rng(n)
+    flag = rng.random(n) < 0.01
+    a = rng.integers(0, 1 << 30, n, dtype=np.int32)
+    b = rng.integers(0, 1 << 30, n, dtype=np.int32)
+    want_f, (wa, wb) = _ff_run_carry(
+        jnp.asarray(flag), (jnp.asarray(a), jnp.asarray(b))
+    )
+    got_f, (ga, gb) = scan_flagged(
+        "fill", jnp.asarray(flag), (jnp.asarray(a), jnp.asarray(b)),
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_f), np.asarray(want_f))
+    m = np.asarray(want_f)
+    np.testing.assert_array_equal(np.asarray(ga)[m], np.asarray(wa)[m])
+    np.testing.assert_array_equal(np.asarray(gb)[m], np.asarray(wb)[m])
+
+
+@pytest.mark.parametrize("kind", ["add", "min", "max"])
+@pytest.mark.parametrize("n", [1, 1000, _BLOCK + 1])
+def test_segmented_ops_match(kind, n):
+    rng = np.random.default_rng(hash((kind, n)) % (1 << 31))
+    heads = rng.random(n) < 0.05
+    vals = rng.integers(-1000, 1000, n, dtype=np.int32)
+    op = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}[kind]
+    ident = {
+        "add": np.int32(0),
+        "min": np.iinfo(np.int32).max,
+        "max": np.iinfo(np.int32).min,
+    }[kind]
+    want = segmented_scan(jnp.asarray(vals), jnp.asarray(heads), op, ident)
+    _f, (got,) = scan_flagged(
+        "add" if kind == "add" else kind,
+        jnp.asarray(heads), (jnp.asarray(vals),), interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fill_edge_flags():
+    # all-false flags: output flag all false; all-true: identity fill
+    n = 300
+    a = np.arange(n, dtype=np.int32)
+    f0, (x0,) = scan_flagged(
+        "fill", jnp.zeros(n, bool), (jnp.asarray(a),), interpret=True
+    )
+    assert not np.asarray(f0).any()
+    f1, (x1,) = scan_flagged(
+        "fill", jnp.ones(n, bool), (jnp.asarray(a),), interpret=True
+    )
+    assert np.asarray(f1).all()
+    np.testing.assert_array_equal(np.asarray(x1), a)
+
+
+def test_plain_cumsum_via_add_scan():
+    n = 2 * _BLOCK + 999
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-50, 50, n, dtype=np.int32)
+    _f, (got,) = scan_flagged(
+        "add", jnp.zeros(n, bool), (jnp.asarray(vals),), interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.cumsum(vals))
+
+
+def test_probe_fill_semantics_via_kernel():
+    """The join probe's fill = 'fill' over (key, val) with dim flags;
+    found mask must match the jnp probe on a sorted packed stream."""
+    from sparkrdma_tpu.models.join import (
+        _ROLE_DIM,
+        _ROLE_FACT,
+        _probe_fill,
+    )
+
+    rng = np.random.default_rng(17)
+    n = 5000
+    keys = np.sort(rng.integers(0, 300, n).astype(np.uint32))
+    role = np.full(n, _ROLE_FACT, np.uint32)
+    # one dim row at each key run head, for ~half the keys
+    heads = np.flatnonzero(np.diff(keys, prepend=-1) != 0)
+    dim_at = heads[::2]
+    role[dim_at] = _ROLE_DIM
+    pay = rng.integers(0, 1 << 30, n).astype(np.uint32)
+    want_val, want_found = _probe_fill(
+        jnp.asarray(keys), jnp.asarray(role), jnp.asarray(pay)
+    )
+    flag = jnp.asarray(role == _ROLE_DIM)
+    got_f, (gk, gv) = scan_flagged(
+        "fill", flag, (jnp.asarray(keys), jnp.asarray(pay)),
+        interpret=True,
+    )
+    got_found = (
+        jnp.asarray(role == _ROLE_FACT) & got_f
+        & (gk == jnp.asarray(keys))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_found), np.asarray(want_found)
+    )
+    m = np.asarray(want_found)
+    np.testing.assert_array_equal(
+        np.asarray(gv)[m], np.asarray(want_val)[m]
+    )
