@@ -109,8 +109,10 @@ def make_broadcast_join_aggregate_step(
         # group-run boundaries on the group key alone
         is_last = jnp.concatenate([sgk[1:] != sgk[:-1], jnp.ones(1, bool)])
         heads = jnp.concatenate([jnp.ones(1, bool), sgk[1:] != sgk[:-1]])
-        csum_v = jnp.cumsum(vz)
-        csum_m = jnp.cumsum(mi)
+        from sparkrdma_tpu.ops.scan_kernels import cumsum_1d
+
+        csum_v = cumsum_1d(vz)
+        csum_m = cumsum_1d(mi)
         flag, (fv, fm) = _ff_run_carry(is_last, (csum_v, csum_m))
         prev_v, prev_m = _prev_end(flag, (fv, fm))
         counts = jnp.where(is_last, csum_m - prev_m, 0).astype(jnp.int32)

@@ -190,6 +190,19 @@ def _scan_padded(kind, dtypes, n_pad, interpret, flag_i32, *cols):
     return out_flag, out_cols
 
 
+def cumsum_1d(vals: jax.Array) -> jax.Array:
+    """``jnp.cumsum`` through the one-pass add kernel on TPU backends
+    (XLA lowers cumulative ops to logarithmic passes too); jnp
+    elsewhere or below the size threshold."""
+    n = int(vals.shape[0])
+    if n >= MIN_KERNEL_ELEMS and use_scan_kernels():
+        _f, (out,) = scan_flagged(
+            "add", jnp.zeros(n, bool), (vals,)
+        )
+        return out
+    return jnp.cumsum(vals)
+
+
 def scan_flagged(
     kind: str,
     flag: jax.Array,

@@ -136,8 +136,10 @@ def reduce_by_key_local(
             (keys, jnp.int32(1) - m, vals), num_keys=2, is_stable=False
         )
         ms = jnp.int32(1) - ms
-    csum_v = jnp.cumsum(vs)
-    csum_m = jnp.cumsum(ms)
+    from sparkrdma_tpu.ops.scan_kernels import cumsum_1d
+
+    csum_v = cumsum_1d(vs)
+    csum_m = cumsum_1d(ms)
     is_last = jnp.concatenate([ks[1:] != ks[:-1], jnp.ones(1, bool)])
     flag, (fv, fm) = _ff_run_carry(is_last, (csum_v, csum_m))
     prev_v, prev_m = _prev_end(flag, (fv, fm))
@@ -190,8 +192,10 @@ def aggregate_by_key_local(
         )
         ms = jnp.int32(1) - inv_s
         bound = (ks[1:] != ks[:-1]) | (inv_s[1:] != inv_s[:-1])
-    csum_v = jnp.cumsum(vs)
-    csum_m = jnp.cumsum(ms)
+    from sparkrdma_tpu.ops.scan_kernels import cumsum_1d
+
+    csum_v = cumsum_1d(vs)
+    csum_m = cumsum_1d(ms)
     is_last = jnp.concatenate([bound, jnp.ones(1, bool)])
     # the slot after a run's end opens the NEXT run = its min
     vs_next = jnp.concatenate([vs[1:], jnp.zeros(1, vs.dtype)])

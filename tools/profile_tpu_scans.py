@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""On-chip validation + micro-bench for the Pallas scan kernels.
+
+Run FIRST when the real chip is reachable after touching
+ops/scan_kernels.py: compiled-mode correctness vs the jnp log-step
+references, then kernel-vs-jnp timing for fill / segmented max /
+cumsum at bench sizes.  `python tools/profile_tpu_scans.py [log2]`.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import fence
+
+
+def bench(name, fn, *args, iters=10):
+    out = fn(*args)
+    fence(jax.tree.leaves(out)[-1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fence(jax.tree.leaves(out)[-1])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt * 1e3:9.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    from sparkrdma_tpu.ops import scan_kernels as sk
+    from sparkrdma_tpu.ops import segment as seg
+
+    log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 22
+    n = 1 << log2
+    rng = np.random.default_rng(5)
+    flag_h = rng.random(n) < 0.01
+    a_h = rng.integers(-(1 << 30), 1 << 30, n, dtype=np.int32)
+    b_h = rng.integers(-(1 << 30), 1 << 30, n, dtype=np.int32)
+    flag = jnp.asarray(flag_h)
+    a = jnp.asarray(a_h)
+    b = jnp.asarray(b_h)
+
+    assert sk.use_scan_kernels(), (
+        "scan kernels disabled on this backend — nothing to validate"
+    )
+
+    # -- compiled-mode correctness (kernel vs jnp references) ---------------
+    os.environ["SPARKRDMA_TPU_DISABLE_SCAN_KERNELS"] = "1"
+    want_f, (wa, wb) = seg._ff_run_carry(flag, (a, b))
+    want_max = seg.segmented_scan(
+        a, flag, jnp.maximum, np.iinfo(np.int32).min
+    )
+    want_cs = jnp.cumsum(a)
+    wf_h, wa_h, wb_h = (
+        np.asarray(want_f), np.asarray(wa), np.asarray(wb)
+    )
+    wmax_h, wcs_h = np.asarray(want_max), np.asarray(want_cs)
+    del os.environ["SPARKRDMA_TPU_DISABLE_SCAN_KERNELS"]
+
+    got_f, (ga, gb) = sk.scan_flagged("fill", flag, (a, b))
+    gf_h = np.asarray(got_f)
+    np.testing.assert_array_equal(gf_h, wf_h)
+    np.testing.assert_array_equal(np.asarray(ga)[wf_h], wa_h[wf_h])
+    np.testing.assert_array_equal(np.asarray(gb)[wf_h], wb_h[wf_h])
+    print("fill kernel: compiled-mode correctness OK", flush=True)
+
+    _f, (gmax,) = sk.scan_flagged("max", flag, (a,))
+    np.testing.assert_array_equal(np.asarray(gmax), wmax_h)
+    _f, (gcs,) = sk.scan_flagged("add", jnp.zeros(n, bool), (a,))
+    np.testing.assert_array_equal(np.asarray(gcs), wcs_h)
+    print("max/add kernels: compiled-mode correctness OK", flush=True)
+
+    # -- timing: kernel vs jnp log-step -------------------------------------
+    jfill = jax.jit(
+        lambda f, x, y: _jnp_fill_body(f, (x, y))
+    )
+    kfill = jax.jit(lambda f, x, y: sk.scan_flagged("fill", f, (x, y)))
+    jcs = jax.jit(jnp.cumsum)
+    kcs = jax.jit(lambda x: sk.cumsum_1d(x))
+
+    bench("fill jnp log-step (2 cols)", jfill, flag, a, b)
+    bench("fill pallas one-pass (2 cols)", kfill, flag, a, b)
+    bench("cumsum jnp", jcs, a)
+    bench("cumsum pallas", kcs, a)
+
+    from sparkrdma_tpu.models.join import _probe_fill  # noqa: F401
+    print("done", flush=True)
+
+
+def _jnp_fill_body(flag, cols):
+    """The raw log-step loop, inlined so the jit traces the jnp path
+    regardless of the dispatch gate."""
+    cols = list(cols)
+    f = flag
+    n = int(f.shape[0])
+    s = 1
+    while s < n:
+        pf = jnp.concatenate([f[:s], f[:-s]])
+        prev = [jnp.concatenate([c[:s], c[:-s]]) for c in cols]
+        need = ~f
+        cols = [jnp.where(need, p, c) for p, c in zip(prev, cols)]
+        f = f | pf
+        s <<= 1
+    return f, cols
+
+
+if __name__ == "__main__":
+    main()
