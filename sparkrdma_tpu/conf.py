@@ -48,9 +48,27 @@ class TpuShuffleConf:
     """
 
     PREFIX = "spark.shuffle.tpu."
+    LEGACY_PREFIX = "spark.shuffle.rdma."
+    # reference knobs (RdmaShuffleConf.scala:34-126) accepted verbatim
+    # under the legacy namespace; names that map onto a different TPU
+    # analog are translated, the rest alias one-to-one.  An explicit
+    # spark.shuffle.tpu.* key always wins over its legacy alias.
+    LEGACY_RENAMES = {
+        "useOdp": "lazyStaging",          # on-demand registration analog
+        "cpuList": "deviceList",          # affinity → mesh device list
+    }
 
     def __init__(self, conf: Optional[Mapping[str, object]] = None):
         self._conf: Dict[str, object] = dict(conf or {})
+        # legacy namespace support: a reference user's existing
+        # spark.shuffle.rdma.* settings apply unchanged
+        for key, value in list(self._conf.items()):
+            if not key.startswith(self.LEGACY_PREFIX):
+                continue
+            short = key[len(self.LEGACY_PREFIX):]
+            mapped = self.LEGACY_RENAMES.get(short, short)
+            new_key = self.PREFIX + mapped
+            self._conf.setdefault(new_key, value)
 
     # -- raw access ---------------------------------------------------------
     def get(self, short_key: str, default=None):

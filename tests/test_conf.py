@@ -97,3 +97,25 @@ def test_manager_compress_conf_picks_codec():
 
     conf = TpuShuffleConf({"spark.shuffle.tpu.compress": "true"})
     assert conf.compress and conf.compress_codec == "zlib"
+
+
+def test_legacy_rdma_namespace_aliases():
+    """A reference user's spark.shuffle.rdma.* settings apply unchanged
+    (RdmaShuffleConf.scala:34-126); explicit tpu keys win; useOdp maps
+    to its on-demand-staging analog."""
+    from sparkrdma_tpu.conf import TpuShuffleConf
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.rdma.shuffleReadBlockSize": "512k",
+        "spark.shuffle.rdma.maxBytesInFlight": "2m",
+        "spark.shuffle.rdma.useOdp": "true",
+        "spark.shuffle.rdma.driverPort": 31999,
+        # explicit tpu key beats its legacy alias
+        "spark.shuffle.rdma.maxAggBlock": "1m",
+        "spark.shuffle.tpu.maxAggBlock": "4m",
+    })
+    assert conf.shuffle_read_block_size == 512 << 10
+    assert conf.max_bytes_in_flight == 2 << 20
+    assert conf.lazy_staging is True
+    assert conf.driver_port == 31999
+    assert conf.max_agg_block == 4 << 20
