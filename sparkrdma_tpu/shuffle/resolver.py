@@ -242,9 +242,24 @@ class ShuffleBlockResolver:
                 m = len(chunk)
                 buf[off : off + m] = np.frombuffer(chunk, np.uint8)
                 off += m
+        arena_full = False
+        if use_arena:
+            try:
+                span = self.device_arena.alloc(max(total, 1))
+            except MemoryError:
+                # arena budget exhausted: commit host-resident instead
+                # of failing the write — the read path falls back to
+                # host serving for this segment (the larger-than-HBM
+                # shuffle contract; lazy staging may promote it later
+                # if space frees up)
+                logger.warning(
+                    "device arena full: committing shuffle=%d map=%d "
+                    "(%dB) host-resident", shuffle_id, map_id, total,
+                )
+                use_arena = False
+                arena_full = True
         try:
             if use_arena:
-                span = self.device_arena.alloc(max(total, 1))
                 try:
                     self.device_arena.write(span, buf[: max(total, 1)])
                     seg = self.arena.register_arena_span(
@@ -257,11 +272,13 @@ class ShuffleBlockResolver:
                     staging_buf.free()
                     staging_buf = None
             else:
-                if self.stage_to_device:
+                if self.stage_to_device and not arena_full:
                     import jax.numpy as jnp
 
                     array = jnp.asarray(buf[: max(total, 1)])
                 else:
+                    # arena-full commits stay on the HOST (an unbudgeted
+                    # device_put would defeat the arena's HBM budget)
                     array = np.asarray(buf[: max(total, 1)])
                 # PJRT may zero-copy alias page-aligned host buffers: the
                 # staging buffer must live until the segment is released,
@@ -308,8 +325,16 @@ class ShuffleBlockResolver:
                 sd, shuffle_id, map_id,
                 [buf[off : off + n] for off, n in ranges], total,
             )
+        span = None
         if self.stage_to_device and self.device_arena is not None:
-            span = self.device_arena.alloc(max(total, 1))
+            try:
+                span = self.device_arena.alloc(max(total, 1))
+            except MemoryError:
+                logger.warning(
+                    "device arena full: committing shuffle=%d map=%d "
+                    "(%dB) host-resident", shuffle_id, map_id, total,
+                )
+        if span is not None:
             try:
                 self.device_arena.write(span, buf)
                 seg = self.arena.register_arena_span(
@@ -319,12 +344,15 @@ class ShuffleBlockResolver:
                 span.free()
                 raise
         else:
-            if self.stage_to_device:
+            if self.stage_to_device and self.device_arena is None:
                 import jax.numpy as jnp
 
                 array = jnp.asarray(buf if total else buf[:1])
                 zero_copy = False
             else:
+                # host plane, or arena-full fallback (an unbudgeted
+                # device_put would defeat the arena's HBM budget): the
+                # writer hands buf over, so views may serve zero-copy
                 array = buf if total else np.zeros(1, np.uint8)
                 zero_copy = True
             seg = self.arena.register(

@@ -226,3 +226,31 @@ def test_coordinator_stop_fails_pending(devices):
             SimpleNamespace(device_index=0), SimpleNamespace(device_index=1),
             [], FnCompletionListener(), lambda locs: [],
         )
+
+
+def test_shuffle_larger_than_arena_completes(devices):
+    """Shuffle bigger than the HBM arena budget: segments that don't
+    fit stay host-resident and fall back to the host read path, the
+    rest ride the collective plane — results exact either way (the
+    larger-than-HBM shuffle contract, SURVEY §5 long-context note)."""
+    # conf clamps the arena to >=1 MiB; ~6 MiB of payload across 4
+    # executors (~1.5 MiB committed each) must overflow it
+    conf = _collective_conf(deviceArenaBytes=1 << 20)
+    data = [(i % 23, bytes(1000) + i.to_bytes(4, "big"))
+            for i in range(6000)]
+    with TpuShuffleContext(
+        num_executors=4, conf=conf, base_port=45500
+    ) as ctx:
+        out = (
+            ctx.parallelize(data, num_slices=8)
+            .group_by_key(num_partitions=8)
+            .collect()
+        )
+        got = {k: sorted(vs) for k, vs in out}
+        stats = ctx.network.coordinator.stats()
+    expect = {}
+    for k, v in data:
+        expect.setdefault(k, []).append(v)
+    assert got == {k: sorted(vs) for k, vs in expect.items()}
+    # the tiny arena forced at least part of the traffic off-plane
+    assert stats["fallback_blocks"] > 0
