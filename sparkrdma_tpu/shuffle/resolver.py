@@ -111,6 +111,21 @@ class ShuffleBlockResolver:
             return _ROW_BYTES
         return 1
 
+    def _alloc_span_or_none(self, total: int, shuffle_id: int,
+                            map_id: int):
+        """Arena span for a commit, or None when the budget is
+        exhausted — the commit then degrades to a host-resident
+        segment instead of failing the write (the larger-than-HBM
+        shuffle contract; lazy staging may promote it later)."""
+        try:
+            return self.device_arena.alloc(max(total, 1))
+        except MemoryError:
+            logger.warning(
+                "device arena full: committing shuffle=%d map=%d "
+                "(%dB) host-resident", shuffle_id, map_id, total,
+            )
+            return None
+
     # -- lazy staging (the ODP page-fault path) ------------------------------
     def ensure_staged(self, mkey: int):
         """Stage a host-committed segment into the device arena on
@@ -242,22 +257,19 @@ class ShuffleBlockResolver:
                 m = len(chunk)
                 buf[off : off + m] = np.frombuffer(chunk, np.uint8)
                 off += m
-        arena_full = False
-        if use_arena:
-            try:
-                span = self.device_arena.alloc(max(total, 1))
-            except MemoryError:
-                # arena budget exhausted: commit host-resident instead
-                # of failing the write — the read path falls back to
-                # host serving for this segment (the larger-than-HBM
-                # shuffle contract; lazy staging may promote it later
-                # if space frees up)
-                logger.warning(
-                    "device arena full: committing shuffle=%d map=%d "
-                    "(%dB) host-resident", shuffle_id, map_id, total,
-                )
-                use_arena = False
-                arena_full = True
+        span = (
+            self._alloc_span_or_none(total, shuffle_id, map_id)
+            if use_arena else None
+        )
+        arena_full = use_arena and span is None
+        use_arena = span is not None
+        if arena_full and staging_buf is not None:
+            # nothing zero-copy aliases a host fallback segment, so
+            # copy once and release the pooled buffer now instead of
+            # pinning it for the shuffle's lifetime
+            buf = buf[: max(total, 1)].copy()
+            staging_buf.free()
+            staging_buf = None
         try:
             if use_arena:
                 try:
@@ -325,15 +337,11 @@ class ShuffleBlockResolver:
                 sd, shuffle_id, map_id,
                 [buf[off : off + n] for off, n in ranges], total,
             )
-        span = None
-        if self.stage_to_device and self.device_arena is not None:
-            try:
-                span = self.device_arena.alloc(max(total, 1))
-            except MemoryError:
-                logger.warning(
-                    "device arena full: committing shuffle=%d map=%d "
-                    "(%dB) host-resident", shuffle_id, map_id, total,
-                )
+        span = (
+            self._alloc_span_or_none(total, shuffle_id, map_id)
+            if self.stage_to_device and self.device_arena is not None
+            else None
+        )
         if span is not None:
             try:
                 self.device_arena.write(span, buf)
