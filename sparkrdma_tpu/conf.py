@@ -268,10 +268,6 @@ class TpuShuffleConf:
         return self._int_in_range("exchangeMaxRoundsInFlight", 2, 1, 64)
 
     @property
-    def exchange_dtype(self) -> str:
-        return str(self.get("exchangeDtype", "uint8"))
-
-    @property
     def verify_exchange_integrity(self) -> bool:
         """Opt-in end-to-end CRC of every (src, dst) exchanged stream
         (ExchangeIntegrityError on mismatch).  Costs O(payload) host
