@@ -65,6 +65,10 @@ from sparkrdma_tpu.utils.types import (
 
 logger = logging.getLogger(__name__)
 
+# sentinel: the exchange-plan barrier is not failed, just not ready
+# (e.g. a publisher's hello has not landed yet) — keep waiters queued
+_PLAN_WAIT = object()
+
 
 @dataclass
 class Aggregator:
@@ -264,6 +268,7 @@ class TpuShuffleManager:
             file_backed_threshold=conf.file_backed_commit_bytes,
             spill_dir=conf.spill_dir,
             lazy_staging=conf.lazy_staging,
+            write_block_size=conf.shuffle_write_block_size,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)
@@ -484,6 +489,13 @@ class TpuShuffleManager:
                 self._send_msg(ch, announce)
             except Exception:
                 logger.exception("driver: announce to %s failed", peer.host)
+        # a bulk-plan barrier may be waiting on exactly this hello (a
+        # publish can land before its publisher's hello — separate
+        # channels): re-trigger pending barriers
+        with self._plan_lock:
+            pending = list(self._plan_waiters.keys())
+        for sid in pending:
+            self._maybe_answer_plans(sid)
 
     def _handle_announce(self, msg: AnnounceShuffleManagersMsg) -> None:
         with self._executors_lock:
@@ -691,6 +703,15 @@ class TpuShuffleManager:
             if not waiters:
                 return
             plan = self._get_or_build_plan(shuffle_id, num_maps)
+            if plan is _PLAN_WAIT:
+                # a publisher's hello hasn't landed yet (publish and
+                # hello race on separate channels): keep the waiters —
+                # _handle_hello re-triggers this barrier
+                with self._plan_lock:
+                    self._plan_waiters.setdefault(
+                        shuffle_id, []
+                    ).extend(waiters)
+                return
             for msg, channel in waiters:
                 if isinstance(plan, str):
                     reply: RpcMsg = FetchMapStatusFailedMsg(
@@ -766,6 +787,12 @@ class TpuShuffleManager:
         for host, by_map in snapshot.items():
             s = idx.get(host)
             if s is None:
+                with self._executors_lock:
+                    tombstoned = host in self._removed
+                if not tombstoned:
+                    # published before its hello landed (separate
+                    # channels): not an error — wait for the hello
+                    return _PLAN_WAIT
                 return (
                     f"publisher {host.host}:{host.port} is not a "
                     f"registered executor (bulk mode needs stable "

@@ -72,7 +72,8 @@ class ShuffleBlockResolver:
                  stage_to_device: bool = True, staging_pool=None,
                  file_backed_threshold: int = 0,
                  spill_dir: Optional[str] = None,
-                 lazy_staging: bool = False):
+                 lazy_staging: bool = False,
+                 write_block_size: int = 8 << 20):
         self.arena = arena
         self.node = node
         self.stage_to_device = stage_to_device
@@ -95,6 +96,12 @@ class ShuffleBlockResolver:
         # ``prefer_file_backed`` (its data is already on disk)
         self.file_backed_threshold = file_backed_threshold
         self.spill_dir = spill_dir
+        # arena-path commits split into segments of at most this many
+        # bytes (the reference's chunked mmap+MR registration,
+        # RdmaMappedFile.java:95-171): bounded span sizes keep a
+        # fragmented arena allocatable and large map outputs from
+        # needing one contiguous extent
+        self.write_block_size = max(int(write_block_size), 1)
         self._shuffles: Dict[int, _ShuffleData] = {}
         self._lock = threading.Lock()
 
@@ -157,9 +164,9 @@ class ShuffleBlockResolver:
                 with self._lock:
                     sd = self._shuffles.get(new_seg.shuffle_id)
                     if sd is not None:
-                        for mid, (mto, s) in sd.outputs.items():
-                            if s.mkey == mkey:
-                                sd.outputs[mid] = (mto, new_seg)
+                        for _mid, (_mto, segs) in sd.outputs.items():
+                            if mkey in segs:
+                                segs[mkey] = new_seg
                                 break
             return new_seg
 
@@ -173,7 +180,8 @@ class ShuffleBlockResolver:
         with self._lock:
             sd = self._shuffles.get(shuffle_id)
             mkeys = (
-                [seg.mkey for _, seg in sd.outputs.values()] if sd else []
+                [mk for _, segs in sd.outputs.values() for mk in segs]
+                if sd else []
             )
         staged = 0
         for mkey in mkeys:
@@ -221,19 +229,72 @@ class ShuffleBlockResolver:
         use_arena = self.stage_to_device and self.device_arena is not None
         # collective plane: partition starts row-aligned for the gather
         align = self.commit_align
-        offsets: List[Tuple[int, int]] = []
+        sizes = [_payload_len(b) for b in partition_bytes]
         total = 0
-        for b in partition_bytes:
-            total = (total + align - 1) // align * align
-            n = _payload_len(b)
-            offsets.append((total, n))
-            total += n
+        for n in sizes:
+            total = (total + align - 1) // align * align + n
         if prefer_file_backed or (
             self.file_backed_threshold and total >= self.file_backed_threshold
         ):
             return self._commit_file_backed(
                 sd, shuffle_id, map_id, partition_bytes, total
             )
+        # arena commits split into write-block-sized segments (chunked
+        # registration, RdmaMappedFile.java:95-171): greedy groups of
+        # whole partitions, a partition larger than the block gets its
+        # own segment.  Host/jnp commits keep one segment.
+        if use_arena and total > self.write_block_size:
+            groups: List[List[int]] = [[]]
+            gsize = 0
+            for pid, n in enumerate(sizes):
+                an = (gsize + align - 1) // align * align + n - gsize
+                if groups[-1] and gsize + an > self.write_block_size:
+                    groups.append([pid])
+                    gsize = n
+                else:
+                    groups[-1].append(pid)
+                    gsize += an
+        else:
+            groups = [list(range(num_partitions))]
+        mto = MapTaskOutput(num_partitions)
+        segs: Dict[int, DeviceSegment] = {}
+        try:
+            for pids in groups:
+                g_bytes = [partition_bytes[p] for p in pids]
+                g_offsets: List[Tuple[int, int]] = []
+                g_total = 0
+                for p in pids:
+                    g_total = (g_total + align - 1) // align * align
+                    g_offsets.append((g_total, sizes[p]))
+                    g_total += sizes[p]
+                seg = self._commit_partitions_segment(
+                    shuffle_id, map_id, g_bytes, g_offsets, g_total,
+                    use_arena,
+                )
+                segs[seg.mkey] = seg
+                for p, (o, n) in zip(pids, g_offsets):
+                    mto.put(
+                        p,
+                        BlockLocation.EMPTY if n == 0
+                        else BlockLocation(o, n, seg.mkey),
+                    )
+        except BaseException:
+            for seg in segs.values():
+                if self.node is not None:
+                    self.node.unregister_block_store(seg.mkey)
+                self.arena.release(seg.mkey)
+            raise
+        # install, releasing any superseded segments from a task retry
+        self._install(sd, map_id, mto, segs)
+        return mto
+
+    def _commit_partitions_segment(
+        self, shuffle_id: int, map_id: int, partition_bytes: Sequence,
+        offsets: List[Tuple[int, int]], total: int, use_arena: bool,
+    ) -> DeviceSegment:
+        """Assemble one group of partitions into a buffer and register
+        it (arena span, device array, or host bytes — with arena-full
+        and pool-exhausted fallbacks)."""
         staging_buf = None
         if self.stage_to_device and self.staging_pool is not None and total > 0:
             # serialize through the pooled, page-aligned native buffer —
@@ -311,15 +372,7 @@ class ShuffleBlockResolver:
             raise
         if self.node is not None:
             self.node.register_block_store(seg.mkey, self.arena)
-        mto = MapTaskOutput(num_partitions)
-        for pid, (o, n) in enumerate(offsets):
-            if n == 0:
-                mto.put(pid, BlockLocation.EMPTY)
-            else:
-                mto.put(pid, BlockLocation(o, n, seg.mkey))
-        # install, releasing any superseded segment from a task retry
-        self._install(sd, map_id, mto, seg)
-        return mto
+        return seg
 
     def commit_assembled(
         self, shuffle_id: int, map_id: int, buf: np.ndarray,
@@ -418,17 +471,20 @@ class ShuffleBlockResolver:
         return mto
 
     def _install(self, sd: "_ShuffleData", map_id: int,
-                 mto: MapTaskOutput, seg: DeviceSegment) -> None:
-        """Publish (mto, seg) as map_id's output, releasing any
-        superseded segment from a task retry/speculation."""
+                 mto: MapTaskOutput, segs) -> None:
+        """Publish (mto, {mkey: segment}) as map_id's output, releasing
+        any superseded segments from a task retry/speculation.  A
+        single segment may be passed bare."""
+        if not isinstance(segs, dict):
+            segs = {segs.mkey: segs}
         with self._lock:
             prior = sd.outputs.get(map_id)
-            sd.outputs[map_id] = (mto, seg)
+            sd.outputs[map_id] = (mto, segs)
         if prior is not None:
-            _, old_seg = prior
-            if self.node is not None:
-                self.node.unregister_block_store(old_seg.mkey)
-            self.arena.release(old_seg.mkey)
+            for old_seg in prior[1].values():
+                if self.node is not None:
+                    self.node.unregister_block_store(old_seg.mkey)
+                self.arena.release(old_seg.mkey)
 
     # -- read side (local short-circuit) ------------------------------------
     def get_local_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
@@ -439,11 +495,11 @@ class ShuffleBlockResolver:
             raise KeyError(
                 f"no committed output for shuffle={shuffle_id} map={map_id}"
             )
-        mto, seg = entry
+        mto, segs = entry
         loc = mto.get_location(reduce_id)
         if loc.is_empty:
             return b""
-        return seg.read(loc.address, loc.length)
+        return segs[loc.mkey].read(loc.address, loc.length)
 
     def get_local_blocks(
         self, shuffle_id: int, map_id: int, reduce_ids
@@ -460,15 +516,22 @@ class ShuffleBlockResolver:
             raise KeyError(
                 f"no committed output for shuffle={shuffle_id} map={map_id}"
             )
-        mto, seg = entry
+        mto, segs = entry
         locs = [mto.get_location(r) for r in reduce_ids]
-        spans = [
-            (loc.address, loc.length) for loc in locs if not loc.is_empty
-        ]
-        blocks = iter(seg.read_many(spans))
-        return [
-            b"" if loc.is_empty else next(blocks) for loc in locs
-        ]
+        # one batched read per backing segment (multi-segment map
+        # outputs exist under write_block_size splitting)
+        by_seg: Dict[int, List[Tuple[int, int]]] = {}
+        for i, loc in enumerate(locs):
+            if not loc.is_empty:
+                by_seg.setdefault(loc.mkey, []).append(
+                    (i, loc.address, loc.length)
+                )
+        out: List[bytes] = [b""] * len(locs)
+        for mkey, items in by_seg.items():
+            blocks = segs[mkey].read_many([(a, ln) for _i, a, ln in items])
+            for (i, _a, _ln), blk in zip(items, blocks):
+                out[i] = blk
+        return out
 
     def num_partitions(self, shuffle_id: int) -> int:
         with self._lock:
@@ -496,9 +559,10 @@ class ShuffleBlockResolver:
         with self._lock:
             sd = self._shuffles.pop(shuffle_id, None)
         if sd is not None:
-            for mto, seg in sd.outputs.values():
-                if self.node is not None:
-                    self.node.unregister_block_store(seg.mkey)
+            for _mto, segs in sd.outputs.values():
+                for seg in segs.values():
+                    if self.node is not None:
+                        self.node.unregister_block_store(seg.mkey)
             self.arena.release_shuffle(shuffle_id)
 
     def stop(self) -> None:

@@ -339,3 +339,50 @@ def test_bulk_matches_pull_fuzz(cluster):
             )
             pull.extend(reader.read())
         assert bulk == sorted(pull), (trial, sid)
+
+
+def test_publish_before_hello_waits_for_membership(devices):
+    """A map output can publish before its executor's hello lands
+    (separate channels): the plan barrier must WAIT for the hello
+    instead of failing the stage (flaky dryrun race)."""
+    import numpy as np
+
+    from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
+    from sparkrdma_tpu.shuffle.manager import _PLAN_WAIT
+    from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+    from sparkrdma_tpu.utils.types import (
+        BlockLocation,
+        BlockManagerId,
+        ShuffleManagerId,
+    )
+
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({"spark.shuffle.tpu.driverPort": 39750})
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    try:
+        ghost = ShuffleManagerId("127.0.0.1", 49777,
+                                 BlockManagerId("g", "127.0.0.1", 49777))
+        driver._shuffle_num_maps[90] = 1
+        driver._shuffle_partitions[90] = 2
+        with driver._plan_lock:
+            driver._shuffle_epoch[90] = driver._membership_epoch
+        mto = MapTaskOutput(2)
+        mto.put(0, BlockLocation(0, 8, 1))
+        mto.put(1, BlockLocation(8, 8, 1))
+        msg = PublishMapTaskOutputMsg(
+            ghost, shuffle_id=90, map_id=0, total_num_partitions=2,
+            first_reduce_id=0, last_reduce_id=1,
+            entries=mto.get_range_bytes(0, 1),
+        )
+        driver._handle_publish(msg)  # publish BEFORE any hello
+        plan = driver._get_or_build_plan(90, 1)
+        assert plan is _PLAN_WAIT, plan
+        # hello lands → the same barrier now builds a real plan
+        with driver._executors_lock:
+            driver._executors.append(ghost)
+        plan2 = driver._get_or_build_plan(90, 1)
+        assert not isinstance(plan2, str) and plan2 is not _PLAN_WAIT
+        hosts, flat, manifest, idx = plan2
+        assert ghost in idx and np.asarray(flat).sum() == 16
+    finally:
+        driver.stop()

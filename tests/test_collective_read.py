@@ -254,3 +254,41 @@ def test_shuffle_larger_than_arena_completes(devices):
     assert got == {k: sorted(vs) for k, vs in expect.items()}
     # the tiny arena forced at least part of the traffic off-plane
     assert stats["fallback_blocks"] > 0
+
+
+def test_write_block_size_splits_commits(devices):
+    """shuffleWriteBlockSize bounds arena span sizes: one map output
+    splits across several registered segments (the reference's chunked
+    mmap+MR registration, RdmaMappedFile.java:95-171) and every block
+    reads back exactly, single and batched."""
+    from sparkrdma_tpu.memory.arena import ArenaManager
+    from sparkrdma_tpu.shuffle.resolver import ShuffleBlockResolver
+
+    arena = ArenaManager()
+    res = ShuffleBlockResolver(
+        arena, node=None, stage_to_device=True,
+        write_block_size=64 << 10,
+    )
+    rng = np.random.default_rng(3)
+    parts = [rng.integers(0, 256, 9000, dtype=np.uint8).tobytes()
+             for _ in range(32)]  # ~288 KiB total, 64 KiB blocks
+    res.device_arena = None  # host/jnp path: no arena attached
+    # arena path: attach a device arena so splitting engages
+    from sparkrdma_tpu.memory.device_arena import DeviceArena
+
+    res.device_arena = DeviceArena(8 << 20, devices[0])
+    mto = res.commit_map_output(7, 0, parts)
+    _mto, segs = res._shuffles[7].outputs[0]
+    assert len(segs) > 1, "expected a multi-segment commit"
+    mkeys = {mto.get_location(p).mkey for p in range(32)}
+    assert mkeys == set(segs), "locations must cover every segment"
+    for p in range(32):
+        assert res.get_local_block(7, 0, p) == parts[p]
+    got = res.get_local_blocks(7, 0, range(32))
+    assert [bytes(b) for b in got] == parts
+    # retry/speculation replaces ALL prior segments
+    mto2 = res.commit_map_output(7, 0, parts)
+    _mto2, segs2 = res._shuffles[7].outputs[0]
+    assert set(segs2).isdisjoint(set(segs))
+    res.remove_shuffle(7)
+    assert res.device_arena.allocated_bytes == 0
