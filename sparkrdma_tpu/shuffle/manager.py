@@ -229,6 +229,9 @@ class TpuShuffleManager:
 
         if is_driver:
             port = port or conf.driver_port or 37000
+        else:
+            # reference: spark.shuffle.rdma.executorPort (+ retries)
+            port = port or conf.executor_port
         self.node = self._bind_node(host, port)
         self.node.set_receive_listener(self._receive)
         if is_driver:

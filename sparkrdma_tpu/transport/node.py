@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
@@ -227,8 +227,23 @@ class Node:
             self._passive.clear()
         channels = actives + passives
         if channels:
-            with ThreadPoolExecutor(max_workers=min(8, len(channels))) as pool:
-                list(pool.map(lambda c: c.stop(), channels))
+            # bounded parallel teardown (reference: stop() waits a
+            # teardownListenTimeout window, RdmaNode.java:367-394): a
+            # hung channel must not wedge shutdown forever
+            budget = max(
+                self.conf.teardown_listen_timeout_ms / 1000.0,
+                0.05,
+            ) * max(1, len(channels))
+            pool = ThreadPoolExecutor(max_workers=min(8, len(channels)))
+            futures = [pool.submit(c.stop) for c in channels]
+            done, not_done = wait(futures, timeout=budget)
+            if not_done:
+                logger.warning(
+                    "node %s teardown: %d channel(s) still stopping "
+                    "after %.1fs — abandoning", self.address,
+                    len(not_done), budget,
+                )
+            pool.shutdown(wait=not not_done)
         self._dispatcher.shutdown(wait=True)
         with self._bulk_lock:
             bulk, self._bulk_pool = self._bulk_pool, None
