@@ -261,3 +261,33 @@ def test_trace_spans_collected():
     with t2.span("nope"):
         pass
     assert t2.events == []
+
+
+def test_node_teardown_bounded_by_hung_channel(devices):
+    """A channel whose stop() hangs must not wedge node teardown
+    (teardownListenTimeout bounds the parallel-stop wait,
+    reference RdmaNode.java:367-394)."""
+    import threading
+    import time as _time
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.transport.node import Node
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.teardownListenTimeout": "100ms"})
+    node = Node(("127.0.0.1", 45990), conf)
+
+    class HungChannel:
+        def __init__(self):
+            self.ev = threading.Event()
+
+        def stop(self):
+            self.ev.wait(30)  # would block teardown for 30s
+
+    hung = HungChannel()
+    with node._passive_lock:
+        node._passive.append(hung)
+    t0 = _time.monotonic()
+    node.stop()
+    took = _time.monotonic() - t0
+    hung.ev.set()  # release the worker thread
+    assert took < 5, f"teardown blocked {took:.1f}s on a hung channel"
