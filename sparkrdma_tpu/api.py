@@ -461,6 +461,55 @@ class Dataset:
         ds = Dataset(self.ctx, parts)
         return ds._shuffled(RangePartitioner(n, sample), key_ordering=True)
 
+    def repartition_and_sort_within_partitions(
+        self, partitioner=None,
+        num_partitions: Optional[int] = None,
+    ) -> "Dataset":
+        """Spark's repartitionAndSortWithinPartitions: one shuffle that
+        both routes rows by the partitioner AND leaves every output
+        partition key-sorted (the columnar writer commits key-sorted
+        blocks, so readers merge views — no extra sort pass)."""
+        n = num_partitions or self.num_partitions
+        part = partitioner or HashPartitioner(n)
+        return self._shuffled(part, key_ordering=True)
+
+    def map_values(self, f: Callable[[Any], Any]) -> "Dataset":
+        return self.map(lambda kv: (kv[0], f(kv[1])))
+
+    def keys(self) -> "Dataset":
+        return self.map(lambda kv: kv[0])
+
+    def values(self) -> "Dataset":
+        return self.map(lambda kv: kv[1])
+
+    def union(self, other: "Dataset") -> "Dataset":
+        """Narrow union: partitions of both datasets side by side."""
+        return Dataset(
+            self.ctx, self._materialize() + other._materialize()
+        )
+
+    def take(self, n: int) -> List[Any]:
+        out: List[Any] = []
+        for part in self._materialize():
+            for rec in part:  # ColumnBatch iterates (key, val) records
+                out.append(rec)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def first(self) -> Any:
+        got = self.take(1)
+        if not got:
+            raise ValueError("first() on an empty dataset")
+        return got[0]
+
+    def sample(self, fraction: float, seed: int = 0) -> "Dataset":
+        """Bernoulli sample without replacement."""
+        if not (0.0 <= fraction <= 1.0):
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+        rng = random.Random(seed)
+        return self.filter(lambda _x: rng.random() < fraction)
+
     def combine_by_key(self, create_combiner, merge_value, merge_combiners,
                        num_partitions: Optional[int] = None) -> "Dataset":
         """The general combiner (Spark combineByKey; the reference's

@@ -153,3 +153,36 @@ def test_dataset_combine_by_key(ctx):
     for k in range(3):
         vals = [v for i, v in enumerate(range(30)) if i % 3 == k]
         assert out[k] == (sum(vals), len(vals))
+
+
+def test_dataset_staples(ctx):
+    ds = ctx.parallelize([(k % 4, k) for k in range(40)], num_slices=4)
+    assert sorted(ds.keys().collect()) == sorted(k % 4 for k in range(40))
+    assert sorted(ds.values().collect()) == list(range(40))
+    assert sorted(ds.map_values(lambda v: v * 2).collect()) == sorted(
+        (k % 4, k * 2) for k in range(40)
+    )
+    u = ds.union(ctx.parallelize([(9, 99)], num_slices=1))
+    assert len(u.collect()) == 41
+    assert ds.first() in [(k % 4, k) for k in range(40)]
+    assert len(ds.take(7)) == 7
+    samp = ds.sample(0.5, seed=3).collect()
+    assert 0 < len(samp) < 40
+    assert set(samp) <= set((k % 4, k) for k in range(40))
+
+
+def test_repartition_and_sort_within_partitions(ctx):
+    import random as _random
+
+    rng = _random.Random(5)
+    data = [(rng.randrange(1000), i) for i in range(500)]
+    out = ctx.parallelize(data, num_slices=4) \
+        .repartition_and_sort_within_partitions(num_partitions=5)
+    parts = out._materialize()
+    assert len(parts) == 5
+    seen = []
+    for part in parts:
+        ks = [k for k, _v in part]
+        assert ks == sorted(ks), "partition not key-sorted"
+        seen.extend(part)
+    assert sorted(seen) == sorted(data)
