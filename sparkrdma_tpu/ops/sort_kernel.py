@@ -1,0 +1,218 @@
+"""Experimental Pallas in-block bitonic sort for (key, value) pairs.
+
+XLA's ``lax.sort`` is the cost ceiling of every sort-bound bench
+(terasort, the join probes, the keyed reductions).  This kernel sorts
+fixed-size blocks entirely in VMEM with a bitonic network — one HBM
+read + one write per block — as the building block of a two-phase
+(sort blocks → range-bucket → sort buckets) full sort.
+
+Pairing uses the standard XOR network: at distance ``d`` element ``i``
+exchanges with ``i ^ d``.  On the [R, 128] row-major block layout a
+distance below 128 is a lane XOR (two ``pltpu.roll``s along lanes +
+select) and a distance that is a multiple of 128 is a row XOR (rolls
+along sublanes), so no general permutes are needed.  Direction bits and
+pair order come from 2-D ``broadcasted_iota``.  Ties break by flat
+index, which keeps the two sides of every compare-exchange consistent
+(the pair moves key and value together).
+
+UNVALIDATED ON REAL TPU SILICON: the chip was unreachable when this
+landed, so only interpret-mode semantics are pinned (tests).  Nothing
+dispatches to it by default — call sites must opt in after
+``tools/profile_tpu_sort.py`` shows it beating ``lax.sort`` on chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+LANES = 128
+
+
+def _partner(x, d, R, interpret):
+    """partner[i] = x[i ^ d] over the flat row-major [R, 128] order."""
+    if d < LANES:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+        take_fwd = (lane & d) == 0
+        if interpret:
+            fwd = jnp.roll(x, -d, axis=1)
+            bwd = jnp.roll(x, d, axis=1)
+        else:
+            from jax.experimental.pallas import tpu as pltpu
+
+            fwd = pltpu.roll(x, -d, 1)
+            bwd = pltpu.roll(x, d, 1)
+        return jnp.where(take_fwd, fwd, bwd)
+    m = d // LANES
+    row = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+    take_fwd = (row & m) == 0
+    if interpret:
+        fwd = jnp.roll(x, -m, axis=0)
+        bwd = jnp.roll(x, m, axis=0)
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        fwd = pltpu.roll(x, -m, 0)
+        bwd = pltpu.roll(x, m, 0)
+    return jnp.where(take_fwd, fwd, bwd)
+
+
+def _block_sort_body(R, interpret, k_ref, v_ref, ok_ref, ov_ref):
+    B = R * LANES
+    k = k_ref[...]
+    v = v_ref[...]
+    row = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+    flat = row * LANES + lane
+    n_stages = B.bit_length() - 1
+    for stage in range(1, n_stages + 1):
+        # ascending iff bit ``stage`` of the flat index is clear; the
+        # final stage has that bit clear everywhere → fully ascending
+        up = (flat & (1 << stage)) == 0 if stage < n_stages else (
+            jnp.ones((R, LANES), bool)
+        )
+        for j in range(stage - 1, -1, -1):
+            d = 1 << j
+            pk = _partner(k, d, R, interpret)
+            pv = _partner(v, d, R, interpret)
+            is_lower = (flat & d) == 0
+            # pair-consistent "my element is the smaller": ties go to
+            # the lower flat index
+            mine_small = (k < pk) | ((k == pk) & is_lower)
+            take_min = up == is_lower
+            want_mine = take_min == mine_small
+            k = jnp.where(want_mine, k, pk)
+            v = jnp.where(want_mine, v, pv)
+    ok_ref[...] = k
+    ov_ref[...] = v
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "interpret")
+)
+def sort_pairs_blocks(keys, vals, block_rows: int = 1024,
+                      interpret: bool = False):
+    """Sort (keys, vals) within consecutive blocks of
+    ``block_rows * 128`` elements (each block independently ascending
+    by key).  Input length must be a multiple of the block size;
+    dtypes: any 32-bit integer keys (compared in their own dtype).
+    """
+    n = int(keys.shape[0])
+    B = block_rows * LANES
+    if n % B:
+        raise ValueError(f"length {n} not a multiple of block {B}")
+    if B & (B - 1):
+        raise ValueError(f"block size {B} must be a power of two")
+    R = block_rows
+    k2 = keys.reshape(-1, LANES)
+    v2 = vals.reshape(-1, LANES)
+    grid = (n // B,)
+    blk = pl.BlockSpec((R, LANES), lambda i: (i, 0))
+    kernel = functools.partial(_block_sort_body, R, interpret)
+    ok, ov = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[blk, blk],
+        out_specs=[blk, blk],
+        out_shape=[
+            jax.ShapeDtypeStruct(k2.shape, k2.dtype),
+            jax.ShapeDtypeStruct(v2.shape, v2.dtype),
+        ],
+        interpret=interpret,
+    )(k2, v2)
+    return ok.reshape(-1), ov.reshape(-1)
+
+
+def sort_pairs_full(keys, vals, block_rows: int = 1024,
+                    n_buckets: int = 16, cap_factor: float = 1.4,
+                    interpret: bool = False):
+    """Full (key, value) sort: Pallas block sorts → equal-frequency
+    splitters from block quantiles → window-copy bucket assembly (the
+    terasort pattern on one chip) → batched bucket sort.  Returns
+    host-trimmable ``(keys', vals', valid)`` of padded length
+    ``n_buckets * cap`` with ``valid`` marking real slots (padding
+    sorts to each bucket's tail).
+
+    Exactness is pinned by tests vs numpy; wire into the sorter only
+    after on-chip profiling (module docstring).
+    """
+    n = int(keys.shape[0])
+    B = block_rows * LANES
+    if n % B or n == 0:
+        raise ValueError(f"length {n} must be a positive multiple of {B}")
+    nb = n // B
+    sk, sv = sort_pairs_blocks(
+        keys, vals, block_rows=block_rows, interpret=interpret
+    )
+    kb = sk.reshape(nb, B)
+    vb = sv.reshape(nb, B)
+    # equal-frequency splitters from exact per-block quantiles
+    S = min(512, B)
+    sample = kb[:, (jnp.arange(S) * B) // S].reshape(-1)
+    ssorted = jnp.sort(sample)
+    idx = (jnp.arange(1, n_buckets) * ssorted.shape[0]) // n_buckets
+    splitters = ssorted[idx]
+    edges = jax.vmap(
+        lambda row: jnp.searchsorted(row, splitters, side="right")
+    )(kb).astype(jnp.int32)                       # [nb, n_buckets-1]
+    zeros = jnp.zeros((nb, 1), jnp.int32)
+    fulls = jnp.full((nb, 1), B, jnp.int32)
+    edges = jnp.concatenate([zeros, edges, fulls], axis=1)
+    counts = edges[:, 1:] - edges[:, :-1]         # [nb, n_buckets]
+    starts = edges[:, :-1]
+    cap = int(np.ceil(n / n_buckets * cap_factor))
+    cap = (cap + LANES - 1) // LANES * LANES
+    sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
+    bucket_off = jnp.cumsum(counts, axis=0) - counts  # offset of block b
+    kp = jnp.concatenate(
+        [kb, jnp.full((nb, cap), sentinel, kb.dtype)], axis=1
+    )
+    vp = jnp.concatenate([vb, jnp.zeros((nb, cap), vb.dtype)], axis=1)
+
+    def fill(i, bufs):
+        fk, fv, fn = bufs
+        b = i // n_buckets
+        dst = i % n_buckets
+        wk = jax.lax.dynamic_slice(kp[b], (starts[b, dst],), (cap,))
+        wv = jax.lax.dynamic_slice(vp[b], (starts[b, dst],), (cap,))
+        off = bucket_off[b, dst]
+        c = counts[b, dst]
+        slot = jnp.arange(cap, dtype=jnp.int32)
+        old_k = jax.lax.dynamic_slice(fk[dst], (off,), (cap,))
+        old_v = jax.lax.dynamic_slice(fv[dst], (off,), (cap,))
+        take = slot < c
+        fk = jax.lax.dynamic_update_slice(
+            fk, jnp.where(take, wk, old_k)[None], (dst, off)
+        )
+        fv = jax.lax.dynamic_update_slice(
+            fv, jnp.where(take, wv, old_v)[None], (dst, off)
+        )
+        fn = fn.at[dst].add(c)
+        return fk, fv, fn
+
+    fk0 = jnp.full((n_buckets, cap + cap), sentinel, kb.dtype)
+    fv0 = jnp.zeros((n_buckets, cap + cap), vb.dtype)
+    fn0 = jnp.zeros((n_buckets,), jnp.int32)
+    fk, fv, fn = jax.lax.fori_loop(
+        0, nb * n_buckets, fill, (fk0, fv0, fn0)
+    )
+    overflow = jnp.max(fn)
+    fk = fk[:, :cap]
+    fv = fv[:, :cap]
+    # bucket sort: padding carries the sentinel and a validity tiebreak
+    slot = jnp.arange(cap, dtype=jnp.int32)
+    invalid = (slot[None, :] >= fn[:, None]).astype(jnp.int32)
+    fk = jnp.where(invalid > 0, sentinel, fk)
+    fv = jnp.where(invalid > 0, jnp.zeros((), fv.dtype), fv)
+    ok, oinv, ov = jax.lax.sort(
+        (fk, invalid, fv), num_keys=2, is_stable=False, dimension=1
+    )
+    valid = jnp.int32(1) - oinv
+    return (
+        ok.reshape(-1), ov.reshape(-1), valid.reshape(-1),
+        fn, overflow,
+    )

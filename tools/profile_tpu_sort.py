@@ -92,6 +92,38 @@ def main():
 
     bench("terasort sort_device step", step, nbytes=nbytes)
 
+    # experimental pallas bitonic sort (ops/sort_kernel.py): blocks
+    # alone, then the full two-phase sort — compare vs lax.sort above
+    try:
+        from sparkrdma_tpu.ops.sort_kernel import (
+            sort_pairs_blocks,
+            sort_pairs_full,
+        )
+
+        for br in (256, 512, 1024):
+            if n % (br * 128) == 0:
+                bench(
+                    f"pallas block sort (R={br})",
+                    lambda k, v, b=br: sort_pairs_blocks(
+                        k, v, block_rows=b
+                    ),
+                    k, v, nbytes=nbytes,
+                )
+        full = jax.jit(
+            lambda k, v: sort_pairs_full(
+                k, v, block_rows=512, n_buckets=16
+            )[:3]
+        )
+        out = full(k, v)
+        ok, _ov, valid = out
+        m = np.asarray(jax.device_get(valid)) > 0
+        got = np.asarray(jax.device_get(ok))[m]
+        assert (np.diff(got) >= 0).all() and m.sum() == n, "full sort bad"
+        bench("pallas full 2-phase sort", full, k, v, nbytes=nbytes)
+    except Exception as e:  # Mosaic lowering may reject it — report
+        print(f"pallas sort unavailable: {type(e).__name__}: {e}",
+              flush=True)
+
     def step_tight():
         (sk, sv, n_valid, _), _cap = sorter.sort_device(
             kk, vv, capacity=n
