@@ -121,10 +121,13 @@ class ShuffleReader:
         for host, map_ids in self.maps_by_host.items():
             if host == self.manager.local_smid:
                 for mid in map_ids:
-                    for rid in reduce_ids:
-                        data = self.manager.resolver.get_local_block(
-                            self.handle.shuffle_id, mid, rid
-                        )
+                    # one batched backing-store read per map output
+                    # (device segments pay a host round-trip per
+                    # Segment read; read_many fetches the union span)
+                    blocks = self.manager.resolver.get_local_blocks(
+                        self.handle.shuffle_id, mid, reduce_ids
+                    )
+                    for data in blocks:
                         self.metrics.local_blocks += 1
                         self.metrics.local_bytes += len(data)
                         if len(data):  # ndarray views: no bool()
