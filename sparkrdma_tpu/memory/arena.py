@@ -288,6 +288,29 @@ class ArenaManager(BlockStore):
             raise TransportError(f"no segment registered for mkey={location.mkey}")
         return seg.read(location.address, location.length)
 
+    def read_blocks(self, locations) -> list:
+        """Serve many blocks, batching per backing segment
+        (``Segment.read_many``: one device→host transfer per segment
+        instead of per block — the one-sided READ service groups
+        fetches, and a grouped fetch usually hits one map segment)."""
+        by_key: Dict[int, list] = {}
+        for i, loc in enumerate(locations):
+            by_key.setdefault(loc.mkey, []).append(i)
+        out: list = [b""] * len(locations)
+        for mkey, idxs in by_key.items():
+            seg = self.get(mkey)
+            if seg is None:
+                raise TransportError(
+                    f"no segment registered for mkey={mkey}"
+                )
+            blocks = seg.read_many(
+                [(locations[i].address, locations[i].length)
+                 for i in idxs]
+            )
+            for i, b in zip(idxs, blocks):
+                out[i] = b
+        return out
+
     # -- stats --------------------------------------------------------------
     @property
     def total_bytes(self) -> int:

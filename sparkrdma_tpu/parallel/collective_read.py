@@ -495,7 +495,7 @@ class CollectiveChannel(Channel):
         def fallback(locs):
             # host path: one-sided read from the peer's block stores
             self._check_alive()
-            return [self.remote.read_local_block(loc) for loc in locs]
+            return self.remote.read_local_blocks(locs)
 
         def deliver():
             try:

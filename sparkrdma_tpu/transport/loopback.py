@@ -175,8 +175,9 @@ class LoopbackChannel(Channel):
                     )
                 if self.state != ChannelState.CONNECTED:
                     raise TransportError("channel not connected")
-                # one-sided: read directly from the peer's registered memory
-                data = [self.remote.read_local_block(loc) for loc in locations]
+                # one-sided: read directly from the peer's registered
+                # memory, batched per backing segment
+                data = self.remote.read_local_blocks(locations)
             except BaseException as e:
                 self._error(e)
                 self._fail(listener, e)

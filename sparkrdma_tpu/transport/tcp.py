@@ -241,7 +241,7 @@ class TcpChannel(Channel):
                 addr, length, mkey = _LOC.unpack_from(payload, off)
                 off += _LOC.size
                 locs.append(BlockLocation(addr, length, mkey))
-            blocks = [self.node.read_local_block(loc) for loc in locs]
+            blocks = self.node.read_local_blocks(locs)
             body = bytearray(_RESP_HDR.pack(req_id, 0))
             for b in blocks:
                 body += _LEN.pack(len(b))
