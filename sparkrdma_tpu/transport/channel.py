@@ -234,6 +234,11 @@ class BlockStore:
     def read_block(self, location: BlockLocation) -> bytes:  # pragma: no cover
         raise NotImplementedError
 
+    def read_blocks(self, locations) -> list:
+        """Batched read; stores with a cheaper grouped path override
+        this (ArenaManager batches per backing segment)."""
+        return [self.read_block(loc) for loc in locations]
+
 
 class BytesBlockStore(BlockStore):
     """Host-memory block store over one contiguous buffer; ``address``

@@ -156,25 +156,26 @@ class Node:
 
     def read_local_blocks(self, locations) -> list:
         """Batched one-sided read service: groups by owning store and
-        uses its ``read_blocks`` (per-segment batched transfers) when
-        available."""
+        uses its ``read_blocks`` (per-segment batched transfers on the
+        arena store; the BlockStore base falls back per block)."""
         by_store: dict = {}
-        for i, loc in enumerate(locations):
-            with self._block_store_lock:
+        with self._block_store_lock:
+            for i, loc in enumerate(locations):
                 store = self._block_stores.get(loc.mkey)
-            if store is None:
-                raise TransportError(
-                    f"{self}: no block store registered for "
-                    f"mkey={loc.mkey}"
-                )
-            by_store.setdefault(id(store), (store, []))[1].append(i)
+                if store is None:
+                    raise TransportError(
+                        f"{self}: no block store registered for "
+                        f"mkey={loc.mkey}"
+                    )
+                by_store.setdefault(id(store), (store, []))[1].append(i)
         out: list = [b""] * len(locations)
         for store, idxs in by_store.values():
-            reader = getattr(store, "read_blocks", None)
-            if reader is not None:
-                blocks = reader([locations[i] for i in idxs])
-            else:
-                blocks = [store.read_block(locations[i]) for i in idxs]
+            blocks = store.read_blocks([locations[i] for i in idxs])
+            if len(blocks) != len(idxs):
+                raise TransportError(
+                    f"{store!r}.read_blocks returned {len(blocks)} "
+                    f"blocks for {len(idxs)} locations"
+                )
             for i, b in zip(idxs, blocks):
                 out[i] = b
         return out

@@ -271,3 +271,30 @@ def test_alloc_gc_close_with_outstanding_views_is_safe():
     assert bytes(arr[:3]) == b"\x09\x08\x07"
     del arr
     gc.collect()
+
+
+def test_read_spans_clustered_skips_large_gaps():
+    """Sparse batches must not materialize the gap between far-apart
+    blocks (code-review finding): clusters split above the gap cap."""
+    from sparkrdma_tpu.memory.arena import (
+        READ_MANY_MAX_GAP,
+        _read_spans_clustered,
+    )
+
+    fetched = []
+    backing = bytes(range(256)) * 4  # 1 KiB pattern
+
+    def fetch(lo, hi):
+        fetched.append((lo, hi))
+        # synthesize content: offset modulo pattern
+        return bytes((i % 251 for i in range(lo, hi)))
+
+    far = READ_MANY_MAX_GAP * 3
+    spans = [(far + 100, 50), (0, 10), (far + 500, 20), (40, 5)]
+    out = _read_spans_clustered(spans, fetch)
+    assert len(fetched) == 2, fetched  # two clusters, gap skipped
+    total = sum(hi - lo for lo, hi in fetched)
+    assert total < READ_MANY_MAX_GAP, "gap was materialized"
+    for (o, ln), b in zip(spans, out):
+        assert b == bytes((i % 251 for i in range(o, o + ln)))
+    assert _read_spans_clustered([], fetch) == []
