@@ -73,22 +73,70 @@ def main():
         _, n_valid = run_once()
     fence(n_valid)
     dt = (time.perf_counter() - t0) / ITERS
+    engine = "lax.sort"
+
+    # single-chip: try the experimental Pallas sort engine
+    # (ops/sort_kernel.py) — adopted ONLY if it verifies exact on this
+    # hardware AND beats the lax.sort step (it has never run on real
+    # silicon when slower/broken, the lax number above stands)
+    n_chips = len(list(mesh.devices.flat))
+    if n_chips == 1:
+        try:
+            dt_p = _try_pallas_engine(keys, vals, dt)
+            if dt_p is not None and dt_p < dt:
+                dt = dt_p
+                engine = "pallas 2-phase sort"
+        except Exception as e:  # Mosaic may reject it — keep lax
+            print(f"# pallas engine unavailable: {e!r}",
+                  flush=True)
 
     bytes_per_iter = N_RECORDS * 8  # key + value
     gbps = bytes_per_iter / dt / 1e9
-    n_chips = len(list(mesh.devices.flat))
     per_chip = gbps / n_chips
     print(
         json.dumps(
             {
                 "metric": "terasort shuffle+sort throughput per chip "
-                          f"({N_RECORDS} records, {n_chips} chip(s))",
+                          f"({N_RECORDS} records, {n_chips} chip(s), "
+                          f"{engine})",
                 "value": round(per_chip, 3),
                 "unit": "GB/s/chip",
                 "vs_baseline": round(per_chip / BASELINE_GBPS, 3),
             }
         )
     )
+
+
+def _try_pallas_engine(keys, vals, dt_lax):
+    """Time the Pallas two-phase sort; returns secs/iter or None.
+    Verifies exactness (count + sortedness on a sampled stride) before
+    trusting any number."""
+    from sparkrdma_tpu.ops.sort_kernel import sort_pairs_full
+
+    fn = jax.jit(
+        lambda k, v: sort_pairs_full(
+            k, v, block_rows=512, n_buckets=16
+        )[:3]
+    )
+
+    def fence1(x):
+        np.asarray(jax.device_get(x.reshape(-1)[-1:]))
+
+    ok, ov, valid = fn(keys, vals)
+    fence1(valid)
+    valid_h = np.asarray(jax.device_get(valid))
+    if int(valid_h.sum()) != N_RECORDS:
+        return None
+    ok_h = np.asarray(jax.device_get(ok))[valid_h > 0]
+    if not (np.diff(ok_h[:: max(1, len(ok_h) // 100000)]) >= 0).all():
+        return None
+    if not (np.diff(ok_h) >= 0).all():
+        return None
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        ok, ov, valid = fn(keys, vals)
+    fence1(valid)
+    return (time.perf_counter() - t0) / ITERS
 
 
 if __name__ == "__main__":

@@ -39,24 +39,26 @@ def _partner(x, d, R, interpret):
         lane = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
         take_fwd = (lane & d) == 0
         if interpret:
-            fwd = jnp.roll(x, -d, axis=1)
+            fwd = jnp.roll(x, LANES - d, axis=1)
             bwd = jnp.roll(x, d, axis=1)
         else:
             from jax.experimental.pallas import tpu as pltpu
 
-            fwd = pltpu.roll(x, -d, 1)
+            # pltpu.roll requires non-negative shifts: a circular
+            # backward roll by d is a forward roll by size - d
+            fwd = pltpu.roll(x, LANES - d, 1)
             bwd = pltpu.roll(x, d, 1)
         return jnp.where(take_fwd, fwd, bwd)
     m = d // LANES
     row = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
     take_fwd = (row & m) == 0
     if interpret:
-        fwd = jnp.roll(x, -m, axis=0)
+        fwd = jnp.roll(x, R - m, axis=0)
         bwd = jnp.roll(x, m, axis=0)
     else:
         from jax.experimental.pallas import tpu as pltpu
 
-        fwd = pltpu.roll(x, -m, 0)
+        fwd = pltpu.roll(x, R - m, 0)
         bwd = pltpu.roll(x, m, 0)
     return jnp.where(take_fwd, fwd, bwd)
 
