@@ -124,3 +124,64 @@ def test_probe_fill_semantics_via_kernel():
     np.testing.assert_array_equal(
         np.asarray(gv)[m], np.asarray(want_val)[m]
     )
+
+
+def test_dispatch_wiring_produces_identical_results(monkeypatch):
+    """Force the TPU dispatch gates ON (kernel routed through interpret
+    mode) and check the join probe and keyed reductions produce exactly
+    the jnp-path results — catches arg-order/flag-convention bugs in
+    the wiring that the isolated kernel tests cannot."""
+    import sparkrdma_tpu.ops.scan_kernels as sk
+    from sparkrdma_tpu.models.join import (
+        _ROLE_DIM,
+        _ROLE_FACT,
+        _probe_fill,
+    )
+    from sparkrdma_tpu.ops.segment import (
+        aggregate_by_key_local,
+        reduce_by_key_local,
+    )
+
+    n = sk.MIN_KERNEL_ELEMS  # large enough to pass the size gate
+    rng = np.random.default_rng(123)
+    keys = np.sort(rng.integers(0, 500, n).astype(np.uint32))
+    role = np.full(n, _ROLE_FACT, np.uint32)
+    heads = np.flatnonzero(np.diff(keys, prepend=-1) != 0)
+    role[heads[::3]] = _ROLE_DIM
+    pay = rng.integers(0, 1 << 30, n).astype(np.uint32)
+
+    rkeys = rng.integers(0, 97, n, dtype=np.int32)
+    rvals = rng.integers(-100, 100, n, dtype=np.int32)
+
+    def run_all():
+        pf = _probe_fill(
+            jnp.asarray(keys), jnp.asarray(role), jnp.asarray(pay)
+        )
+        red = reduce_by_key_local(
+            jnp.asarray(rkeys), jnp.asarray(rvals), None
+        )
+        agg = aggregate_by_key_local(
+            jnp.asarray(rkeys), jnp.asarray(rvals), None
+        )
+        return pf, red, agg
+
+    # reference: jnp log-step paths (kernels off)
+    monkeypatch.setattr(sk, "use_scan_kernels", lambda: False)
+    (wv, wf), wred, wagg = run_all()
+
+    # kernel path: gate on, interpret-mode execution
+    real = sk.scan_flagged
+    monkeypatch.setattr(
+        sk, "scan_flagged",
+        lambda kind, flag, cols: real(kind, flag, cols, interpret=True),
+    )
+    monkeypatch.setattr(sk, "use_scan_kernels", lambda: True)
+    (gv, gf), gred, gagg = run_all()
+
+    np.testing.assert_array_equal(np.asarray(gf), np.asarray(wf))
+    m = np.asarray(wf)
+    np.testing.assert_array_equal(np.asarray(gv)[m], np.asarray(wv)[m])
+    for w, g in zip(wred, gred):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    for w, g in zip(wagg, gagg):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
