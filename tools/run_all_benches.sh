@@ -1,0 +1,20 @@
+#!/bin/bash
+# One-shot on-chip sweep: kernel validation first, then every bench.
+# Appends all JSON lines + timings to tools/bench_results_$(date).log
+# so BASELINE.md can be updated from one artifact.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out="tools/bench_results_$(date +%m%d_%H%M).log"
+run() {
+  echo "== $* ==" | tee -a "$out"
+  timeout 1200 "$@" 2>&1 | grep -v -E "WARNING|^I[0-9]" | tee -a "$out"
+}
+run python tools/profile_tpu_scans.py 22
+run python tools/profile_tpu_sort.py 24
+run python bench.py
+run python benchmarks/bench_join.py
+run python benchmarks/bench_sort_wordcount.py
+run python benchmarks/bench_tpcds.py
+run python benchmarks/bench_attention.py
+run python benchmarks/bench_terasort.py
+echo "results in $out"
