@@ -133,12 +133,14 @@ def _probe_fill(sk, srole, spay):
     """
     from sparkrdma_tpu.ops.scan_kernels import (
         MIN_KERNEL_ELEMS,
+        kernel_eligible,
         scan_flagged,
         use_scan_kernels,
     )
 
     m = int(sk.shape[0])
-    if m >= MIN_KERNEL_ELEMS and use_scan_kernels():
+    if (m >= MIN_KERNEL_ELEMS and kernel_eligible(sk, spay)
+            and use_scan_kernels()):
         flag, (fkey, fval) = scan_flagged(
             "fill", srole == _ROLE_DIM, (sk, spay)
         )

@@ -67,6 +67,14 @@ def use_scan_kernels() -> bool:
         return False
 
 
+def kernel_eligible(*cols) -> bool:
+    """Dtype gate for the kernels: 64-bit integer columns (reachable
+    only under ``jax_enable_x64``) stay on the jnp log-step paths —
+    Mosaic's emulated 64-bit support is not something to bet the
+    x64 join path on."""
+    return all(np.dtype(c.dtype).itemsize <= 4 for c in cols)
+
+
 def _identity(kind: str, dtype) -> np.generic:
     dt = np.dtype(dtype)
     if kind == "min":
@@ -195,7 +203,8 @@ def cumsum_1d(vals: jax.Array) -> jax.Array:
     (XLA lowers cumulative ops to logarithmic passes too); jnp
     elsewhere or below the size threshold."""
     n = int(vals.shape[0])
-    if n >= MIN_KERNEL_ELEMS and use_scan_kernels():
+    if (n >= MIN_KERNEL_ELEMS and kernel_eligible(vals)
+            and use_scan_kernels()):
         _f, (out,) = scan_flagged(
             "add", jnp.zeros(n, bool), (vals,)
         )

@@ -36,13 +36,15 @@ def segmented_scan(vals, heads, op, identity):
     """
     from sparkrdma_tpu.ops.scan_kernels import (
         MIN_KERNEL_ELEMS,
+        kernel_eligible,
         scan_flagged,
         use_scan_kernels,
     )
 
     n = int(vals.shape[0])
     kind = {jnp.add: "add", jnp.minimum: "min", jnp.maximum: "max"}.get(op)
-    if kind and n >= MIN_KERNEL_ELEMS and use_scan_kernels():
+    if (kind and n >= MIN_KERNEL_ELEMS and kernel_eligible(vals)
+            and use_scan_kernels()):
         _f, (out,) = scan_flagged(kind, heads, (vals,))
         return out
     x = vals
@@ -67,12 +69,14 @@ def _ff_run_carry(is_last, columns):
     pass (ops/scan_kernels.py)."""
     from sparkrdma_tpu.ops.scan_kernels import (
         MIN_KERNEL_ELEMS,
+        kernel_eligible,
         scan_flagged,
         use_scan_kernels,
     )
 
     if (
         int(is_last.shape[0]) >= MIN_KERNEL_ELEMS
+        and kernel_eligible(*columns)
         and use_scan_kernels()
     ):
         flag, cols = scan_flagged("fill", is_last, tuple(columns))
