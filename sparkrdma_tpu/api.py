@@ -364,6 +364,15 @@ class Dataset:
         return self._chain(lambda part: list(f(part)))
 
     # -- materialization -----------------------------------------------------
+    def cache(self) -> "Dataset":
+        """Materialize the pending transform chain once and keep the
+        result: later actions reuse it instead of re-running the chain
+        (Spark's cache/persist at MEMORY_ONLY).  Returns self."""
+        if self._transform is not None:
+            self._parts = self._materialize()
+            self._transform = None
+        return self
+
     def _materialize(self) -> List[List[Any]]:
         if self._transform is None:
             return self._parts

@@ -186,3 +186,24 @@ def test_repartition_and_sort_within_partitions(ctx):
         assert ks == sorted(ks), "partition not key-sorted"
         seen.extend(part)
     assert sorted(seen) == sorted(data)
+
+
+def test_dataset_cache_materializes_once(ctx):
+    calls = []
+
+    def probe(x):
+        calls.append(x)
+        return x * 2
+
+    ds = ctx.parallelize(list(range(20)), num_slices=2).map(probe)
+    assert sorted(ds.collect()) == sorted(x * 2 for x in range(20))
+    assert sorted(ds.collect()) == sorted(x * 2 for x in range(20))
+    assert len(calls) == 40  # uncached: chain re-ran per action
+
+    calls.clear()
+    cached = ctx.parallelize(list(range(20)), num_slices=2) \
+        .map(probe).cache()
+    assert sorted(cached.collect()) == sorted(x * 2 for x in range(20))
+    assert sorted(cached.collect()) == sorted(x * 2 for x in range(20))
+    assert cached.count() == 20
+    assert len(calls) == 20  # cached: chain ran once
