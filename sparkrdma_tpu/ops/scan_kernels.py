@@ -43,8 +43,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
 # rows of 128 lanes per grid block: 1024*128 elements = 512 KiB per
-# int32 column in VMEM — small enough for several columns + scratch
-BLOCK_ROWS = 1024
+# int32 column in VMEM — small enough for several columns + scratch.
+# Env-tunable for the on-chip sweep (tools/TPU_TODO.md); read once at
+# import so compiled shapes stay consistent within a process.
+import os as _os  # noqa: E402
+
+BLOCK_ROWS = int(_os.environ.get("SPARKRDMA_TPU_SCAN_BLOCK_ROWS", 1024))
 _BLOCK = BLOCK_ROWS * LANES
 
 # columns longer than this use the kernel on TPU backends; below it the
