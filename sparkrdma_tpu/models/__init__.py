@@ -10,6 +10,7 @@ from sparkrdma_tpu.models.join import JOIN_HOWS, BroadcastJoiner, HashJoiner
 from sparkrdma_tpu.models.join_aggregate import BroadcastJoinAggregator
 from sparkrdma_tpu.models.ring_attention import ring_attention, ulysses_attention
 from sparkrdma_tpu.models.terasort import TeraSorter, make_sort_step
+from sparkrdma_tpu.models.topk import GroupedTopK
 from sparkrdma_tpu.models.wordcount import WordCounter, make_count_step
 
 __all__ = [
@@ -17,5 +18,5 @@ __all__ = [
     "HashJoiner", "BroadcastJoiner", "JOIN_HOWS",
     "BroadcastJoinAggregator", "ExternalTeraSorter",
     "ring_attention", "ulysses_attention",
-    "KeyedAggregator", "KeyStats",
+    "KeyedAggregator", "KeyStats", "GroupedTopK",
 ]

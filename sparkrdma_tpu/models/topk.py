@@ -1,0 +1,126 @@
+"""Grouped top-k on the mesh: the rank/LIMIT-per-group SQL shape.
+
+TPC-DS q67-style plans rank rows within each group and keep the top k
+(``row_number() over (partition by key order by value desc) <= k``).
+Device-native here as one SPMD pass over the existing primitives:
+
+  hash exchange (co-locate each key) → ONE sort keyed (key, value
+  descending via bitwise complement) → per-run rank from a run-head
+  forward fill (no gathers) → rank < k mask.
+
+The run-head fill rides the same machinery as the keyed reductions
+(ops/segment.py; one-pass Pallas on TPU backends), so the step's cost
+is the sort — identical shape to wordcount/aggregate.
+
+Reference analog: none in-repo (the reference left SQL to Spark); this
+is BASELINE config-5 surface like the joins (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkrdma_tpu.models._base import ExchangeModel
+from sparkrdma_tpu.ops.exchange import hash_exchange
+from sparkrdma_tpu.ops.segment import _ff_run_carry
+from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+
+
+def _rank_in_runs(ks, valid_s):
+    """Rank of each slot within its (key, validity) run in an already
+    sorted layout: iota minus the run's start index, via the run-END
+    fill of the PREVIOUS run's end position (the _prev_end idea with
+    positions as the carried column)."""
+    n = int(ks.shape[0])
+    iota = jnp.arange(n, dtype=jnp.int32)
+    bound = (ks[1:] != ks[:-1]) | (valid_s[1:] != valid_s[:-1])
+    is_last = jnp.concatenate([bound, jnp.ones(1, bool)])
+    # fill of run-end POSITIONS; shifted right one slot = the previous
+    # run's end + 1 = my run's start (0 for the first run)
+    flag, (fpos,) = _ff_run_carry(is_last, (iota + 1,))
+    fpos = jnp.where(flag, fpos, 0)
+    run_start = jnp.concatenate([jnp.zeros(1, jnp.int32), fpos[:-1]])
+    return iota - run_start
+
+
+@functools.lru_cache(maxsize=16)
+def make_topk_step(mesh: Mesh, n_local: int, capacity: int, k: int):
+    """Jitted grouped top-k over global [D*n_local] columns sharded on
+    the mesh axis: returns (keys', vals', keep) where keep = 1 on the
+    top-k rows of each key (value descending, ties broken
+    arbitrarily — unstable sort, Spark shuffle parity)."""
+    D = len(list(mesh.devices.flat))
+    spec = P(EXCHANGE_AXIS)
+
+    def body(keys, vals, valid):  # local [n_local]
+        flat_k, flat_v, flat_m, max_fill = hash_exchange(
+            keys, vals, valid, D, capacity
+        )
+        sentinel = jnp.array(jnp.iinfo(flat_k.dtype).max, flat_k.dtype)
+        flat_k = jnp.where(flat_m > 0, flat_k, sentinel)
+        # descending by value inside a run: sort on the bitwise
+        # complement (order-reversing bijection on signed ints)
+        desc = ~flat_v
+        inv = jnp.int32(1) - flat_m.astype(jnp.int32)
+        ks, inv_s, ds = jax.lax.sort(
+            (flat_k, inv, desc), num_keys=3, is_stable=False
+        )
+        vs = ~ds  # complement is an involution: one fewer sort operand
+        ms = jnp.int32(1) - inv_s
+        rank = _rank_in_runs(ks, inv_s)
+        keep = ((rank < k) & (ms > 0)).astype(jnp.int32)
+        n_keep = jnp.sum(keep)
+        # (*rows, n_unique, max_fill): the shared keyed-driver contract
+        return ks, vs, keep, n_keep[None], max_fill[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec,) * 3, out_specs=(spec,) * 5
+    )
+    return jax.jit(mapped)
+
+
+class GroupedTopK(ExchangeModel):
+    """Host-facing grouped top-k: ``{key: [k largest values desc]}``."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 capacity_factor: float = 2.0):
+        super().__init__(mesh, capacity_factor)
+
+    def top_k(self, keys, vals, k: int) -> Dict[int, List[int]]:
+        if k <= 0:
+            raise ValueError(f"k must be positive: {k}")
+        step_maker = functools.partial(_make_step_with_k, k=k)
+        rows, _nu = self._run_padded_keyed(keys, vals, step_maker)
+        if rows is None:
+            return {}
+        ks_h, vs_h, keep_h = rows
+        out: Dict[int, List[Tuple[int, int]]] = {}
+        D = self.n_devices
+        for d in range(D):
+            mask = keep_h[d] > 0
+            for kk, vv in zip(ks_h[d][mask], vs_h[d][mask]):
+                out.setdefault(int(kk), []).append(int(vv))
+        # rows arrive key-grouped and value-descending per device; a
+        # key lives on exactly one device post-exchange, so each list
+        # is already the final descending top-k
+        return out
+
+
+def _make_step_with_k(mesh, n_local, capacity, k, with_validity=True):
+    """Adapter matching the shared keyed-driver's maker signature; the
+    validity-free fast path reuses the general body (the rank fill
+    needs the validity run delimiter anyway)."""
+    if not with_validity:
+        step = make_topk_step(mesh, n_local, capacity, k)
+
+        def run(keys, vals):
+            valid = jnp.ones(keys.shape[0], jnp.int32)
+            return step(keys, vals, valid)
+
+        return run
+    return make_topk_step(mesh, n_local, capacity, k)

@@ -636,3 +636,24 @@ def test_quantized_padded_lengths_collapse_shapes(mesh, devices):
     got = wc.count(keys)
     u, c = np.unique(keys, return_counts=True)
     assert got == dict(zip(u.tolist(), c.tolist()))
+
+
+def test_grouped_topk(mesh, devices):
+    """Grouped top-k (the q67 rank/LIMIT-per-group shape) vs a dict
+    oracle, including ties, k larger than a group, and negatives."""
+    from sparkrdma_tpu.models.topk import GroupedTopK
+
+    rng = np.random.default_rng(42)
+    n = 20011
+    keys = rng.integers(0, 67, n, dtype=np.int32)
+    vals = rng.integers(-1000, 1000, n, dtype=np.int32)
+    for k in (1, 3, 500):
+        got = GroupedTopK(mesh).top_k(keys, vals, k)
+        for kk in np.unique(keys):
+            sel = np.sort(vals[keys == kk])[::-1][:k]
+            assert got[int(kk)] == sel.tolist(), (k, kk)
+        assert set(got) == set(np.unique(keys).tolist())
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="k must be positive"):
+        GroupedTopK(mesh).top_k(keys, vals, 0)
