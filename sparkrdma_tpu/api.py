@@ -519,6 +519,18 @@ class Dataset:
         rng = random.Random(seed)
         return self.filter(lambda _x: rng.random() < fraction)
 
+    def top_k_per_key(self, k: int,
+                      num_partitions: Optional[int] = None) -> "Dataset":
+        """Top-k values per key, descending (the rank/LIMIT-per-group
+        shape; device-plane analog: models/topk.py GroupedTopK)."""
+        import heapq
+
+        if k <= 0:
+            raise ValueError(f"k must be positive: {k}")
+        return self.group_by_key(num_partitions).map_values(
+            lambda vs: heapq.nlargest(k, list(vs))
+        )
+
     def combine_by_key(self, create_combiner, merge_value, merge_combiners,
                        num_partitions: Optional[int] = None) -> "Dataset":
         """The general combiner (Spark combineByKey; the reference's

@@ -207,3 +207,18 @@ def test_dataset_cache_materializes_once(ctx):
     assert sorted(cached.collect()) == sorted(x * 2 for x in range(20))
     assert cached.count() == 20
     assert len(calls) == 20  # cached: chain ran once
+
+
+def test_dataset_top_k_per_key(ctx):
+    rng = __import__("random").Random(7)
+    data = [(i % 5, rng.randrange(-100, 100)) for i in range(300)]
+    got = dict(
+        ctx.parallelize(data, num_slices=4)
+        .top_k_per_key(3, num_partitions=4)
+        .collect()
+    )
+    for kk in range(5):
+        want = sorted((v for q, v in data if q == kk), reverse=True)[:3]
+        assert list(got[kk]) == want
+    with pytest.raises(ValueError, match="k must be positive"):
+        ctx.parallelize(data, num_slices=2).top_k_per_key(0)
