@@ -178,13 +178,20 @@ class TpuShuffleContext:
         return KeyedAggregator(mesh).aggregate(keys, vals)
 
     def device_join(self, fact_keys, fact_vals, dim_keys, dim_vals,
-                    broadcast: bool = False, mesh=None):
-        """Inner equi-join on the device mesh: exchange (hash) or
-        broadcast schedule."""
+                    broadcast: bool = False, mesh=None, how: str = "inner"):
+        """Equi-join on the device mesh: exchange (hash) or broadcast
+        schedule; ``how`` = inner|left_outer|semi|anti."""
         from sparkrdma_tpu.models.join import BroadcastJoiner, HashJoiner
 
         joiner = (BroadcastJoiner if broadcast else HashJoiner)(mesh)
-        return joiner.join(fact_keys, fact_vals, dim_keys, dim_vals)
+        return joiner.join(fact_keys, fact_vals, dim_keys, dim_vals,
+                           how=how)
+
+    def device_top_k(self, keys, vals, k: int, mesh=None):
+        """Grouped top-k on the device mesh (rank/LIMIT per group)."""
+        from sparkrdma_tpu.models.topk import GroupedTopK
+
+        return GroupedTopK(mesh).top_k(keys, vals, k)
 
     # -- task running -------------------------------------------------------
     def _run_tasks(self, tasks: Sequence[Tuple[int, Callable[[], Any]]]) -> List[Any]:

@@ -222,3 +222,19 @@ def test_dataset_top_k_per_key(ctx):
         assert list(got[kk]) == want
     with pytest.raises(ValueError, match="k must be positive"):
         ctx.parallelize(data, num_slices=2).top_k_per_key(0)
+
+
+def test_device_top_k_and_join_how_via_context(ctx):
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 9, 2000).astype(np.int32)
+    vals = rng.integers(-100, 100, 2000).astype(np.int32)
+    top = ctx.device_top_k(keys, vals, 2)
+    for kk in np.unique(keys):
+        want = np.sort(vals[keys == kk])[::-1][:2].tolist()
+        assert top[int(kk)] == want
+    fk = np.array([1, 2, 9], np.int32)
+    fv = np.array([10, 20, 90], np.int32)
+    dk = np.array([1, 2], np.int32)
+    dv = np.array([5, 6], np.int32)
+    k_, v_ = ctx.device_join(fk, fv, dk, dv, how="anti")
+    assert k_.tolist() == [9] and v_.tolist() == [90]
