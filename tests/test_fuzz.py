@@ -167,3 +167,22 @@ def test_fuzz_join_aggregate(mesh, devices):
             st = got[g]
             assert (st.sum - s) % (1 << 32) == 0, (i, g)
             assert (st.count, st.min, st.max) == (c, mn, mx), (i, g)
+
+
+def test_fuzz_grouped_topk(mesh, devices):
+    """Grouped top-k fuzzed vs numpy: random k, cardinality, skew."""
+    from sparkrdma_tpu.models.topk import GroupedTopK
+
+    model = GroupedTopK(mesh)
+    rng = np.random.default_rng(2100)
+    for i in range(5):
+        n = int(rng.choice((16, 999, 4096)))
+        card = int(rng.choice((1, 13, 300)))
+        k = int(rng.choice((1, 2, 7, 64)))
+        keys = rng.integers(0, card, n, dtype=np.int32)
+        vals = rng.integers(-(1 << 20), 1 << 20, n, dtype=np.int32)
+        got = model.top_k(keys, vals, k)
+        assert set(got) == set(np.unique(keys).tolist()), f"case {i}"
+        for kk in np.unique(keys):
+            want = np.sort(vals[keys == kk])[::-1][:k].tolist()
+            assert got[int(kk)] == want, (i, kk, k)
