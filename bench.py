@@ -36,7 +36,28 @@ ITERS = 20
 
 
 def main():
+    # the tunneled backend can hang indefinitely at init when the
+    # remote grant is wedged (see tools/TPU_TODO.md); fail loudly with
+    # a diagnostic instead of hanging the driver's bench run
+    import os
+    import sys
+    import threading
+
+    def _watchdog():
+        print(
+            "bench.py: device backend unresponsive for 300s "
+            "(tunneled TPU grant wedged?) — aborting instead of "
+            "hanging; see tools/TPU_TODO.md",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(3)
+
+    timer = threading.Timer(300, _watchdog)
+    timer.daemon = True
+    timer.start()
     mesh = make_mesh()
+    jax.block_until_ready(jnp.zeros(8))  # backend truly alive
+    timer.cancel()
     sorter = TeraSorter(mesh)
     rng = np.random.default_rng(42)
     keys = jnp.asarray(
