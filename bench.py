@@ -18,6 +18,9 @@ __graft_entry__.dryrun_multichip).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -34,25 +37,73 @@ N_RECORDS = 1 << 24  # 16.7M records x 8B (int32 key + int32 val) = 134 MB
 WARMUP = 2
 ITERS = 20
 
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "assert int(jnp.sum(jnp.arange(100))) == 4950; "
+    "print('BACKEND_OK', flush=True)"
+)
+
+
+def _probe_backend(timeout_s=150, attempts=2):
+    """Liveness-check the device backend in a DISPOSABLE subprocess.
+
+    The tunneled backend can hang indefinitely at init when the remote
+    grant is wedged (a client SIGTERM'd mid-RPC holds it for hours —
+    see tools/TPU_TODO.md).  Probing in a throwaway child means the
+    main bench process never issues a device RPC until the backend is
+    known-good, and is never the process that gets killed mid-RPC.
+    Returns None when alive, else a short diagnostic string.
+    """
+    last = "unknown"
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            last = (f"probe attempt {attempt + 1}: no response in "
+                    f"{timeout_s}s (backend init hang — wedged grant?)")
+            print(f"# {last}", file=sys.stderr, flush=True)
+            continue
+        if r.returncode == 0 and "BACKEND_OK" in r.stdout:
+            return None
+        tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+        last = (f"probe attempt {attempt + 1}: rc={r.returncode} "
+                + " | ".join(tail))
+        print(f"# {last}", file=sys.stderr, flush=True)
+        time.sleep(5)
+    return last
+
 
 def main():
-    # the tunneled backend can hang indefinitely at init when the
-    # remote grant is wedged (see tools/TPU_TODO.md); fail loudly with
-    # a diagnostic instead of hanging the driver's bench run
-    import os
-    import sys
+    err = _probe_backend()
+    if err is not None:
+        # structured record the driver can tell apart from a perf
+        # regression: value/vs_baseline null, error names the cause
+        print(json.dumps({
+            "metric": "terasort shuffle+sort throughput per chip",
+            "value": None,
+            "unit": "GB/s/chip",
+            "vs_baseline": None,
+            "error": f"backend_unreachable: {err}",
+        }))
+        return
+
     import threading
 
+    # second line of defense: the probe passed but the grant could
+    # still wedge mid-run; abort loudly rather than hang the driver
     def _watchdog():
         print(
-            "bench.py: device backend unresponsive for 300s "
-            "(tunneled TPU grant wedged?) — aborting instead of "
-            "hanging; see tools/TPU_TODO.md",
+            "bench.py: device backend unresponsive for 600s after a "
+            "successful pre-flight probe — aborting; see "
+            "tools/TPU_TODO.md",
             file=sys.stderr, flush=True,
         )
         os._exit(3)
 
-    timer = threading.Timer(300, _watchdog)
+    timer = threading.Timer(600, _watchdog)
     timer.daemon = True
     timer.start()
     mesh = make_mesh()
@@ -132,19 +183,25 @@ def _try_pallas_engine(keys, vals, dt_lax):
     """Time the Pallas two-phase sort; returns secs/iter or None.
     Verifies exactness (count + sortedness on a sampled stride) before
     trusting any number."""
-    from sparkrdma_tpu.ops.sort_kernel import sort_pairs_full
+    from sparkrdma_tpu.ops.sort_kernel import bucket_cap, sort_pairs_full
 
-    fn = jax.jit(
-        lambda k, v: sort_pairs_full(
+    def run(k, v):
+        ok, ov, valid, _fn, overflow = sort_pairs_full(
             k, v, block_rows=512, n_buckets=16
-        )[:3]
-    )
+        )
+        return ok, ov, valid, overflow
+
+    fn = jax.jit(run)
 
     def fence1(x):
         np.asarray(jax.device_get(x.reshape(-1)[-1:]))
 
-    ok, ov, valid = fn(keys, vals)
+    ok, ov, valid, overflow = fn(keys, vals)
     fence1(valid)
+    # overflow contract (ops/sort_kernel.py): outputs are garbage if
+    # any bucket exceeded cap
+    if int(jax.device_get(overflow)) > bucket_cap(N_RECORDS, 16):
+        return None
     valid_h = np.asarray(jax.device_get(valid))
     if int(valid_h.sum()) != N_RECORDS:
         return None
@@ -155,7 +212,7 @@ def _try_pallas_engine(keys, vals, dt_lax):
         return None
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        ok, ov, valid = fn(keys, vals)
+        ok, ov, valid, overflow = fn(keys, vals)
     fence1(valid)
     return (time.perf_counter() - t0) / ITERS
 

@@ -322,6 +322,9 @@ class TpuShuffleContext:
         if self._stopped:
             return
         self._stopped = True
+        # quiesce the driver's failure-detection plane FIRST: stopping
+        # executors below is deliberate, not a failure to report
+        self.driver.quiesce()
         for p in self._pools:
             p.shutdown(wait=True)
         for m in self.executors + [self.driver]:
@@ -350,12 +353,19 @@ class Dataset:
 
     # -- narrow transformations (lazy, fused) --------------------------------
     def _chain(self, f: Callable[[List[Any]], List[Any]]) -> "Dataset":
+        return self._chain_indexed(lambda part, _pidx, f=f: f(part))
+
+    def _chain_indexed(
+        self, f: Callable[[List[Any], int], List[Any]]
+    ) -> "Dataset":
+        """Chain a narrow transform that also receives the partition
+        index (needed by index-seeded ops like sample)."""
         prev = self._transform
         if prev is None:
             fused = f
         else:
-            def fused(part, prev=prev, f=f):
-                return f(prev(part))
+            def fused(part, pidx, prev=prev, f=f):
+                return f(prev(part, pidx), pidx)
         return Dataset(self.ctx, self._parts, fused)
 
     def map(self, f: Callable[[Any], Any]) -> "Dataset":
@@ -386,7 +396,7 @@ class Dataset:
         t = self._transform
         E = len(self.ctx.executors)
         out = self.ctx._run_tasks([
-            (i % E, (lambda p=p, t=t: t(list(p))))
+            (i % E, (lambda p=p, t=t, i=i: t(list(p), i)))
             for i, p in enumerate(self._parts)
         ])
         return out
@@ -520,11 +530,20 @@ class Dataset:
         return got[0]
 
     def sample(self, fraction: float, seed: int = 0) -> "Dataset":
-        """Bernoulli sample without replacement."""
+        """Bernoulli sample without replacement.
+
+        Deterministic like Spark's seeded sample: the decision stream
+        is re-derived from ``(seed, partition_index)`` on every
+        materialization, so repeated actions on the same sampled
+        dataset (count() then collect()) see identical rows."""
         if not (0.0 <= fraction <= 1.0):
             raise ValueError(f"fraction must be in [0, 1]: {fraction}")
-        rng = random.Random(seed)
-        return self.filter(lambda _x: rng.random() < fraction)
+
+        def sample_part(part, pidx, seed=seed, fraction=fraction):
+            rng = random.Random(hash((seed, pidx)))
+            return [x for x in part if rng.random() < fraction]
+
+        return self._chain_indexed(sample_part)
 
     def top_k_per_key(self, k: int,
                       num_partitions: Optional[int] = None) -> "Dataset":

@@ -248,6 +248,25 @@ class TpuShuffleConf:
         return str(self.get("readPlane", "host")).lower()
 
     @property
+    def bulk_window_maps(self) -> int:
+        """Bulk mode's incremental-plan window: the driver cuts an
+        exchange plan every time this many NEW maps have published and
+        filled (the last window takes the remainder), so reducers start
+        moving bytes while stragglers still write — the collective
+        analog of the reference's windowed fetch overlap
+        (RdmaShuffleFetcherIterator.scala:241-251 +
+        RdmaMapTaskOutput.scala:41-44 partial fills).  0 (default)
+        keeps the single all-maps barrier."""
+        return self._int_in_range("bulkWindowMaps", 0, 0, 1 << 20)
+
+    @property
+    def bulk_barrier_timeout_ms(self) -> int:
+        """How long an in-process bulk-session contributor waits for
+        the other participating executors before failing the
+        exchange."""
+        return self._time_ms("bulkBarrierTimeout", 120_000)
+
+    @property
     def device_arena_bytes(self) -> int:
         """Capacity of each executor's persistent HBM arena on the
         collective plane (all arenas share one capacity so the pack

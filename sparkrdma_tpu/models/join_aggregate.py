@@ -186,6 +186,15 @@ class BroadcastJoinAggregator(ExchangeModel):
         gk_h = gk_h.view(signed).astype(np.dtype(lk.dtype), copy=False)
         sums_h, counts_h = np.asarray(sums), np.asarray(counts)
         mins_h, maxs_h = np.asarray(mins), np.asarray(maxs)
+        # preserve the aggregate dtype: agg_val_fn may return floats
+        # (the +/-inf min/max identities support them) — int() here
+        # would silently truncate
+        def conv_for(a):
+            return float if np.issubdtype(a.dtype, np.floating) else int
+
+        c_sum, c_min, c_max = (
+            conv_for(sums_h), conv_for(mins_h), conv_for(maxs_h)
+        )
         out: Dict[int, KeyStats] = {}
         (idx,) = (counts_h > 0).nonzero()
         for i in idx:
@@ -193,15 +202,15 @@ class BroadcastJoinAggregator(ExchangeModel):
             prev = out.get(key)
             if prev is None:
                 out[key] = KeyStats(
-                    int(sums_h[i]), int(counts_h[i]),
-                    int(mins_h[i]), int(maxs_h[i]),
+                    c_sum(sums_h[i]), int(counts_h[i]),
+                    c_min(mins_h[i]), c_max(maxs_h[i]),
                 )
             else:
                 out[key] = KeyStats(
-                    prev.sum + int(sums_h[i]),
+                    prev.sum + c_sum(sums_h[i]),
                     prev.count + int(counts_h[i]),
-                    min(prev.min, int(mins_h[i])),
-                    max(prev.max, int(maxs_h[i])),
+                    min(prev.min, c_min(mins_h[i])),
+                    max(prev.max, c_max(maxs_h[i])),
                 )
         return out
 

@@ -33,6 +33,19 @@ from jax.experimental import pallas as pl
 LANES = 128
 
 
+class BucketOverflowError(RuntimeError):
+    """A bucket exceeded its capacity in sort_pairs_full: the sorted
+    output is garbage (see the overflow contract in its docstring)."""
+
+
+def bucket_cap(n: int, n_buckets: int = 16,
+               cap_factor: float = 1.4) -> int:
+    """Per-bucket row capacity sort_pairs_full allocates for ``n``
+    rows; a bucket fill above this invalidates the whole result."""
+    cap = int(np.ceil(n / n_buckets * cap_factor))
+    return (cap + LANES - 1) // LANES * LANES
+
+
 def _partner(x, d, R, interpret):
     """partner[i] = x[i ^ d] over the flat row-major [R, 128] order."""
     if d < LANES:
@@ -135,9 +148,19 @@ def sort_pairs_full(keys, vals, block_rows: int = 1024,
     """Full (key, value) sort: Pallas block sorts → equal-frequency
     splitters from block quantiles → window-copy bucket assembly (the
     terasort pattern on one chip) → batched bucket sort.  Returns
-    host-trimmable ``(keys', vals', valid)`` of padded length
+    ``(keys', vals', valid, fn, overflow)`` of padded length
     ``n_buckets * cap`` with ``valid`` marking real slots (padding
     sorts to each bucket's tail).
+
+    OVERFLOW CONTRACT: when splitters are badly skewed a bucket can
+    receive more than ``cap = bucket_cap(n, n_buckets, cap_factor)``
+    rows; the assembly then clamps its writes and ALL outputs are
+    garbage (earlier rows silently overwritten, invalid slots marked
+    valid).  Callers MUST verify ``overflow <= bucket_cap(...)``
+    (device-side, no sync needed: it is the max per-bucket fill) and
+    discard the result or retry with a higher ``cap_factor`` when it
+    fails — or call :func:`sort_pairs_full_checked`, which raises
+    ``BucketOverflowError``.
 
     Exactness is pinned by tests vs numpy; wire into the sorter only
     after on-chip profiling (module docstring).
@@ -166,8 +189,7 @@ def sort_pairs_full(keys, vals, block_rows: int = 1024,
     edges = jnp.concatenate([zeros, edges, fulls], axis=1)
     counts = edges[:, 1:] - edges[:, :-1]         # [nb, n_buckets]
     starts = edges[:, :-1]
-    cap = int(np.ceil(n / n_buckets * cap_factor))
-    cap = (cap + LANES - 1) // LANES * LANES
+    cap = bucket_cap(n, n_buckets, cap_factor)
     sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
     bucket_off = jnp.cumsum(counts, axis=0) - counts  # offset of block b
     kp = jnp.concatenate(
@@ -218,3 +240,27 @@ def sort_pairs_full(keys, vals, block_rows: int = 1024,
         ok.reshape(-1), ov.reshape(-1), valid.reshape(-1),
         fn, overflow,
     )
+
+
+def sort_pairs_full_checked(keys, vals, block_rows: int = 1024,
+                            n_buckets: int = 16,
+                            cap_factor: float = 1.4,
+                            interpret: bool = False):
+    """sort_pairs_full with the overflow contract enforced: syncs the
+    per-bucket max fill to the host and raises
+    :class:`BucketOverflowError` instead of returning garbage.  Use the
+    raw function + a device-side ``overflow <= bucket_cap(...)`` check
+    when the sync is too expensive."""
+    ok, ov, valid, fn, overflow = sort_pairs_full(
+        keys, vals, block_rows=block_rows, n_buckets=n_buckets,
+        cap_factor=cap_factor, interpret=interpret,
+    )
+    cap = bucket_cap(int(keys.shape[0]), n_buckets, cap_factor)
+    ovf = int(jax.device_get(overflow))
+    if ovf > cap:
+        raise BucketOverflowError(
+            f"bucket fill {ovf} > cap {cap} "
+            f"(n={int(keys.shape[0])}, n_buckets={n_buckets}, "
+            f"cap_factor={cap_factor}) — retry with a higher cap_factor"
+        )
+    return ok, ov, valid, fn, overflow

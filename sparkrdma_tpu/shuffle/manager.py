@@ -294,6 +294,9 @@ class TpuShuffleManager:
         self._membership_epoch = 0
         self._shuffle_epoch: Dict[int, int] = {}
         self._plan_lock = threading.Lock()
+        # bumped (under _plan_lock) on every hello: lets the barrier
+        # detect a hello that raced its pop/requeue of plan waiters
+        self._hello_gen = 0
         self._fetch_pool = (
             ThreadPoolExecutor(max_workers=8, thread_name_prefix="drv-fetch")
             if is_driver
@@ -444,6 +447,13 @@ class TpuShuffleManager:
         the reference gets this signal from CM DISCONNECTED events."""
         if self._stopped:
             return
+        if (isinstance(err, RuntimeError)
+                and "cannot schedule new futures" in str(err)):
+            # executor/transport pools are gone because the process (or
+            # harness) is shutting down — that is quiescence, not an
+            # executor failure; stop probing instead of spamming prunes
+            self._hb_stop.set()
+            return
         with self._executors_lock:
             known = smid in self._executors
         if known:
@@ -496,6 +506,7 @@ class TpuShuffleManager:
         # publish can land before its publisher's hello — separate
         # channels): re-trigger pending barriers
         with self._plan_lock:
+            self._hello_gen += 1
             pending = list(self._plan_waiters.keys())
         for sid in pending:
             self._maybe_answer_plans(sid)
@@ -701,20 +712,28 @@ class TpuShuffleManager:
             return  # more publishes coming; re-checked on each publish
 
         def answer_all():
-            with self._plan_lock:
-                waiters = self._plan_waiters.pop(shuffle_id, [])
-            if not waiters:
-                return
-            plan = self._get_or_build_plan(shuffle_id, num_maps)
-            if plan is _PLAN_WAIT:
+            while True:
+                with self._plan_lock:
+                    gen = self._hello_gen
+                    waiters = self._plan_waiters.pop(shuffle_id, [])
+                if not waiters:
+                    return
+                plan = self._get_or_build_plan(shuffle_id, num_maps)
+                if plan is not _PLAN_WAIT:
+                    break
                 # a publisher's hello hasn't landed yet (publish and
                 # hello race on separate channels): keep the waiters —
-                # _handle_hello re-triggers this barrier
+                # _handle_hello re-triggers this barrier.  A hello that
+                # arrived between our pop and this requeue saw an empty
+                # waiter list and will never re-trigger — detect it via
+                # the generation counter and re-check ourselves.
                 with self._plan_lock:
                     self._plan_waiters.setdefault(
                         shuffle_id, []
                     ).extend(waiters)
-                return
+                    raced = self._hello_gen != gen
+                if not raced:
+                    return
             for msg, channel in waiters:
                 if isinstance(plan, str):
                     reply: RpcMsg = FetchMapStatusFailedMsg(
@@ -1014,14 +1033,24 @@ class TpuShuffleManager:
         with self._executors_lock:
             return list(self._executors)
 
+    def quiesce(self) -> None:
+        """Stop the background liveness plane (heartbeat monitor)
+        WITHOUT tearing the manager down.  Call on the driver before
+        stopping executors: a deliberate shutdown must not race the
+        monitor into reporting healthy executors as dead ("channel to
+        executor N dead — pruning" noise at exit)."""
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._hb_thread = None
+
     def stop(self) -> None:
         """Teardown (reference: RdmaShuffleManager.scala:348-357)."""
         if self._stopped:
             return
         self._stopped = True
-        self._hb_stop.set()
-        if self._hb_thread is not None:
-            self._hb_thread.join(timeout=2.0)
+        self.quiesce()
         if self.stats is not None:
             self.stats.print_stats()
         if self.conf.trace:

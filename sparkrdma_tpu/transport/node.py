@@ -20,9 +20,10 @@ via ``register_passive_channel``.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor, wait
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
@@ -255,21 +256,52 @@ class Node:
         if channels:
             # bounded parallel teardown (reference: stop() waits a
             # teardownListenTimeout window, RdmaNode.java:367-394): a
-            # hung channel must not wedge shutdown forever
+            # hung channel must not wedge shutdown forever.  Plain
+            # DAEMON threads, not a ThreadPoolExecutor: its workers are
+            # non-daemon and concurrent.futures' atexit hook joins
+            # them, so an abandoned wedged stop would still hang
+            # interpreter exit.
             budget = max(
                 self.conf.teardown_listen_timeout_ms / 1000.0,
                 0.05,
             ) * max(1, len(channels))
-            pool = ThreadPoolExecutor(max_workers=min(8, len(channels)))
-            futures = [pool.submit(c.stop) for c in channels]
-            done, not_done = wait(futures, timeout=budget)
-            if not_done:
-                logger.warning(
-                    "node %s teardown: %d channel(s) still stopping "
-                    "after %.1fs — abandoning", self.address,
-                    len(not_done), budget,
+            work: "queue.Queue[Channel]" = queue.Queue()
+            for c in channels:
+                work.put(c)
+
+            def _stop_worker() -> None:
+                while True:
+                    try:
+                        c = work.get_nowait()
+                    except queue.Empty:
+                        return
+                    try:
+                        c.stop()
+                    except Exception:
+                        logger.exception("channel stop failed")
+                    finally:
+                        work.task_done()
+
+            workers = [
+                threading.Thread(
+                    target=_stop_worker, daemon=True,
+                    name=f"node-stop-{i}",
                 )
-            pool.shutdown(wait=not not_done)
+                for i in range(min(8, len(channels)))
+            ]
+            for t in workers:
+                t.start()
+            deadline = time.monotonic() + budget
+            for t in workers:
+                t.join(max(0.0, deadline - time.monotonic()))
+            hung = sum(1 for t in workers if t.is_alive())
+            if hung:
+                logger.warning(
+                    "node %s teardown: %d stop worker(s) still busy "
+                    "after %.1fs — abandoning (daemon threads; they "
+                    "cannot block process exit)", self.address,
+                    hung, budget,
+                )
         self._dispatcher.shutdown(wait=True)
         with self._bulk_lock:
             bulk, self._bulk_pool = self._bulk_pool, None
