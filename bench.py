@@ -44,7 +44,7 @@ _PROBE_CODE = (
 )
 
 
-def _probe_backend(timeout_s=150, attempts=2):
+def _probe_backend(timeout_s=240, attempts=2):
     """Liveness-check the device backend in a DISPOSABLE subprocess.
 
     The tunneled backend can hang indefinitely at init when the remote
@@ -53,6 +53,13 @@ def _probe_backend(timeout_s=150, attempts=2):
     main bench process never issues a device RPC until the backend is
     known-good, and is never the process that gets killed mid-RPC.
     Returns None when alive, else a short diagnostic string.
+
+    Killing a timed-out probe is safe: a client hanging at backend
+    INIT is queued on the grant, not holding it (observed during the
+    round-2 wedge — fresh sessions just queue); the dangerous kill is
+    of a client holding the grant mid-computation, and the probe's
+    compute window after init is <1s.  The generous timeout still
+    comfortably covers a slow-but-healthy cold init (~20-40s compile).
     """
     last = "unknown"
     for attempt in range(attempts):

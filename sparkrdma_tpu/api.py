@@ -273,7 +273,8 @@ class TpuShuffleContext:
 
         E = len(self.executors)
         session = BulkShuffleSession(
-            TileExchange.from_conf(self.conf, make_mesh(E)), E
+            TileExchange.from_conf(self.conf, make_mesh(E)), E,
+            timeout_s=self.conf.bulk_barrier_timeout_ms / 1000.0,
         )
 
         def bulk_task(i: int):
@@ -359,13 +360,23 @@ class Dataset:
         self, f: Callable[[List[Any], int], List[Any]]
     ) -> "Dataset":
         """Chain a narrow transform that also receives the partition
-        index (needed by index-seeded ops like sample)."""
+        index (needed by index-seeded ops like sample).
+
+        A transform carrying ``_columnar_ok = True`` promises to accept
+        a ColumnBatch as well as a record list and return the same
+        kind; a chain where EVERY stage promises this keeps partitions
+        columnar end to end (the vectorized narrow plane), otherwise
+        _materialize falls back to record lists."""
         prev = self._transform
         if prev is None:
             fused = f
         else:
             def fused(part, pidx, prev=prev, f=f):
                 return f(prev(part, pidx), pidx)
+            fused._columnar_ok = (
+                getattr(prev, "_columnar_ok", False)
+                and getattr(f, "_columnar_ok", False)
+            )
         return Dataset(self.ctx, self._parts, fused)
 
     def map(self, f: Callable[[Any], Any]) -> "Dataset":

@@ -494,30 +494,42 @@ class HeartbeatMsg(RpcMsg):
 
 @dataclass(frozen=True)
 class FetchExchangePlanMsg(RpcMsg):
-    """Host asks the driver for the bulk-exchange plan of one shuffle
-    (answered once EVERY registered map has published — the barrier of
-    the bulk-synchronous mode)."""
+    """Host asks the driver for the bulk-exchange plan of one shuffle.
+
+    ``window == -1`` requests the legacy single plan (answered once
+    EVERY registered map has published — the full barrier).  ``window
+    >= 0`` requests incremental plan number ``window``: the driver
+    answers once ``bulkWindowMaps`` new maps (or the remainder) have
+    published AND filled, so reducers exchange early windows while
+    stragglers still write (the collective analog of the reference's
+    windowed fetch overlap,
+    RdmaShuffleFetcherIterator.scala:241-251)."""
 
     requester: ShuffleManagerId
     shuffle_id: int
     callback_id: int
+    window: int = -1
 
     MSG_TYPE = 8
 
     def _payload(self) -> bytes:
         buf = bytearray()
         self.requester.write(buf)
-        buf += struct.pack("<ii", self.shuffle_id, self.callback_id)
+        buf += struct.pack(
+            "<iii", self.shuffle_id, self.callback_id, self.window
+        )
         return bytes(buf)
 
     def _payload_size(self) -> int:
-        return self.requester.serialized_length() + 8
+        return self.requester.serialized_length() + 12
 
     @staticmethod
     def _decode_payload(view: memoryview) -> "FetchExchangePlanMsg":
         smid, off = ShuffleManagerId.read(view, 0)
-        shuffle_id, callback_id = struct.unpack_from("<ii", view, off)
-        return FetchExchangePlanMsg(smid, shuffle_id, callback_id)
+        shuffle_id, callback_id, window = struct.unpack_from(
+            "<iii", view, off
+        )
+        return FetchExchangePlanMsg(smid, shuffle_id, callback_id, window)
 
 
 @dataclass(frozen=True)
@@ -532,16 +544,25 @@ class ExchangePlanMsg(RpcMsg):
     hosts: Tuple[ShuffleManagerId, ...]          # canonical order
     lengths: Tuple[int, ...]                     # row-major [E * E]
     manifest: Tuple[Tuple[Tuple[int, int, int], ...], ...]  # [E][blocks]
+    window: int = -1            # -1: full-barrier plan; >=0: window no.
+    final: bool = True          # True: no window follows this one
+    my_maps: Tuple[int, ...] = ()  # requester's map_ids in this window
 
     MSG_TYPE = 9
 
-    def __init__(self, callback_id, hosts, lengths, manifest):
+    def __init__(self, callback_id, hosts, lengths, manifest,
+                 window: int = -1, final: bool = True, my_maps=()):
         object.__setattr__(self, "callback_id", callback_id)
         object.__setattr__(self, "hosts", tuple(hosts))
         object.__setattr__(self, "lengths", tuple(int(x) for x in lengths))
         object.__setattr__(
             self, "manifest",
             tuple(tuple(tuple(b) for b in row) for row in manifest),
+        )
+        object.__setattr__(self, "window", int(window))
+        object.__setattr__(self, "final", bool(final))
+        object.__setattr__(
+            self, "my_maps", tuple(int(m) for m in my_maps)
         )
         e = len(self.hosts)
         if len(self.lengths) != e * e or len(self.manifest) != e:
@@ -560,6 +581,11 @@ class ExchangePlanMsg(RpcMsg):
             buf += struct.pack("<i", len(row))
             for map_id, reduce_id, length in row:
                 buf += struct.pack("<iiq", map_id, reduce_id, length)
+        buf += struct.pack(
+            "<iBi", self.window, int(self.final), len(self.my_maps)
+        )
+        for m in self.my_maps:
+            buf += struct.pack("<i", m)
         return bytes(buf)
 
     def _payload_size(self) -> int:
@@ -568,6 +594,7 @@ class ExchangePlanMsg(RpcMsg):
             + sum(h.serialized_length() for h in self.hosts)
             + 8 * len(self.lengths)
             + sum(4 + 16 * len(row) for row in self.manifest)
+            + 9 + 4 * len(self.my_maps)
         )
 
     @staticmethod
@@ -590,7 +617,13 @@ class ExchangePlanMsg(RpcMsg):
                 off += 16
                 row.append((m, r, n))
             manifest.append(tuple(row))
-        return ExchangePlanMsg(callback_id, hosts, lengths, manifest)
+        window, final, n_my = struct.unpack_from("<iBi", view, off)
+        off += 9
+        my_maps = struct.unpack_from(f"<{n_my}i", view, off) if n_my else ()
+        return ExchangePlanMsg(
+            callback_id, hosts, lengths, manifest,
+            window=window, final=bool(final), my_maps=my_maps,
+        )
 
 
 MSG_TYPES: Dict[int, Type[RpcMsg]] = {

@@ -33,6 +33,7 @@ backends scale — symmetric collectives instead of per-pair streams.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -52,9 +53,11 @@ class BulkShuffleSession:
     is the barrier (each process fills only its own addressable rows).
     """
 
-    def __init__(self, exchange: TileExchange, n_hosts: int):
+    def __init__(self, exchange: TileExchange, n_hosts: int,
+                 timeout_s: float = 120.0):
         self.exchange = exchange
         self.n_hosts = n_hosts
+        self.timeout_s = timeout_s
         self._cv = threading.Condition()
         self._rows = {}
         self._lengths = None
@@ -117,10 +120,11 @@ class BulkShuffleSession:
                 self._cv.notify_all()
             else:
                 while self._gen == gen and self._aborted is None:
-                    if not self._cv.wait(timeout=120):
+                    if not self._cv.wait(timeout=self.timeout_s):
                         raise TimeoutError(
-                            "bulk exchange barrier: not every host "
-                            "contributed within 120s"
+                            f"bulk exchange barrier: not every host "
+                            f"contributed within {self.timeout_s:.0f}s "
+                            f"(conf spark.shuffle.tpu.bulkBarrierTimeout)"
                         )
                 if self._aborted is not None:
                     raise RuntimeError(
@@ -150,9 +154,13 @@ class BulkExchangeReader:
                     manager.conf.exchange_max_rounds_in_flight
                 ),
             )
+        # (window, monotonic completion time, payload bytes) per
+        # completed window exchange — lets tests/metrics observe bytes
+        # landing while straggler maps are still writing
+        self.window_events: List[tuple] = []
 
     # -- step 2: the plan barrier -------------------------------------------
-    def _fetch_plan(self, shuffle_id: int):
+    def _fetch_plan(self, shuffle_id: int, window: int = -1):
         mgr = self.manager
         event = threading.Event()
         box = {}
@@ -169,7 +177,9 @@ class BulkExchangeReader:
         try:
             mgr._send_msg(
                 mgr._driver_channel(),
-                FetchExchangePlanMsg(mgr.local_smid, shuffle_id, cb_id),
+                FetchExchangePlanMsg(
+                    mgr.local_smid, shuffle_id, cb_id, window=window
+                ),
                 on_failure=lambda e: (
                     box.setdefault("error", str(e)), event.set()
                 ),
@@ -211,13 +221,29 @@ class BulkExchangeReader:
         )
 
     # -- steps 3-4: exchange + consume --------------------------------------
-    def _exchange_rows(self, shuffle_id: int):
+    def _exchange_all(self, shuffle_id: int):
+        """Run the shuffle's exchange(s) eagerly and return a list of
+        (plan, E, row) — ONE entry for the legacy full barrier, one
+        per window when ``bulkWindowMaps`` > 0 (each window's exchange
+        runs as soon as its plan lands, overlapping straggler maps)."""
+        if self.manager.conf.bulk_window_maps <= 0:
+            return [self._exchange_rows(shuffle_id, window=-1)]
+        out = []
+        w = 0
+        while True:
+            plan, E, row = self._exchange_rows(shuffle_id, window=w)
+            out.append((plan, E, row))
+            if plan.final:
+                return out
+            w += 1
+
+    def _exchange_rows(self, shuffle_id: int, window: int = -1):
         """Plan barrier + stream build + ONE collective exchange; all
         EAGER (a lazily-deferred exchange would leave every other
         participant blocked in the collective).  Returns (plan, E,
         row) where row[s] is the received stream from source s."""
         mgr = self.manager
-        plan = self._fetch_plan(shuffle_id)
+        plan = self._fetch_plan(shuffle_id, window=window)
         hosts = list(plan.hosts)
         E = len(hosts)
         try:
@@ -234,8 +260,13 @@ class BulkExchangeReader:
         # in the canonical order (map_id asc, reduce_id asc, empties
         # skipped) — the exact order the driver's plan assumed.  A host
         # that ran no map tasks still participates (the collective
-        # needs every member) with all-empty source streams.
-        my_maps = mgr.resolver.map_ids(shuffle_id)
+        # needs every member) with all-empty source streams.  A
+        # windowed plan names exactly which of my maps belong to THIS
+        # window (the driver assigns maps to windows as fills land).
+        if window >= 0:
+            my_maps = sorted(plan.my_maps)
+        else:
+            my_maps = mgr.resolver.map_ids(shuffle_id)
         streams: List[List[bytes]] = [[b""] * E for _ in range(E)]
         if my_maps:
             num_parts = mgr.resolver.num_partitions(shuffle_id)
@@ -271,26 +302,30 @@ class BulkExchangeReader:
 
         with get_tracer().span(
             "shuffle.bulk.exchange", shuffle=shuffle_id, hosts=E,
-            payload_bytes=int(lengths.sum()),
+            window=window, payload_bytes=int(lengths.sum()),
         ):
             result = self._run_exchange(shuffle_id, me, streams, lengths)
+        self.window_events.append(
+            (window, time.monotonic(), int(lengths.sum()))
+        )
         return plan, E, result[me]
 
     def read(self, shuffle_id: int) -> Iterator:
-        """Blocking bulk read of this host's partitions (the exchange
-        runs eagerly in this call; the returned iterator only
-        deserializes).  Yields records."""
-        plan, E, row = self._exchange_rows(shuffle_id)
+        """Blocking bulk read of this host's partitions (the
+        exchange(s) run eagerly in this call; the returned iterator
+        only deserializes).  Yields records."""
+        exchanged = self._exchange_all(shuffle_id)
         deser = self.manager.serializer.deserialize
 
         def _records():
-            for s in range(E):
-                data = row[s]
-                off = 0
-                for _map_id, _reduce_id, n in plan.manifest[s]:
-                    block = data[off : off + n]
-                    off += n
-                    yield from deser(block)
+            for plan, E, row in exchanged:
+                for s in range(E):
+                    data = row[s]
+                    off = 0
+                    for _map_id, _reduce_id, n in plan.manifest[s]:
+                        block = data[off : off + n]
+                        off += n
+                        yield from deser(block)
 
         return _records()
 
@@ -308,17 +343,18 @@ class BulkExchangeReader:
         """Lowest-level consumption: yields (reduce_id, raw block
         bytes) pairs after the exchange — lets columnar consumers feed
         blocks straight to ``deserialize_columns`` (the vectorized
-        path) instead of per-record tuples.  The exchange runs eagerly
-        before the first yield."""
-        plan, E, row = self._exchange_rows(shuffle_id)
+        path) instead of per-record tuples.  The exchange(s) run
+        eagerly before the first yield."""
+        exchanged = self._exchange_all(shuffle_id)
 
         def _blocks():
-            for s in range(E):
-                data = row[s]
-                off = 0
-                for _map_id, reduce_id, n in plan.manifest[s]:
-                    block = data[off : off + n]
-                    off += n
-                    yield reduce_id, block
+            for plan, E, row in exchanged:
+                for s in range(E):
+                    data = row[s]
+                    off = 0
+                    for _map_id, reduce_id, n in plan.manifest[s]:
+                        block = data[off : off + n]
+                        off += n
+                        yield reduce_id, block
 
         return _blocks()

@@ -297,6 +297,10 @@ class TpuShuffleManager:
         # bumped (under _plan_lock) on every hello: lets the barrier
         # detect a hello that raced its pop/requeue of plan waiters
         self._hello_gen = 0
+        # incremental (windowed) bulk plans: per-shuffle window state —
+        # built in order under _window_lock (see _maybe_answer_windows)
+        self._window_state: Dict[int, dict] = {}
+        self._window_lock = threading.RLock()
         self._fetch_pool = (
             ThreadPoolExecutor(max_workers=8, thread_name_prefix="drv-fetch")
             if is_driver
@@ -407,6 +411,8 @@ class TpuShuffleManager:
             self._hb_seq += 1
             now = _time.monotonic()
             for smid in self.executors:
+                if self._hb_stop.is_set():
+                    break  # quiesced mid-sweep: stop probing/pruning
                 # the monitor must survive anything one executor's
                 # bookkeeping throws — a dead monitor silently disables
                 # failure detection for the rest of the job
@@ -445,7 +451,7 @@ class TpuShuffleManager:
         """A control-plane send to an executor failed outright: its
         channel is dead (partition / closed peer).  Prune immediately —
         the reference gets this signal from CM DISCONNECTED events."""
-        if self._stopped:
+        if self._stopped or self._hb_stop.is_set():
             return
         if (isinstance(err, RuntimeError)
                 and "cannot schedule new futures" in str(err)):
@@ -658,14 +664,9 @@ class TpuShuffleManager:
     def _handle_fetch_plan(self, msg: FetchExchangePlanMsg,
                            channel: Channel) -> None:
         assert self.is_driver, "fetch-plan must only reach the driver"
+
         def reply_failed(reason: str) -> None:
-            try:
-                self._send_msg(
-                    channel.reply_channel(),
-                    FetchMapStatusFailedMsg(msg.callback_id, reason),
-                )
-            except Exception:
-                logger.exception("plan failure reply failed")
+            self._reply_plan_failed(channel, msg.callback_id, reason)
 
         if msg.shuffle_id not in self._shuffle_num_maps:
             reply_failed(
@@ -693,16 +694,23 @@ class TpuShuffleManager:
         self._maybe_answer_plans(msg.shuffle_id)
 
     def _maybe_answer_plans(self, shuffle_id: int) -> None:
-        """Answer pending plan requests once EVERY registered map has
-        published and filled (the bulk-synchronous barrier)."""
+        """Answer pending plan requests: full-barrier waiters
+        (``window == -1``) once EVERY registered map has published and
+        filled; windowed waiters (``window >= 0``) as soon as their
+        window's map quota is met (_maybe_answer_windows)."""
         if not self.is_driver:
             return
         num_maps = self._shuffle_num_maps.get(shuffle_id)
         if num_maps is None:
             return
         with self._plan_lock:
-            if not self._plan_waiters.get(shuffle_id):
-                return
+            waiters_now = self._plan_waiters.get(shuffle_id, [])
+            any_windowed = any(m.window >= 0 for m, _ in waiters_now)
+            any_legacy = any(m.window < 0 for m, _ in waiters_now)
+        if any_windowed:
+            self._maybe_answer_windows(shuffle_id, num_maps)
+        if not any_legacy:
+            return
         with self._outputs_lock:
             mtos = [
                 m for bm in self._outputs.get(shuffle_id, {}).values()
@@ -715,7 +723,9 @@ class TpuShuffleManager:
             while True:
                 with self._plan_lock:
                     gen = self._hello_gen
-                    waiters = self._plan_waiters.pop(shuffle_id, [])
+                waiters = self._take_plan_waiters(
+                    shuffle_id, lambda m: m.window < 0
+                )
                 if not waiters:
                     return
                 plan = self._get_or_build_plan(shuffle_id, num_maps)
@@ -843,6 +853,245 @@ class TpuShuffleManager:
             self._plan_cache.setdefault(shuffle_id, plan)
             return self._plan_cache[shuffle_id]
 
+    # -- incremental (windowed) bulk plans -----------------------------------
+    # The overlap the reference gets from partial-fill futures + a
+    # bounded in-flight window (RdmaMapTaskOutput.scala:41-44,
+    # RdmaShuffleFetcherIterator.scala:241-251), re-architected for
+    # symmetric collectives: instead of one all-maps barrier the driver
+    # cuts plan windows of `bulkWindowMaps` maps as they publish+fill;
+    # every host runs one collective per window, so early bytes move
+    # while straggler maps still write.
+
+    def _maybe_answer_windows(self, shuffle_id: int,
+                              num_maps: int) -> None:
+        with self._window_lock:
+            st = self._window_state.setdefault(shuffle_id, {
+                "hosts": None,      # pinned at first window build
+                "idx": None,
+                "assigned": {},     # host → set(map_id)
+                "total_assigned": 0,
+                "next": 0,          # next window number to build
+                "plans": {},        # window → (flat, manifest, final,
+                                    #           my_maps_by_host)
+                "failure": None,    # sticky error string
+                "hooked": set(),    # id(mto) with fill retriggers
+            })
+            progress = True
+            while progress:
+                progress = False
+                with self._plan_lock:
+                    win = [
+                        w for w in self._plan_waiters.get(shuffle_id, [])
+                        if w[0].window >= 0
+                    ]
+                if not win:
+                    return
+                fail = st["failure"]
+                if fail is None and (
+                    self._shuffle_epoch.get(shuffle_id)
+                    != self._membership_epoch
+                ):
+                    fail = st["failure"] = (
+                        "membership changed since shuffle registration "
+                        "(executor lost) — retry the stage"
+                    )
+                if fail is not None:
+                    self._fail_window_waiters(shuffle_id, fail)
+                    return
+                if any(m.window == st["next"] for m, _ in win):
+                    if self._try_build_window(shuffle_id, num_maps, st):
+                        progress = True
+                        if st["failure"] is not None:
+                            continue  # dispatch the failure above
+                # answer every waiter whose window is already built
+                done_all = st["total_assigned"] >= num_maps
+                taken = self._take_plan_waiters(
+                    shuffle_id,
+                    lambda m: 0 <= m.window < st["next"]
+                    or (done_all and m.window >= st["next"]),
+                )
+                ready = [w for w in taken if w[0].window < st["next"]]
+                beyond = [w for w in taken if w[0].window >= st["next"]]
+                for m, ch in ready:
+                    self._send_window_plan(m, ch, st)
+                    progress = True
+                for m, ch in beyond:
+                    self._reply_plan_failed(
+                        ch, m.callback_id,
+                        f"window {m.window} is beyond the final window "
+                        f"({st['next'] - 1})",
+                    )
+
+    def _try_build_window(self, shuffle_id: int, num_maps: int,
+                          st: dict) -> bool:
+        """Build window ``st['next']`` if its quota of published+filled
+        maps is available.  Returns True when state advanced (a window
+        was built OR a sticky failure was recorded)."""
+        remaining = num_maps - st["total_assigned"]
+        if remaining <= 0:
+            if num_maps == 0 and st["next"] == 0:
+                # zero-map shuffle (empty upstream stage): cut one
+                # empty FINAL window so readers complete with no
+                # records, exactly like the legacy full-barrier path
+                self._pin_window_hosts(st, ())
+                E = len(st["hosts"])
+                st["plans"][0] = (
+                    [0] * (E * E),
+                    [[[] for _ in range(E)] for _ in range(E)],
+                    True, {},
+                )
+                st["next"] = 1
+                return True
+            return False
+        with self._outputs_lock:
+            snapshot = {
+                h: dict(bm)
+                for h, bm in self._outputs.get(shuffle_id, {}).items()
+            }
+        eligible: List = []
+        pending: List = []
+        for host, by_map in snapshot.items():
+            assigned = st["assigned"].get(host, set())
+            for map_id, mto in by_map.items():
+                if map_id in assigned:
+                    continue
+                f = mto.fill_future
+                if not f.done():
+                    pending.append(mto)
+                elif f.exception() is not None:
+                    st["failure"] = (
+                        f"map {map_id} of {host.host}:{host.port} "
+                        f"failed before publish completed "
+                        f"(executor removed)"
+                    )
+                    return True
+                else:
+                    eligible.append((host, map_id, mto))
+        window_maps = self.conf.bulk_window_maps
+        need = min(window_maps, remaining) if window_maps > 0 else remaining
+        if len(eligible) < need:
+            # not enough filled maps yet: retrigger when fills land
+            for mto in pending:
+                key = id(mto)
+                if key not in st["hooked"]:
+                    st["hooked"].add(key)
+                    mto.fill_future.add_done_callback(
+                        lambda _f, sid=shuffle_id:
+                            self._maybe_answer_plans(sid)
+                    )
+            return False
+        if st["hosts"] is None:
+            self._pin_window_hosts(st, snapshot.keys())
+        idx = st["idx"]
+        unknown = [h for (h, _m, _t) in eligible if h not in idx]
+        if unknown:
+            h = unknown[0]
+            st["failure"] = (
+                f"publisher {h.host}:{h.port} is not in the pinned "
+                f"window host set (joined after window 0 — windowed "
+                f"bulk needs stable membership)"
+            )
+            return True
+        eligible.sort(key=lambda e: (e[0].host, e[0].port, e[1]))
+        selected = eligible[:need]
+        E = len(st["hosts"])
+        num_parts = self._shuffle_partitions[shuffle_id]
+        lengths = [[0] * E for _ in range(E)]
+        manifest = [[[] for _ in range(E)] for _ in range(E)]
+        my_maps_by_host: Dict[ShuffleManagerId, List[int]] = {}
+        for host, map_id, mto in selected:
+            s = idx[host]
+            my_maps_by_host.setdefault(host, []).append(map_id)
+            for r in range(num_parts):
+                loc = mto.get_location(r)
+                if loc.is_empty or loc.length == 0:
+                    continue
+                d = r % E
+                lengths[s][d] += loc.length
+                manifest[s][d].append((map_id, r, loc.length))
+        flat = [lengths[s][d] for s in range(E) for d in range(E)]
+        final = st["total_assigned"] + len(selected) >= num_maps
+        st["plans"][st["next"]] = (flat, manifest, final, my_maps_by_host)
+        for host, map_id, _mto in selected:
+            st["assigned"].setdefault(host, set()).add(map_id)
+        st["total_assigned"] += len(selected)
+        logger.info(
+            "shuffle %d: window %d planned (%d map(s), final=%s, "
+            "%d assigned / %d total)",
+            shuffle_id, st["next"], len(selected), final,
+            st["total_assigned"], num_maps,
+        )
+        st["next"] += 1
+        return True
+
+    def _pin_window_hosts(self, st: dict, publishers) -> None:
+        """Pin ONE membership snapshot for every window of a shuffle
+        (divergent host sets across windows would shift partition
+        ownership r % E and compile different collectives).  Publishers
+        whose hello hasn't landed yet are still included — a publish
+        proves the executor is alive, and the legacy path's
+        wait-for-hello (_PLAN_WAIT) would stall the whole window on a
+        control-plane race the data plane has already won."""
+        with self._executors_lock:
+            members = set(self._executors)
+            removed = set(self._removed)
+        members.update(h for h in publishers if h not in removed)
+        hosts = sorted(members, key=lambda s: (s.host, s.port))
+        st["hosts"] = tuple(hosts)
+        st["idx"] = {h: i for i, h in enumerate(hosts)}
+
+    def _send_window_plan(self, msg: FetchExchangePlanMsg,
+                          channel: Channel, st: dict) -> None:
+        flat, manifest, final, my_maps_by_host = st["plans"][msg.window]
+        me = st["idx"].get(msg.requester)
+        if me is None:
+            self._reply_plan_failed(
+                channel, msg.callback_id,
+                f"requester {msg.requester.host}:{msg.requester.port} "
+                f"is not in the plan's host set",
+            )
+            return
+        reply = ExchangePlanMsg(
+            msg.callback_id, st["hosts"], flat,
+            [row[me] for row in manifest],
+            window=msg.window, final=final,
+            my_maps=sorted(my_maps_by_host.get(msg.requester, [])),
+        )
+        try:
+            self._send_msg(channel.reply_channel(), reply)
+        except Exception:
+            logger.exception("window plan reply failed")
+
+    def _take_plan_waiters(self, shuffle_id: int, pred) -> List:
+        """Pop (under _plan_lock) the plan waiters whose request
+        matches ``pred``; the rest stay queued."""
+        with self._plan_lock:
+            cur = self._plan_waiters.get(shuffle_id, [])
+            taken = [w for w in cur if pred(w[0])]
+            rest = [w for w in cur if not pred(w[0])]
+            if rest:
+                self._plan_waiters[shuffle_id] = rest
+            else:
+                self._plan_waiters.pop(shuffle_id, None)
+        return taken
+
+    def _fail_window_waiters(self, shuffle_id: int, reason: str) -> None:
+        taken = self._take_plan_waiters(
+            shuffle_id, lambda m: m.window >= 0
+        )
+        for m, ch in taken:
+            self._reply_plan_failed(ch, m.callback_id, reason)
+
+    def _reply_plan_failed(self, channel: Channel, callback_id: int,
+                           reason: str) -> None:
+        try:
+            self._send_msg(
+                channel.reply_channel(),
+                FetchMapStatusFailedMsg(callback_id, reason),
+            )
+        except Exception:
+            logger.exception("plan failure reply failed")
+
     # -- executor handlers ---------------------------------------------------
     def _handle_fetch_response(self, msg: FetchMapStatusResponseMsg) -> None:
         with self._callbacks_lock:
@@ -956,6 +1205,8 @@ class TpuShuffleManager:
         with self._plan_lock:
             self._plan_cache.pop(shuffle_id, None)
             self._shuffle_epoch.pop(shuffle_id, None)
+        with self._window_lock:
+            self._window_state.pop(shuffle_id, None)
         with self._outputs_lock:
             self._outputs.pop(shuffle_id, None)
         self._shuffle_partitions.pop(shuffle_id, None)
@@ -989,6 +1240,8 @@ class TpuShuffleManager:
             ]
             self._plan_waiters.clear()
             self._plan_cache.clear()
+        with self._window_lock:
+            self._window_state.clear()
         for sid, (msg, channel) in doomed_waiters:
             try:
                 self._send_msg(
@@ -1043,7 +1296,8 @@ class TpuShuffleManager:
         t = self._hb_thread
         if t is not None:
             t.join(timeout=2.0)
-            self._hb_thread = None
+            if not t.is_alive():
+                self._hb_thread = None
 
     def stop(self) -> None:
         """Teardown (reference: RdmaShuffleManager.scala:348-357)."""

@@ -40,14 +40,23 @@ def main() -> None:
         "spark.shuffle.tpu.partitionLocationFetchTimeout": "60s",
         "spark.shuffle.tpu.connectTimeout": "10s",
     })
+    # windowed-plan conf (shuffle 71): 4 maps / window of 2 — reducers
+    # exchange window 0 while each process's straggler map is unwritten
+    wconf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "60s",
+        "spark.shuffle.tpu.connectTimeout": "10s",
+        "spark.shuffle.tpu.bulkWindowMaps": "2",
+    })
     NUM_PARTS = 8
     part = HashPartitioner(NUM_PARTS)
     driver = None
     if pid == 0:
         driver = TpuShuffleManager(
-            conf, is_driver=True, network=TcpNetwork(), port=driver_port,
+            wconf, is_driver=True, network=TcpNetwork(), port=driver_port,
         )
         driver.register_shuffle(70, 2, part)
+        driver.register_shuffle(71, 4, part)
 
     multihost.initialize(
         coordinator_address=f"127.0.0.1:{port}",
@@ -194,6 +203,58 @@ def main() -> None:
     ]
     assert sorted(mine) == sorted(expect), (
         f"proc {pid}: got {len(mine)} records, want {len(expect)}"
+    )
+
+    # ---- windowed bulk across processes (shuffle 71): each process
+    # writes map `pid`, starts reading, PROVES window 0's collective
+    # completed, then writes its straggler map `pid + 2` — the
+    # incremental-plan overlap crossing a real process boundary
+    import threading
+
+    conf.set("bulkWindowMaps", "2")
+    handle71 = ShuffleHandle(71, 4, part)
+    rec71 = {
+        m: [(f"w{m}-k{j}", (m, j)) for j in range(40)] for m in range(4)
+    }
+    w = ex_mgr.get_writer(handle71, pid)
+    w.write(rec71[pid])
+    w.stop(True)
+
+    reader71 = BulkExchangeReader(
+        ex_mgr, TileExchange(mesh2, tile_bytes=1 << 12)
+    )
+    box = {}
+
+    def read71():
+        try:
+            box["got"] = list(reader71.read(71))
+        except BaseException as e:  # surfaced after join
+            box["err"] = e
+
+    th = threading.Thread(target=read71, daemon=True)
+    th.start()
+    deadline = time.time() + 30
+    while time.time() < deadline and not reader71.window_events:
+        time.sleep(0.02)
+    assert reader71.window_events, (
+        f"proc {pid}: window 0 never exchanged before the straggler"
+    )
+    assert "got" not in box, "read returned before the straggler map"
+
+    w = ex_mgr.get_writer(handle71, pid + 2)
+    w.write(rec71[pid + 2])
+    w.stop(True)
+    th.join(timeout=60)
+    assert "err" not in box, f"proc {pid}: {box.get('err')!r}"
+    wins = [wn for wn, _t, _b in reader71.window_events]
+    assert wins == [0, 1], f"proc {pid}: windows {wins}"
+    all71 = [kv for m in range(4) for kv in rec71[m]]
+    expect71 = [
+        (k, v) for k, v in all71 if part.partition(k) % 2 == pid
+    ]
+    assert sorted(box["got"]) == sorted(expect71), (
+        f"proc {pid}: windowed got {len(box['got'])} records, "
+        f"want {len(expect71)}"
     )
 
     ex_mgr.stop()

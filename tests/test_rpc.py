@@ -213,3 +213,40 @@ def test_heartbeat_roundtrip():
     ack = HeartbeatMsg(smid(5), seq=12, is_ack=True)
     assert decode_msg(ping.encode()) == ping
     assert decode_msg(ack.encode()) == ack
+
+
+def test_exchange_plan_roundtrip_windowed():
+    from sparkrdma_tpu.rpc.messages import (
+        ExchangePlanMsg,
+        FetchExchangePlanMsg,
+    )
+
+    # fetch side: legacy default window=-1 and an explicit window
+    legacy = FetchExchangePlanMsg(smid(1), 5, 33)
+    out = decode_msg(legacy.encode())
+    assert out == legacy and out.window == -1
+    win = FetchExchangePlanMsg(smid(2), 5, 34, window=3)
+    assert decode_msg(win.encode()) == win
+
+    # plan side: window metadata + the requester's map set round-trip
+    hosts = [smid(i) for i in range(3)]
+    lengths = list(range(9))
+    manifest = [
+        ((0, 1, 100), (2, 4, 50)),
+        (),
+        ((1, 0, 7),),
+    ]
+    plan = ExchangePlanMsg(
+        9, hosts, lengths, manifest,
+        window=2, final=False, my_maps=(4, 7, 9),
+    )
+    got = decode_msg(plan.encode())
+    assert got == plan
+    assert got.window == 2 and got.final is False
+    assert got.my_maps == (4, 7, 9)
+    # defaults decode as the legacy full-barrier plan
+    full = ExchangePlanMsg(9, hosts, lengths, manifest)
+    got2 = decode_msg(full.encode())
+    assert got2.window == -1 and got2.final is True and got2.my_maps == ()
+    # size estimate stays exact with the new tail fields
+    assert len(plan._payload()) == plan._payload_size()
