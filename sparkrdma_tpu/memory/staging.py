@@ -53,6 +53,13 @@ def _load_native():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint64,
         ]
+    if hasattr(lib, "hash_partition_order"):
+        lib.hash_partition_order.restype = ctypes.c_int
+        lib.hash_partition_order.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
     return lib
 
 
@@ -83,6 +90,34 @@ def native_row_gather(src: np.ndarray, idx: np.ndarray,
         idx.shape[0], src.dtype.itemsize,
     )
     return True
+
+
+def native_hash_partition_order(keys: np.ndarray, num_partitions: int,
+                                kmin: int, krange: int):
+    """Fused splitmix64 %P + stable pid-major key-asc counting-sort
+    order for int64 key columns (requires ``num_partitions * krange <=
+    65536``).  Returns ``(order int64[n], counts int64[P])`` or None
+    when the native lib is absent / the column doesn't qualify —
+    callers fall back to the numpy two-sort path.  Bit-exact with
+    HashPartitioner.partition_array + the composite radix argsort."""
+    if _NATIVE is None or not hasattr(_NATIVE, "hash_partition_order"):
+        return None
+    if (
+        keys.ndim != 1 or keys.dtype != np.int64
+        or keys.strides[0] != 8
+        or num_partitions * krange > (1 << 16)
+    ):
+        return None
+    n = keys.shape[0]
+    order = np.empty(n, np.int64)
+    counts = np.empty(num_partitions, np.int64)
+    rc = _NATIVE.hash_partition_order(
+        keys.ctypes.data, n, num_partitions, kmin, krange,
+        counts.ctypes.data, order.ctypes.data,
+    )
+    if rc != 0:
+        return None
+    return order, counts
 
 
 class StagingBuffer:

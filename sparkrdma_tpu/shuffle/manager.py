@@ -301,6 +301,12 @@ class TpuShuffleManager:
         # built in order under _window_lock (see _maybe_answer_windows)
         self._window_state: Dict[int, dict] = {}
         self._window_lock = threading.RLock()
+        # shuffle → first-seen plan mode (True = windowed); mixed modes
+        # across hosts (conf skew) are rejected at request time
+        self._plan_mode: Dict[int, bool] = {}
+        # shuffle → hosts that requested windowed plans (participation
+        # evidence for host-set pinning ahead of a racing hello)
+        self._window_requesters: Dict[int, set] = {}
         self._fetch_pool = (
             ThreadPoolExecutor(max_workers=8, thread_name_prefix="drv-fetch")
             if is_driver
@@ -673,6 +679,31 @@ class TpuShuffleManager:
                 f"shuffle {msg.shuffle_id} not registered on driver"
             )
             return
+        # one plan mode per shuffle: a windowed host and a full-barrier
+        # host would run DIFFERENT collective sequences against the
+        # same exchange (conf skew) — reject the latecomer's mode
+        # loudly instead of letting the barrier hang to timeout
+        windowed = msg.window >= 0
+        with self._window_lock:
+            prev = self._plan_mode.setdefault(msg.shuffle_id, windowed)
+        if prev != windowed:
+            mine = "windowed" if windowed else "full-barrier"
+            served = "windowed" if prev else "full-barrier"
+            reply_failed(
+                f"shuffle {msg.shuffle_id} plan mode mismatch: this "
+                f"host requested {mine} plans but the shuffle is being "
+                f"served {served} — align "
+                f"spark.shuffle.tpu.bulkWindowMaps across hosts"
+            )
+            return
+        if windowed:
+            # a fetch-plan request proves the requester participates:
+            # remember it so the window host set pinned below includes
+            # hosts whose hello is still in flight
+            with self._window_lock:
+                self._window_requesters.setdefault(
+                    msg.shuffle_id, set()
+                ).add(msg.requester)
         with self._plan_lock:
             stale = (
                 self._shuffle_epoch.get(msg.shuffle_id)
@@ -933,7 +964,7 @@ class TpuShuffleManager:
                 # zero-map shuffle (empty upstream stage): cut one
                 # empty FINAL window so readers complete with no
                 # records, exactly like the legacy full-barrier path
-                self._pin_window_hosts(st, ())
+                self._pin_window_hosts(st, shuffle_id, ())
                 E = len(st["hosts"])
                 st["plans"][0] = (
                     [0] * (E * E),
@@ -981,7 +1012,7 @@ class TpuShuffleManager:
                     )
             return False
         if st["hosts"] is None:
-            self._pin_window_hosts(st, snapshot.keys())
+            self._pin_window_hosts(st, shuffle_id, snapshot.keys())
         idx = st["idx"]
         unknown = [h for (h, _m, _t) in eligible if h not in idx]
         if unknown:
@@ -1024,18 +1055,29 @@ class TpuShuffleManager:
         st["next"] += 1
         return True
 
-    def _pin_window_hosts(self, st: dict, publishers) -> None:
+    def _pin_window_hosts(self, st: dict, shuffle_id: int,
+                          publishers) -> None:
         """Pin ONE membership snapshot for every window of a shuffle
         (divergent host sets across windows would shift partition
         ownership r % E and compile different collectives).  Publishers
-        whose hello hasn't landed yet are still included — a publish
-        proves the executor is alive, and the legacy path's
-        wait-for-hello (_PLAN_WAIT) would stall the whole window on a
-        control-plane race the data plane has already won."""
+        and plan REQUESTERS whose hello hasn't landed yet are still
+        included — a publish or a plan request proves the executor
+        participates, and the legacy path's wait-for-hello (_PLAN_WAIT)
+        would stall the whole window on a control-plane race the data
+        plane has already won."""
         with self._executors_lock:
             members = set(self._executors)
             removed = set(self._removed)
-        members.update(h for h in publishers if h not in removed)
+        with self._window_lock:
+            requesters = set(
+                self._window_requesters.get(shuffle_id, ())
+            )
+        members.update(
+            h for h in list(publishers) + sorted(
+                requesters, key=lambda s: (s.host, s.port)
+            )
+            if h not in removed
+        )
         hosts = sorted(members, key=lambda s: (s.host, s.port))
         st["hosts"] = tuple(hosts)
         st["idx"] = {h: i for i, h in enumerate(hosts)}
@@ -1207,6 +1249,8 @@ class TpuShuffleManager:
             self._shuffle_epoch.pop(shuffle_id, None)
         with self._window_lock:
             self._window_state.pop(shuffle_id, None)
+            self._plan_mode.pop(shuffle_id, None)
+            self._window_requesters.pop(shuffle_id, None)
         with self._outputs_lock:
             self._outputs.pop(shuffle_id, None)
         self._shuffle_partitions.pop(shuffle_id, None)
@@ -1242,6 +1286,8 @@ class TpuShuffleManager:
             self._plan_cache.clear()
         with self._window_lock:
             self._window_state.clear()
+            self._plan_mode.clear()
+            self._window_requesters.clear()
         for sid, (msg, channel) in doomed_waiters:
             try:
                 self._send_msg(

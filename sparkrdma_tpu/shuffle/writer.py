@@ -19,7 +19,9 @@ from typing import Any, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.memory.staging import native_hash_partition_order
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
 from sparkrdma_tpu.utils.columns import (
     ColumnBatch,
     combine_columns,
@@ -122,11 +124,44 @@ class ShuffleWriter:
             counts = np.array([n], np.int64)
             self._col_pending.append((batch, None, counts))
         else:
-            pids = self.handle.partitioner.partition_array(batch.keys)
-            korder = stable_key_order(batch.keys)
-            porder = stable_key_order(pids[korder])
-            order = korder[porder]  # pid-major, key-sorted within
-            counts = np.bincount(pids, minlength=P).astype(np.int64)
+            order = counts = None
+            is_hash = type(self.handle.partitioner) is HashPartitioner
+            if is_hash and np.issubdtype(batch.keys.dtype, np.integer):
+                kmin = int(batch.keys.min())
+                krange = int(batch.keys.max()) - kmin + 1
+                if krange * P <= (1 << 16):
+                    # modest-cardinality int keys: ONE fused native
+                    # pass (splitmix64 + composite counting sort)
+                    # replaces hash + two radix argsorts + two index
+                    # gathers + bincount — or, without the native lib,
+                    # one composite uint16 radix argsort does
+                    got = native_hash_partition_order(
+                        np.ascontiguousarray(batch.keys, np.int64),
+                        P, kmin, krange,
+                    )
+                    if got is not None:
+                        order, counts = got
+                    else:
+                        pids = self.handle.partitioner.partition_array(
+                            batch.keys
+                        )
+                        # widen BEFORE subtracting: narrow key dtypes
+                        # (int8 span 256) overflow on (keys - kmin)
+                        comp = (
+                            pids.astype(np.uint32) * np.uint32(krange)
+                            + (batch.keys.astype(np.int64) - kmin)
+                            .astype(np.uint32)
+                        ).astype(np.uint16)
+                        order = np.argsort(comp, kind="stable")
+                        counts = np.bincount(
+                            pids, minlength=P
+                        ).astype(np.int64)
+            if order is None:
+                pids = self.handle.partitioner.partition_array(batch.keys)
+                korder = stable_key_order(batch.keys)
+                porder = stable_key_order(pids[korder])
+                order = korder[porder]  # pid-major, key-sorted within
+                counts = np.bincount(pids, minlength=P).astype(np.int64)
             self._col_pending.append((batch, order, counts))
         self.metrics.records_written += n
         self._records_in_memory += n
@@ -399,7 +434,15 @@ class ShuffleWriter:
                 (starts[p] + sizes[p] + align - 1) // align * align
             )
         total = int(starts[P - 1] + sizes[P - 1]) if P else 0
-        buf = np.empty(max(total, 1), np.uint8)
+        # assemble in a POOLED buffer: repeated shuffles reuse warm
+        # pages (a fresh np.empty of tens of MB pays ~0.4ms/MB in
+        # first-touch page faults — measured 25ms per 72MB commit);
+        # the GC-tied release returns it to the pool when the shuffle's
+        # segment dies
+        try:
+            buf = self.manager.staging_pool.alloc_gc(max(total, 1))
+        except MemoryError:
+            buf = np.empty(max(total, 1), np.uint8)
         # zero the alignment gaps so committed segments are
         # deterministic (gap bytes are staged but never served)
         for p in range(P - 1):

@@ -594,3 +594,49 @@ def test_bulk_windowed_zero_map_shuffle(devices):
     finally:
         for m in executors + [driver]:
             m.stop()
+
+
+def test_bulk_mixed_plan_modes_rejected(devices):
+    """Conf skew (one host windowed, one full-barrier) must fail fast
+    instead of hanging the shared collective to the barrier timeout."""
+    net, conf, driver, executors = _windowed_cluster(2, 45700)
+    try:
+        part = HashPartitioner(4)
+        handle = driver.register_shuffle(64, len(executors), part)
+        for m, e in enumerate(executors):
+            w = e.get_writer(handle, m)
+            w.write([(f"k{j}", j) for j in range(10)])
+            w.stop(True)
+        session = BulkShuffleSession(
+            TileExchange(make_mesh(len(executors)), tile_bytes=1 << 12),
+            len(executors),
+        )
+        # first reader establishes windowed mode...
+        r0 = BulkExchangeReader(executors[0], session=session)
+        results = {}
+        t0 = threading.Thread(
+            target=lambda: results.update(ok=list(r0.read(64))),
+            daemon=True,
+        )
+        t0.start()
+        time.sleep(0.3)  # let its windowed request land first
+        # ...then a full-barrier request (skewed conf) must fail fast
+        legacy_conf = TpuShuffleConf({
+            "spark.shuffle.tpu.driverPort": conf.driver_port,
+        })
+        ex1 = executors[1]
+        old = ex1.conf
+        ex1.conf = legacy_conf
+        try:
+            r1 = BulkExchangeReader(ex1, session=session)
+            t_start = time.monotonic()
+            with pytest.raises(
+                MetadataFetchFailedError, match="plan mode mismatch"
+            ):
+                list(r1.read(64))
+            assert time.monotonic() - t_start < 10
+        finally:
+            ex1.conf = old
+    finally:
+        for m in executors + [driver]:
+            m.stop()
