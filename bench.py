@@ -173,17 +173,70 @@ def main():
     gbps = bytes_per_iter / dt / 1e9
     per_chip = gbps / n_chips
     print(
+        f"# terasort 8B-record shape ({N_RECORDS} records, {engine}): "
+        f"{per_chip:.3f} GB/s/chip "
+        f"(vs_baseline {per_chip / BASELINE_GBPS:.3f})",
+        flush=True,
+    )
+
+    # headline metric: the HiBench record shape the reference's 175 GB
+    # result is measured on (10B key + 90B value ≈ 100B records,
+    # /root/reference/README.md:7-19) — the sort cost is per RECORD, so
+    # wide values are the honest sorted-bytes/s comparison against the
+    # NIC line rate
+    wide_chip = _bench_wide(mesh, fence)
+    print(
         json.dumps(
             {
-                "metric": "terasort shuffle+sort throughput per chip "
-                          f"({N_RECORDS} records, {n_chips} chip(s), "
-                          f"{engine})",
-                "value": round(per_chip, 3),
+                "metric": "terasort shuffle+sort throughput per chip, "
+                          f"HiBench 100B records ({N_WIDE} records, "
+                          f"{n_chips} chip(s), key sort + payload "
+                          f"gather)",
+                "value": round(wide_chip, 3),
                 "unit": "GB/s/chip",
-                "vs_baseline": round(per_chip / BASELINE_GBPS, 3),
+                "vs_baseline": round(wide_chip / BASELINE_GBPS, 3),
             }
         )
     )
+
+
+N_WIDE = 1 << 22       # 4.2M records
+WIDE_WORDS = 24        # 96B payload + 4B key = 100B (HiBench ~100B)
+
+
+def _bench_wide(mesh, fence):
+    """Time the wide-record sort (models/terasort.py wide path);
+    returns GB/s per chip.  Retries once with a higher capacity factor
+    on bucket overflow."""
+    from sparkrdma_tpu.models.terasort import TeraSorter
+
+    rng = np.random.default_rng(7)
+    keys = jnp.asarray(
+        rng.integers(0, 1 << 31, N_WIDE, dtype=np.int32)
+    )
+    payload = jnp.asarray(
+        rng.integers(0, 1 << 31, (N_WIDE, WIDE_WORDS), dtype=np.int32)
+    )
+    n_chips = len(list(mesh.devices.flat))
+    for factor in (1.3, 2.0):
+        sorter = TeraSorter(mesh, capacity_factor=factor)
+        (sk, sp, n_valid, max_fill), cap = sorter.sort_device_wide(
+            keys, payload
+        )
+        fence(n_valid)
+        if int(np.max(np.asarray(jax.device_get(max_fill)))) > cap:
+            continue  # overflow: retry with more headroom
+        assert int(np.asarray(jax.device_get(n_valid)).sum()) == N_WIDE
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            (sk, sp, n_valid, _mf), _ = sorter.sort_device_wide(
+                keys, payload
+            )
+        fence(n_valid)
+        dt = (time.perf_counter() - t0) / ITERS
+        record_bytes = 4 + 4 * WIDE_WORDS
+        return N_WIDE * record_bytes / dt / 1e9 / n_chips
+    raise AssertionError("wide sort overflowed even at factor 2.0")
 
 
 def _try_pallas_engine(keys, vals, dt_lax):

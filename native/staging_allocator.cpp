@@ -293,3 +293,74 @@ extern "C" int hash_partition_order(
   free(comp);
   return 0;
 }
+
+// Stable LSD radix argsort for int64 keys (order-preserving unsigned
+// transform), 4 x 16-bit digit passes with constant-digit passes
+// skipped — numpy's stable argsort falls back to timsort (~86 ms/M)
+// for int64 columns whose range exceeds the uint16 rebase, and the
+// key-sort is the record plane's cost ceiling for wide-range keys
+// (the TeraSort shape).  Scratch persists per thread so repeated maps
+// reuse warm pages.
+static thread_local uint64_t* rs_keys[2] = {nullptr, nullptr};
+static thread_local int64_t* rs_idx[2] = {nullptr, nullptr};
+static thread_local uint64_t rs_cap = 0;
+
+extern "C" int radix_argsort_i64(const int64_t* keys, uint64_t n,
+                                 int64_t* order_out) {
+  if (n == 0) return 0;
+  if (n > rs_cap) {
+    uint64_t cap = rs_cap ? rs_cap : 4096;
+    while (cap < n) cap *= 2;
+    for (int b = 0; b < 2; b++) {
+      free(rs_keys[b]);
+      free(rs_idx[b]);
+      rs_keys[b] = static_cast<uint64_t*>(malloc(cap * 8));
+      rs_idx[b] = static_cast<int64_t*>(malloc(cap * 8));
+      if (!rs_keys[b] || !rs_idx[b]) {
+        for (int c = 0; c < 2; c++) {
+          free(rs_keys[c]); free(rs_idx[c]);
+          rs_keys[c] = nullptr; rs_idx[c] = nullptr;
+        }
+        rs_cap = 0;
+        return -2;
+      }
+    }
+    rs_cap = cap;
+  }
+  // all 8 byte-digit histograms in ONE pass over the keys (8-bit
+  // digits beat 16-bit here: 256 write streams stay cache/TLB
+  // resident during the scatter — measured 54ms vs 74ms per 1M)
+  static thread_local uint64_t hist[8][256];
+  memset(hist, 0, sizeof(hist));
+  constexpr uint64_t SIGN = 0x8000000000000000ULL;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t k = static_cast<uint64_t>(keys[i]) ^ SIGN;
+    rs_keys[0][i] = k;
+    rs_idx[0][i] = static_cast<int64_t>(i);
+    for (int d = 0; d < 8; d++) hist[d][(k >> (8 * d)) & 0xFF]++;
+  }
+  int cur = 0;
+  for (int pass = 0; pass < 8; pass++) {
+    uint64_t* h = hist[pass];
+    const int shift = 8 * pass;
+    if (h[(rs_keys[cur][0] >> shift) & 0xFF] == n) continue;
+    uint64_t sum = 0;
+    for (uint32_t b = 0; b < 256; b++) {
+      uint64_t c = h[b];
+      h[b] = sum;
+      sum += c;
+    }
+    const uint64_t* sk = rs_keys[cur];
+    const int64_t* si = rs_idx[cur];
+    uint64_t* dk = rs_keys[cur ^ 1];
+    int64_t* di = rs_idx[cur ^ 1];
+    for (uint64_t i = 0; i < n; i++) {
+      uint64_t pos = h[(sk[i] >> shift) & 0xFF]++;
+      dk[pos] = sk[i];
+      di[pos] = si[i];
+    }
+    cur ^= 1;
+  }
+  memcpy(order_out, rs_idx[cur], n * 8);
+  return 0;
+}

@@ -340,6 +340,26 @@ class TpuShuffleContext:
         self.stop()
 
 
+def _try_vectorized(f, arg, n: int, kinds: str = ""):
+    """Apply ``f`` to a whole column (or column pair) and accept the
+    result only when it is a clean elementwise vector: an ndarray of
+    exactly ``n`` rows, non-object dtype, optionally restricted to
+    dtype ``kinds`` (numpy kind letters, space-separated groups
+    allowed).  Returns None otherwise — the caller re-applies ``f``
+    per record, so ``f`` must be pure."""
+    try:
+        out = f(arg)
+    except Exception:
+        return None
+    if not isinstance(out, np.ndarray):
+        return None
+    if out.ndim != 1 or out.shape[0] != n or out.dtype.hasobject:
+        return None
+    if kinds and out.dtype.kind not in kinds.replace(" ", ""):
+        return None
+    return out
+
+
 class Dataset:
     """Partitioned collection with Spark-shaped transformations.
 
@@ -383,7 +403,28 @@ class Dataset:
         return self._chain(lambda part: [f(x) for x in part])
 
     def filter(self, f: Callable[[Any], bool]) -> "Dataset":
-        return self._chain(lambda part: [x for x in part if f(x)])
+        """Columnar partitions first try ``f`` VECTORIZED over the
+        ``(keys, vals)`` column pair (tuple-indexing predicates like
+        ``lambda kv: kv[1] > 5`` evaluate to a boolean mask in one
+        numpy pass); anything that doesn't vectorize cleanly falls back
+        to the per-record loop.  ``f`` must be pure — the fallback
+        re-applies it."""
+
+        def fl(part, _pidx, f=f):
+            if isinstance(part, ColumnBatch):
+                mask = _try_vectorized(f, (part.keys, part.vals),
+                                       len(part), kinds="bui f")
+                if mask is not None:
+                    mask = mask.astype(bool, copy=False)
+                    return ColumnBatch(
+                        part.keys[mask], part.vals[mask],
+                        key_sorted=part.key_sorted,
+                    )
+                part = list(part)
+            return [x for x in part if f(x)]
+
+        fl._columnar_ok = True
+        return self._chain_indexed(fl)
 
     def flat_map(self, f: Callable[[Any], Iterable[Any]]) -> "Dataset":
         return self._chain(lambda part: [y for x in part for y in f(x)])
@@ -405,9 +446,18 @@ class Dataset:
         if self._transform is None:
             return self._parts
         t = self._transform
+        col_ok = getattr(t, "_columnar_ok", False)
         E = len(self.ctx.executors)
+
+        def run(p, i):
+            # a fully column-aware chain receives the ColumnBatch
+            # itself (vectorized narrow plane); otherwise records
+            if col_ok and isinstance(p, ColumnBatch):
+                return t(p, i)
+            return t(list(p), i)
+
         out = self.ctx._run_tasks([
-            (i % E, (lambda p=p, t=t, i=i: t(list(p), i)))
+            (i % E, (lambda p=p, i=i: run(p, i)))
             for i, p in enumerate(self._parts)
         ])
         return out
@@ -425,10 +475,12 @@ class Dataset:
     # -- wide transformations ------------------------------------------------
     @property
     def _is_columnar(self) -> bool:
-        """True when partitions are ColumnBatch columns with no pending
-        tuple-level narrow transform (which would de-columnarize)."""
+        """True when partitions are ColumnBatch columns and any pending
+        narrow transform is fully column-aware (tuple-level transforms
+        de-columnarize)."""
         return (
-            self._transform is None
+            (self._transform is None
+             or getattr(self._transform, "_columnar_ok", False))
             and bool(self._parts)
             and all(isinstance(p, ColumnBatch) for p in self._parts)
         )
@@ -511,7 +563,24 @@ class Dataset:
         return self._shuffled(part, key_ordering=True)
 
     def map_values(self, f: Callable[[Any], Any]) -> "Dataset":
-        return self.map(lambda kv: (kv[0], f(kv[1])))
+        """Columnar partitions first try ``f`` VECTORIZED over the
+        whole value column (ufunc-style callables like ``lambda v:
+        v * 2`` run in one numpy pass and the chain STAYS columnar);
+        non-vectorizable callables fall back per record.  ``f`` must be
+        pure — the fallback re-applies it."""
+
+        def mv(part, _pidx, f=f):
+            if isinstance(part, ColumnBatch):
+                out = _try_vectorized(f, part.vals, len(part))
+                if out is not None:
+                    return ColumnBatch(
+                        part.keys, out, key_sorted=part.key_sorted
+                    )
+                part = list(part)
+            return [(k, f(v)) for k, v in part]
+
+        mv._columnar_ok = True
+        return self._chain_indexed(mv)
 
     def keys(self) -> "Dataset":
         return self.map(lambda kv: kv[0])
@@ -551,9 +620,17 @@ class Dataset:
             raise ValueError(f"fraction must be in [0, 1]: {fraction}")
 
         def sample_part(part, pidx, seed=seed, fraction=fraction):
+            if isinstance(part, ColumnBatch):
+                rng = np.random.default_rng(abs(hash((seed, pidx, "c"))))
+                mask = rng.random(len(part)) < fraction
+                return ColumnBatch(
+                    part.keys[mask], part.vals[mask],
+                    key_sorted=part.key_sorted,
+                )
             rng = random.Random(hash((seed, pidx)))
             return [x for x in part if rng.random() < fraction]
 
+        sample_part._columnar_ok = True
         return self._chain_indexed(sample_part)
 
     def top_k_per_key(self, k: int,

@@ -53,6 +53,11 @@ def _load_native():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint64,
         ]
+    if hasattr(lib, "radix_argsort_i64"):
+        lib.radix_argsort_i64.restype = ctypes.c_int
+        lib.radix_argsort_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ]
     if hasattr(lib, "hash_partition_order"):
         lib.hash_partition_order.restype = ctypes.c_int
         lib.hash_partition_order.argtypes = [
@@ -90,6 +95,26 @@ def native_row_gather(src: np.ndarray, idx: np.ndarray,
         idx.shape[0], src.dtype.itemsize,
     )
     return True
+
+
+def native_radix_argsort(keys: np.ndarray):
+    """Stable argsort of an int64 column via the native LSD radix
+    (4 x 16-bit passes, constant digits skipped) — ~2.5x numpy's
+    timsort path for wide-range int64 keys.  Returns the int64 order
+    or None when unavailable/ineligible (caller falls back)."""
+    if _NATIVE is None or not hasattr(_NATIVE, "radix_argsort_i64"):
+        return None
+    if keys.ndim != 1 or keys.dtype != np.int64 or (
+        len(keys) and keys.strides[0] != 8
+    ):
+        return None
+    order = np.empty(keys.shape[0], np.int64)
+    rc = _NATIVE.radix_argsort_i64(
+        keys.ctypes.data, keys.shape[0], order.ctypes.data
+    )
+    if rc != 0:
+        return None
+    return order
 
 
 def native_hash_partition_order(keys: np.ndarray, num_partitions: int,

@@ -158,6 +158,111 @@ def _local_sort_step(keys, vals, valid, n_devices, capacity, sample_size):
     return sorted_k, sorted_v, n_valid, overflow
 
 
+def _local_sort_wide_step(keys, payload, n_devices, capacity,
+                          sample_size):
+    """Wide-record variant (the HiBench TeraSort shape: 10B key + 90B
+    value, README.md:7-19): keys [n_local] ride the sort/sample/window
+    machinery with a row INDEX as the carried operand, and the payload
+    matrix [n_local, W] follows via two batched row gathers plus the
+    same all_to_all — the sort cost is unchanged while every exchanged
+    record carries ``8 + 4W`` bytes."""
+    n_local = keys.shape[0]
+    W = payload.shape[1]
+    sentinel = jnp.array(jnp.iinfo(keys.dtype).max, keys.dtype)
+    iota = jnp.arange(n_local, dtype=jnp.int32)
+    if n_devices == 1:
+        k, perm = jax.lax.sort((keys, iota), num_keys=1, is_stable=False)
+        p = jnp.take(payload, perm, axis=0)
+        pad = capacity - n_local
+        if pad < 0:
+            k, p = k[:capacity], p[:capacity]
+        elif pad:
+            k = jnp.concatenate([k, jnp.full((pad,), sentinel, k.dtype)])
+            p = jnp.concatenate([p, jnp.zeros((pad, W), p.dtype)], axis=0)
+        n_valid = jnp.minimum(jnp.int32(n_local), jnp.int32(capacity))
+        return k, p, n_valid, jnp.int32(n_local)
+    k, perm = jax.lax.sort((keys, iota), num_keys=1, is_stable=False)
+    ps = jnp.take(payload, perm, axis=0)
+    sample = k[(jnp.arange(sample_size) * n_local) // sample_size]
+    all_samples = jax.lax.all_gather(sample, EXCHANGE_AXIS)
+    splitters = make_range_splitters(all_samples.reshape(-1), n_devices)
+    edges = jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.searchsorted(k, splitters, side="right").astype(jnp.int32),
+        jnp.full((1,), n_local, jnp.int32),
+    ])
+    counts = edges[1:] - edges[:-1]
+    starts = edges[:-1]
+    clamped = jnp.minimum(counts, capacity)
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    window_valid = slot[None, :] < clamped[:, None]
+    kp = jnp.concatenate([k, jnp.full((capacity,), sentinel, k.dtype)])
+    pp = jnp.concatenate(
+        [ps, jnp.zeros((capacity, W), ps.dtype)], axis=0
+    )
+
+    def fill(p_, bufs):
+        fk, fp = bufs
+        wk = jax.lax.dynamic_slice(kp, (starts[p_],), (capacity,))
+        wp = jax.lax.dynamic_slice(pp, (starts[p_], 0), (capacity, W))
+        fk = jax.lax.dynamic_update_slice(fk, wk[None], (p_, 0))
+        fp = jax.lax.dynamic_update_slice(fp, wp[None], (p_, 0, 0))
+        return fk, fp
+
+    bk0 = jax.lax.pcast(
+        jnp.zeros((n_devices, capacity), k.dtype), EXCHANGE_AXIS,
+        to="varying",
+    )
+    bp0 = jax.lax.pcast(
+        jnp.zeros((n_devices, capacity, W), ps.dtype), EXCHANGE_AXIS,
+        to="varying",
+    )
+    bk, bp = jax.lax.fori_loop(0, n_devices, fill, (bk0, bp0))
+    bk = jnp.where(window_valid, bk, sentinel)
+    rk = jax.lax.all_to_all(bk, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    rp = jax.lax.all_to_all(bp, EXCHANGE_AXIS, split_axis=0, concat_axis=0)
+    rvalid = jax.lax.all_to_all(
+        clamped.reshape(n_devices, 1), EXCHANGE_AXIS,
+        split_axis=0, concat_axis=0,
+    ).reshape(n_devices)
+    n_valid = jnp.sum(rvalid).astype(jnp.int32)
+    riv = (slot[None, :] >= rvalid[:, None]).astype(jnp.int32).reshape(-1)
+    iota2 = jnp.arange(n_devices * capacity, dtype=jnp.int32)
+    sorted_k, _siv, perm2 = jax.lax.sort(
+        (rk.reshape(-1), riv, iota2), num_keys=2, is_stable=False
+    )
+    sorted_p = jnp.take(
+        rp.reshape(n_devices * capacity, W), perm2, axis=0
+    )
+    overflow = jnp.max(counts).astype(jnp.int32)
+    return sorted_k, sorted_p, n_valid, overflow
+
+
+@functools.lru_cache(maxsize=16)
+def make_wide_sort_step(mesh: Mesh, n_local: int, payload_words: int,
+                        capacity: int, sample_size: int = 1024):
+    """Jitted wide-record sort step: fn(keys [D*n_local], payload
+    [D*n_local, W]) → (keys' [D, D*cap], payload' [D, D*cap, W],
+    valid counts [D], max bucket fill [D])."""
+    D = len(list(mesh.devices.flat))
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(EXCHANGE_AXIS)
+    spec2 = P(EXCHANGE_AXIS, None)
+
+    def body(k, p):
+        sk, sp, n_valid, overflow = _local_sort_wide_step(
+            k, p, D, capacity, sample_size
+        )
+        return sk, sp, n_valid[None], overflow[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec2),
+        out_specs=(spec, spec2, spec, spec),
+    )
+    return jax.jit(mapped)
+
+
 @functools.lru_cache(maxsize=16)
 def make_sort_step(
     mesh: Mesh, n_local: int, capacity: int, sample_size: int = 1024,
@@ -240,6 +345,36 @@ class TeraSorter(ExchangeModel):
             return step(keys, vals), cap
         valid = jax.device_put(valid, self.sharding)
         return step(keys, vals, valid), cap
+
+    def sort_device_wide(
+        self, keys: jax.Array, payload: jax.Array,
+        capacity: Optional[int] = None,
+    ):
+        """Wide-record sort step (HiBench shape): ``payload`` is
+        [n, W] int32 rows that follow their keys through the exchange.
+        Length must divide D; returns device results unfetched."""
+        n = keys.shape[0]
+        if n % self.n_devices:
+            raise ValueError(
+                f"length {n} not divisible by D={self.n_devices}"
+            )
+        if payload.ndim != 2 or payload.shape[0] != n:
+            raise ValueError(
+                f"payload must be [n, W], got {payload.shape}"
+            )
+        n_local = n // self.n_devices
+        cap = capacity or self._capacity(n_local)
+        step = make_wide_sort_step(
+            self.mesh, n_local, int(payload.shape[1]), cap,
+            min(self.sample_size, max(1, n_local)),
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        keys = jax.device_put(keys, self.sharding)
+        payload = jax.device_put(
+            payload, NamedSharding(self.mesh, P(EXCHANGE_AXIS, None))
+        )
+        return step(keys, payload), cap
 
     def sort(self, keys, vals=None) -> Tuple[np.ndarray, np.ndarray]:
         """Full host-facing sortByKey: returns (sorted_keys, sorted_vals)."""

@@ -21,7 +21,10 @@ import numpy as np
 
 from sparkrdma_tpu.memory.staging import native_hash_partition_order
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
-from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.shuffle.partitioner import (
+    HashPartitioner,
+    RangePartitioner,
+)
 from sparkrdma_tpu.utils.columns import (
     ColumnBatch,
     combine_columns,
@@ -156,6 +159,31 @@ class ShuffleWriter:
                         counts = np.bincount(
                             pids, minlength=P
                         ).astype(np.int64)
+            if order is None and type(
+                self.handle.partitioner
+            ) is RangePartitioner:
+                # range partitioning: key order IS pid-major order, so
+                # ONE key sort suffices and counts fall out of P-1
+                # binary searches (no pid column, no second sort)
+                spl = self.handle.partitioner.splitters
+                try:
+                    spl_arr = np.asarray(spl)
+                except (TypeError, ValueError):
+                    spl_arr = None
+                if spl_arr is not None and (
+                    spl_arr.dtype != batch.keys.dtype
+                    or spl_arr.dtype.hasobject
+                ):
+                    # dtype mismatch could change comparison semantics
+                    # vs the scalar bisect path — stay generic
+                    spl_arr = None
+                if spl_arr is not None and len(spl_arr) == P - 1:
+                    order = stable_key_order(batch.keys)
+                    sk = take_rows(batch.keys, order)
+                    bounds = np.searchsorted(sk, spl_arr, side="left")
+                    counts = np.diff(
+                        np.concatenate(([0], bounds, [n]))
+                    ).astype(np.int64)
             if order is None:
                 pids = self.handle.partitioner.partition_array(batch.keys)
                 korder = stable_key_order(batch.keys)

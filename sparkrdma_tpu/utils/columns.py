@@ -120,16 +120,24 @@ def take_rows(col: np.ndarray, idx: np.ndarray,
 
 
 def stable_key_order(keys: np.ndarray) -> np.ndarray:
-    """Stable argsort choosing the fastest numpy path: integer keys
-    spanning < 2^16 values (partition ids, modest-cardinality group
-    keys) rebase to uint16 where numpy's stable sort is RADIX — measured
-    ~15x faster than the int64 timsort path (5.6ms vs 86ms per 1M)."""
+    """Stable argsort choosing the fastest path: integer keys spanning
+    < 2^16 values (partition ids, modest-cardinality group keys) rebase
+    to uint16 where numpy's stable sort is RADIX — measured ~15x faster
+    than the int64 timsort path (5.6ms vs 86ms per 1M); WIDE-range
+    int64 keys (the TeraSort shape) ride the native 64-bit LSD radix
+    argsort (~2.5x timsort) when the lib is built."""
     if len(keys) and np.issubdtype(keys.dtype, np.integer):
         kmin = keys.min()
         if int(keys.max()) - int(kmin) < (1 << 16):
             return np.argsort(
                 (keys - kmin).astype(np.uint16), kind="stable"
             )
+        if keys.dtype == np.int64 and len(keys) >= (1 << 14):
+            from sparkrdma_tpu.memory.staging import native_radix_argsort
+
+            order = native_radix_argsort(keys)
+            if order is not None:
+                return order
     return np.argsort(keys, kind="stable")
 
 

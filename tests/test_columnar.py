@@ -352,3 +352,120 @@ def test_tuple_group_by_key_under_columnar_serializer(devices):
     for i in range(200):
         expect.setdefault(i % 5, []).append(i)
     assert out == {k: sorted(v) for k, v in expect.items()}
+
+
+def test_range_partitioner_one_sort_fast_path_routes_like_scalar():
+    """The RangePartitioner columnar fast path (one key sort + binary-
+    searched counts) must route every record exactly like the scalar
+    bisect path, including keys EQUAL to a splitter."""
+    import numpy as np
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.shuffle.partitioner import RangePartitioner
+
+    rng = np.random.default_rng(11)
+    keys = np.concatenate([
+        rng.integers(-(1 << 40), 1 << 40, 30_000).astype(np.int64),
+        np.full(100, 12345, np.int64),  # exact splitter hits
+    ])
+    vals = np.arange(len(keys), dtype=np.int64)
+    part = RangePartitioner(5, [-(1 << 39), 0, 12345, 1 << 39])
+    conf = TpuShuffleConf({"spark.shuffle.tpu.serializer": "columnar"})
+    from sparkrdma_tpu.shuffle.manager import (
+        ShuffleHandle,
+        TpuShuffleManager,
+    )
+    from sparkrdma_tpu.transport import LoopbackNetwork
+    from sparkrdma_tpu.utils.columns import ColumnBatch
+
+    net = LoopbackNetwork()
+    mgr = TpuShuffleManager(conf, is_driver=True, network=net,
+                            stage_to_device=False)
+    try:
+        handle = ShuffleHandle(99, 1, part)
+        mgr.register_shuffle(99, 1, part)
+        w = mgr.get_writer(handle, 0)
+        w.write_columns(ColumnBatch(keys, vals))
+        batch, order, counts = w._col_pending[-1]
+        expect = np.bincount(
+            np.fromiter((part.partition(int(k)) for k in keys), np.int64),
+            minlength=5,
+        )
+        assert np.array_equal(counts, expect)
+        sk = keys[order]
+        bounds = np.cumsum(counts)
+        for p in range(5):
+            lo = 0 if p == 0 else bounds[p - 1]
+            seg = sk[lo:bounds[p]]
+            assert (np.diff(seg) >= 0).all()  # key-sorted within pid
+            for k in (seg[:1], seg[-1:]):
+                if len(k):
+                    assert part.partition(int(k[0])) == p
+        w.stop(True)
+    finally:
+        mgr.stop()
+
+
+# -- vectorized narrow plane (map_values / filter / sample) ------------------
+
+def test_columnar_map_values_filter_stay_columnar(devices):
+    rng = np.random.default_rng(21)
+    N = 50_000
+    keys = rng.integers(0, 64, N).astype(np.int64)
+    vals = rng.integers(-100, 100, N).astype(np.int64)
+    with TpuShuffleContext(num_executors=2, conf=_columnar_conf(),
+                           base_port=47300, stage_to_device=False) as ctx:
+        ds = (
+            ctx.parallelize_columns(keys, vals, num_slices=4)
+            .map_values(lambda v: v * 2)
+            .filter(lambda kv: kv[1] > 10)
+        )
+        assert ds._is_columnar  # the chain did NOT de-columnarize
+        got = dict(ds.reduce_by_key("sum", num_partitions=4).collect())
+    expect = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        if v * 2 > 10:
+            expect[k] = expect.get(k, 0) + v * 2
+    assert got == expect
+
+
+def test_columnar_narrow_fallback_matches_vectorized(devices):
+    """A non-vectorizable callable (str payloads) produces the same
+    records through the per-record fallback."""
+    rng = np.random.default_rng(22)
+    N = 5_000
+    keys = rng.integers(0, 16, N).astype(np.int64)
+    vals = rng.integers(0, 50, N).astype(np.int64)
+    with TpuShuffleContext(num_executors=2, conf=_columnar_conf(),
+                           base_port=47350, stage_to_device=False) as ctx:
+        ds_vec = (
+            ctx.parallelize_columns(keys, vals, num_slices=3)
+            .map_values(lambda v: v + 1)
+        )
+        # same op, defeats vectorization (returns a list per element)
+        ds_slow = (
+            ctx.parallelize_columns(keys, vals, num_slices=3)
+            .map_values(lambda v: (v + 1) if np.ndim(v) == 0 else _no(v))
+        )
+        def _no(v):
+            raise TypeError("not vectorizable")
+        a = sorted(ds_vec.collect())
+        b = sorted(ds_slow.collect())
+    assert [(k, int(v)) for k, v in a] == [(k, int(v)) for k, v in b]
+
+
+def test_columnar_sample_deterministic(devices):
+    rng = np.random.default_rng(23)
+    N = 40_000
+    keys = rng.integers(0, 8, N).astype(np.int64)
+    vals = np.arange(N, dtype=np.int64)
+    with TpuShuffleContext(num_executors=2, conf=_columnar_conf(),
+                           base_port=47400, stage_to_device=False) as ctx:
+        s = ctx.parallelize_columns(keys, vals, num_slices=4).sample(
+            0.25, seed=3
+        )
+        assert s._is_columnar
+        c1, r1 = s.count(), sorted(v for _k, v in s.collect())
+        c2, r2 = s.count(), sorted(v for _k, v in s.collect())
+    assert c1 == c2 and r1 == r2
+    assert 0.2 < c1 / N < 0.3

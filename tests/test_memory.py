@@ -340,3 +340,27 @@ def test_native_hash_partition_order_matches_numpy():
         ref_counts = np.bincount(pids, minlength=P).astype(np.int64)
         assert np.array_equal(counts, ref_counts), (trial, n, P)
         assert np.array_equal(order, ref_order), (trial, n, P)
+
+
+def test_native_radix_argsort_matches_numpy_stable():
+    import numpy as np
+
+    from sparkrdma_tpu.memory.staging import native_radix_argsort
+
+    rng = np.random.default_rng(3)
+    cases = [
+        rng.integers(-(1 << 62), 1 << 62, 100_000).astype(np.int64),
+        rng.integers(-5, 5, 50_000).astype(np.int64),  # heavy ties
+        np.zeros(1000, np.int64),
+        np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max, 0, -1, 1],
+                 np.int64),
+        np.arange(70_000, dtype=np.int64)[::-1].copy(),
+    ]
+    for keys in cases:
+        got = native_radix_argsort(keys)
+        if got is None:
+            import pytest
+
+            pytest.skip("native staging lib not built")
+        ref = np.argsort(keys, kind="stable")
+        assert np.array_equal(got, ref), keys[:8]
