@@ -202,15 +202,31 @@ def main():
         )
 
     # a wedged grant mid-wide-path hangs in device_get without raising;
-    # this timer converts that hang into the 8B fallback line + exit
-    def _wide_hang():
-        print("bench.py: wide path unresponsive for 600s — emitting "
-              "8B-shape fallback and aborting", file=sys.stderr,
-              flush=True)
-        print(_fallback_record("wide_path_hang"), flush=True)
-        os._exit(0)
+    # this timer converts that hang into the 8B fallback line + exit.
+    # _emit_once makes timer and main path mutually exclusive so the
+    # ONE-JSON-line contract holds even if the timer races completion;
+    # 1800s is generous enough that a slow-but-progressing run (two
+    # compiles + warmup + ITERS wide sorts through the tunnel) is not
+    # mislabeled as a hang
+    emit_lock = threading.Lock()
+    emitted = [False]
 
-    wtimer = threading.Timer(600, _wide_hang)
+    def _emit_once(line):
+        with emit_lock:
+            if emitted[0]:
+                return False
+            emitted[0] = True
+        print(line, flush=True)
+        return True
+
+    def _wide_hang():
+        if _emit_once(_fallback_record("wide_path_hang")):
+            print("bench.py: wide path unresponsive for 1800s — "
+                  "emitted 8B-shape fallback, aborting",
+                  file=sys.stderr, flush=True)
+            os._exit(0)
+
+    wtimer = threading.Timer(1800, _wide_hang)
     wtimer.daemon = True
     wtimer.start()
     try:
@@ -219,10 +235,10 @@ def main():
         wtimer.cancel()
         print(f"# wide path failed ({e!r}); emitting 8B-shape fallback",
               file=sys.stderr, flush=True)
-        print(_fallback_record(f"wide_path_error: {e!r}"), flush=True)
+        _emit_once(_fallback_record(f"wide_path_error: {e!r}"))
         return
     wtimer.cancel()
-    print(
+    _emit_once(
         json.dumps(
             {
                 "metric": "terasort shuffle+sort throughput per chip, "

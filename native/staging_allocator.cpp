@@ -304,6 +304,24 @@ extern "C" int hash_partition_order(
 static thread_local uint64_t* rs_keys[2] = {nullptr, nullptr};
 static thread_local int64_t* rs_idx[2] = {nullptr, nullptr};
 static thread_local uint64_t rs_cap = 0;
+// retention cap: scratch above this (32 B/row -> 64 MiB) is freed after
+// the sort so a pool of writer threads cannot pin hundreds of MB for
+// the process lifetime; smaller batches keep warm pages
+static constexpr uint64_t RS_RETAIN_ROWS = 1ULL << 21;
+
+static void rs_free_scratch() {
+  for (int b = 0; b < 2; b++) {
+    free(rs_keys[b]);
+    free(rs_idx[b]);
+    rs_keys[b] = nullptr;
+    rs_idx[b] = nullptr;
+  }
+  rs_cap = 0;
+}
+
+// explicit per-thread trim hook (callers that know they are done
+// sorting can release even sub-threshold scratch)
+extern "C" void radix_scratch_trim() { rs_free_scratch(); }
 
 extern "C" int radix_argsort_i64(const int64_t* keys, uint64_t n,
                                  int64_t* order_out) {
@@ -317,11 +335,7 @@ extern "C" int radix_argsort_i64(const int64_t* keys, uint64_t n,
       rs_keys[b] = static_cast<uint64_t*>(malloc(cap * 8));
       rs_idx[b] = static_cast<int64_t*>(malloc(cap * 8));
       if (!rs_keys[b] || !rs_idx[b]) {
-        for (int c = 0; c < 2; c++) {
-          free(rs_keys[c]); free(rs_idx[c]);
-          rs_keys[c] = nullptr; rs_idx[c] = nullptr;
-        }
-        rs_cap = 0;
+        rs_free_scratch();
         return -2;
       }
     }
@@ -362,5 +376,6 @@ extern "C" int radix_argsort_i64(const int64_t* keys, uint64_t n,
     cur ^= 1;
   }
   memcpy(order_out, rs_idx[cur], n * 8);
+  if (rs_cap > RS_RETAIN_ROWS) rs_free_scratch();
   return 0;
 }

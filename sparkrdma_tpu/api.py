@@ -327,11 +327,39 @@ class TpuShuffleContext:
         # executors below is deliberate, not a failure to report
         self.driver.quiesce()
         for p in self._pools:
+            self._trim_pool_scratch(p)
             p.shutdown(wait=True)
         for m in self.executors + [self.driver]:
             m.stop()
         if hasattr(self.network, "coordinator"):
             self.network.stop()
+
+    @staticmethod
+    def _trim_pool_scratch(pool: ThreadPoolExecutor) -> None:
+        """Release per-thread native radix scratch on every worker of a
+        retiring pool (the scratch is thread_local, so each worker must
+        run the trim itself; a barrier makes each take exactly one)."""
+        import threading
+
+        from sparkrdma_tpu.memory.staging import native_radix_scratch_trim
+
+        workers = len(pool._threads)
+        if not workers:
+            return
+        barrier = threading.Barrier(workers)
+
+        def _trim():
+            try:
+                barrier.wait(timeout=5)
+            except threading.BrokenBarrierError:
+                pass  # a busy/dead worker: trim whoever arrived
+            native_radix_scratch_trim()
+
+        for f in [pool.submit(_trim) for _ in range(workers)]:
+            try:
+                f.result(timeout=10)
+            except Exception:
+                break
 
     def __enter__(self):
         return self
@@ -621,7 +649,14 @@ class Dataset:
 
         def sample_part(part, pidx, seed=seed, fraction=fraction):
             if isinstance(part, ColumnBatch):
-                rng = np.random.default_rng(abs(hash((seed, pidx, "c"))))
+                # salt-free seed mix: str hashing is PYTHONHASHSEED-
+                # salted and would break cross-process determinism;
+                # SeedSequence keeps the FULL seed (no truncation)
+                rng = np.random.default_rng(
+                    np.random.SeedSequence(
+                        [seed & ((1 << 64) - 1), pidx, 0xC0]
+                    )
+                )
                 mask = rng.random(len(part)) < fraction
                 return ColumnBatch(
                     part.keys[mask], part.vals[mask],

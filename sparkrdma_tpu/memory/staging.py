@@ -65,6 +65,9 @@ def _load_native():
             ctypes.c_int64, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
+    if hasattr(lib, "radix_scratch_trim"):
+        lib.radix_scratch_trim.restype = None
+        lib.radix_scratch_trim.argtypes = []
     return lib
 
 
@@ -115,6 +118,14 @@ def native_radix_argsort(keys: np.ndarray):
     if rc != 0:
         return None
     return order
+
+
+def native_radix_scratch_trim() -> None:
+    """Release the CALLING thread's radix-sort scratch (scratch above
+    64 MiB is auto-freed after each sort; this hook drops the warm
+    sub-threshold pages too — call it when a writer thread retires)."""
+    if _NATIVE is not None and hasattr(_NATIVE, "radix_scratch_trim"):
+        _NATIVE.radix_scratch_trim()
 
 
 def native_hash_partition_order(keys: np.ndarray, num_partitions: int,

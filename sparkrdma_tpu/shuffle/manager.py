@@ -460,10 +460,16 @@ class TpuShuffleManager:
         if self._stopped or self._hb_stop.is_set():
             return
         if (isinstance(err, RuntimeError)
-                and "cannot schedule new futures" in str(err)):
-            # executor/transport pools are gone because the process (or
-            # harness) is shutting down — that is quiescence, not an
-            # executor failure; stop probing instead of spamming prunes
+                and "cannot schedule new futures" in str(err)
+                and ("interpreter shutdown" in str(err)
+                     or self._stopped
+                     or self.node._stopped.is_set())):
+            # OUR pools (or the interpreter) are shutting down — that is
+            # quiescence, not an executor failure; stop probing instead
+            # of spamming prunes.  A single dead peer channel's pool can
+            # raise the same RuntimeError; that case must still prune,
+            # so only quiesce when the shutdown is provably ours.
+            logger.info("heartbeat monitor quiescing (%s)", err)
             self._hb_stop.set()
             return
         with self._executors_lock:

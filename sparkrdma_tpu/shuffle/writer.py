@@ -131,8 +131,14 @@ class ShuffleWriter:
             is_hash = type(self.handle.partitioner) is HashPartitioner
             if is_hash and np.issubdtype(batch.keys.dtype, np.integer):
                 kmin = int(batch.keys.min())
-                krange = int(batch.keys.max()) - kmin + 1
-                if krange * P <= (1 << 16):
+                kmax = int(batch.keys.max())
+                krange = kmax - kmin + 1
+                # uint64 keys past int64.max cannot ride the int64 fast
+                # path (ctypes arg + astype both break); generic
+                # partition_array handles them
+                if kmax <= np.iinfo(np.int64).max and (
+                    krange * P <= (1 << 16)
+                ):
                     # modest-cardinality int keys: ONE fused native
                     # pass (splitmix64 + composite counting sort)
                     # replaces hash + two radix argsorts + two index

@@ -406,6 +406,39 @@ def test_range_partitioner_one_sort_fast_path_routes_like_scalar():
         mgr.stop()
 
 
+def test_hash_fast_path_skips_uint64_overflow_keys():
+    """uint64 keys past int64.max have a small range but cannot ride the
+    int64-rebased fast path (native ctypes arg / astype both break) —
+    they must fall through to the generic partition_array path and still
+    route every record like the scalar partitioner."""
+    from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+    from sparkrdma_tpu.transport import LoopbackNetwork
+
+    keys = np.uint64(1 << 63) + (
+        np.arange(5000, dtype=np.uint64) % np.uint64(7)
+    )
+    vals = np.arange(len(keys), dtype=np.int64)
+    P = 4
+    part = HashPartitioner(P)
+    net = LoopbackNetwork()
+    mgr = TpuShuffleManager(_columnar_conf(), is_driver=True, network=net,
+                            stage_to_device=False)
+    try:
+        handle = ShuffleHandle(98, 1, part)
+        mgr.register_shuffle(98, 1, part)
+        w = mgr.get_writer(handle, 0)
+        w.write_columns(ColumnBatch(keys, vals))
+        batch, order, counts = w._col_pending[-1]
+        expect = np.bincount(part.partition_array(keys), minlength=P)
+        assert np.array_equal(counts, expect)
+        if order is not None:
+            pids = part.partition_array(keys)[order]
+            assert (np.diff(pids) >= 0).all()  # pid-major order
+        w.stop(True)
+    finally:
+        mgr.stop()
+
+
 # -- vectorized narrow plane (map_values / filter / sample) ------------------
 
 def test_columnar_map_values_filter_stay_columnar(devices):
