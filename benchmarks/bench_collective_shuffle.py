@@ -1,14 +1,15 @@
 #!/usr/bin/env python
-"""Integrated record-plane shuffle over the COLLECTIVE read plane.
+"""Integrated record-plane shuffle over the UNIFIED device plane.
 
-BASELINE config 2's round-2 form: the same groupByKey record job as
+BASELINE config 2's round-4 form: the same groupByKey record job as
 ``bench_local_baseline`` (shared workload from benchmarks/common.py),
-but with map outputs committed into per-device HBM arenas and every
-remote fetch executed as pack + ``all_to_all`` tile rounds over the
-mesh (parallel/collective_read.py) — the write → publish → resolve →
-exchange → read integration standing in for the reference's commit →
-publish → FetchMapStatus → scatter RDMA READ pipeline
-(RdmaShuffleFetcherIterator.scala:162-171, RdmaChannel.java:441-474).
+but every byte moving via driver-planned window collectives
+(readPlane=windowed, shuffle/bulk.py WindowedReadPlane) — the write →
+publish → plan windows → TileExchange → reducer reads integration
+standing in for the reference's commit → publish → FetchMapStatus →
+scatter RDMA READ pipeline (RdmaShuffleFetcherIterator.scala:162-171,
+RdmaChannel.java:441-474).  Supersedes the round-2/3 coordinator
+variant (parallel/collective_read.py, now a test fixture).
 
 Needs ≥4 mesh devices; on the single-chip bench host it re-execs onto
 a spoofed 8-device CPU mesh, so the number gauges the integrated
@@ -39,26 +40,21 @@ def main():
     keys, vals = canonical_record_workload(n_records, payload, n_keys)
     conf = TpuShuffleConf()
     conf.set("serializer", "columnar")
-    conf.set("readPlane", "collective")
-    conf.set("deviceArenaBytes", 256 << 20)
-    # collective tile rounds amortize over LARGE grouped fetches: widen
-    # the reference's NIC-era defaults (256k groups / 1m window)
-    conf.set("shuffleReadBlockSize", "32m")
-    conf.set("maxAggBlock", "32m")
-    conf.set("maxBytesInFlight", "128m")
+    conf.set("readPlane", "windowed")
+    conf.set("bulkWindowMaps", "2")
     conf.set("exchangeTileBytes", "16m")
-    conf.set("exchangeFlush", "10ms")
 
     with TpuShuffleContext(num_executors=4, conf=conf) as ctx:
         best = time_group_by_key(ctx, keys, vals, n_keys)
-        stats = ctx.network.coordinator.stats()
-        assert stats["rounds_executed"] > 0, "collective plane never ran"
-        assert stats["fallback_blocks"] == 0, "collective plane fell back"
+        stats = ctx.executors[0].windowed_plane._bulk.exchange.stats()
+        assert stats["rounds_executed"] > 0, "windowed plane never ran"
+        assert stats["payload_bytes_moved"] > 0, "no payload exchanged"
 
     gbps = n_records * payload / best / 1e9
     emit(
-        f"collective-plane groupByKey end-to-end throughput "
-        f"({n_records} x {payload}B records, arena + all_to_all rounds)",
+        f"windowed-plane groupByKey end-to-end throughput "
+        f"({n_records} x {payload}B records, plan windows + "
+        f"all_to_all rounds)",
         gbps, "GB/s", gbps / ROCE_LINE_RATE_GBPS,
     )
 

@@ -22,6 +22,7 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.utils.columns import ColumnBatch
 from sparkrdma_tpu.utils.types import (
     BlockLocation,
     BlockManagerId,
@@ -32,6 +33,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "TpuShuffleConf",
+    "ColumnBatch",
     "BlockLocation",
     "BlockManagerId",
     "ShuffleManagerId",

@@ -25,6 +25,7 @@ plane.
 from __future__ import annotations
 
 import itertools
+import logging
 import random
 import threading
 from collections import defaultdict
@@ -47,6 +48,8 @@ from sparkrdma_tpu.shuffle.partitioner import (
 from sparkrdma_tpu.transport import LoopbackNetwork
 from sparkrdma_tpu.utils.columns import ColumnBatch
 
+logger = logging.getLogger(__name__)
+
 
 class TpuShuffleContext:
     """Driver + executor managers + a task pool per executor."""
@@ -66,36 +69,27 @@ class TpuShuffleContext:
         self.conf = conf or TpuShuffleConf()
         if network is not None:
             self.network = network
-        elif self.conf.read_plane == "collective":
-            # bulk fetches between executors ride all_to_all tile
-            # rounds over the device mesh (SURVEY §7 READ inversion);
-            # default mesh = exactly one device per executor, so no
-            # placeholder arenas join the collective
-            from sparkrdma_tpu.parallel.collective_read import (
-                CollectiveNetwork,
-            )
-            from sparkrdma_tpu.parallel.mesh import make_mesh
-
-            if mesh is None:
-                import jax
-
-                mesh = make_mesh(
-                    min(num_executors, len(jax.devices()))
-                )
-            self.network = CollectiveNetwork(
-                mesh=mesh,
-                tile_bytes=self.conf.exchange_tile_bytes,
-                flush_ms=self.conf.exchange_flush_ms,
-            )
         else:
-            if self.conf.read_plane == "bulk":
+            if self.conf.read_plane == "collective":
+                # the opportunistic in-process coordinator is a test
+                # fixture now (parallel/collective_read.py): the
+                # windowed plane is reactive AND multi-process, so
+                # production configs route there (pass an explicit
+                # CollectiveNetwork as ``network=`` to use the fixture)
+                logger.warning(
+                    "readPlane=collective is superseded by the unified "
+                    "windowed plane; using readPlane=windowed"
+                )
+                self.conf.set("readPlane", "windowed")
+            if self.conf.read_plane in ("bulk", "windowed"):
                 import jax
 
                 n_dev = len(jax.devices())
                 if num_executors > n_dev:
                     raise ValueError(
-                        f"bulk read plane: {num_executors} executors "
-                        f"need {num_executors} mesh devices, have {n_dev}"
+                        f"{self.conf.read_plane} read plane: "
+                        f"{num_executors} executors need "
+                        f"{num_executors} mesh devices, have {n_dev}"
                     )
             self.network = LoopbackNetwork()
         self.driver = TpuShuffleManager(
@@ -120,6 +114,35 @@ class TpuShuffleContext:
                 )
             for i, ex in enumerate(self.executors):
                 self.network.attach_executor(ex, i)
+        if self.conf.read_plane == "windowed":
+            # in-process executors share ONE contribution barrier per
+            # window (one collective, every executor's row aboard) —
+            # across OS processes each manager's plane runs its own
+            # exchange and the collective itself is the barrier
+            from sparkrdma_tpu.parallel.exchange import TileExchange
+            from sparkrdma_tpu.parallel.mesh import make_mesh
+            from sparkrdma_tpu.shuffle.bulk import (
+                BulkShuffleSession,
+                WindowedReadPlane,
+            )
+
+            E = num_executors
+            # the exchange mesh must carry exactly one device per
+            # executor (streams are [E][E]); a caller-provided mesh of
+            # any other size is for the device-native workloads, not
+            # the shuffle session
+            sess_mesh = mesh
+            if sess_mesh is None or len(
+                list(sess_mesh.devices.flat)
+            ) != E:
+                sess_mesh = make_mesh(E)
+            session = BulkShuffleSession(
+                TileExchange.from_conf(self.conf, sess_mesh),
+                E,
+                timeout_s=self.conf.bulk_barrier_timeout_ms / 1000.0,
+            )
+            for ex in self.executors:
+                ex.windowed_plane = WindowedReadPlane(ex, session=session)
         self._pools = [
             ThreadPoolExecutor(
                 max_workers=tasks_per_executor,
@@ -238,6 +261,14 @@ class TpuShuffleContext:
         if self.conf.read_plane == "bulk":
             out = self._bulk_reduce(handle, shuffle_id)
         else:
+            if self.conf.read_plane == "windowed":
+                # symmetric participation: an executor owning no
+                # partition of this shuffle still joins every window's
+                # collective
+                for ex in self.executors:
+                    if ex.windowed_plane is not None:
+                        ex.windowed_plane.join(shuffle_id)
+
             def reduce_task(pid: int) -> List[Tuple[Any, Any]]:
                 ex = self.executors[pid % E]
                 reader = ex.get_reader(handle, pid, pid + 1, mbh)
@@ -368,6 +399,55 @@ class TpuShuffleContext:
         self.stop()
 
 
+def _try_vectorized_pair(f, batch: "ColumnBatch",
+                         elementwise: bool = True):
+    """Apply ``f`` to the ``(keys, vals)`` column pair and accept the
+    result only when it is a clean ``(keys', vals')`` column pair:
+    a 2-tuple of 1-D non-object ndarrays of equal length (scalars
+    broadcast against the other column).  ``elementwise`` additionally
+    requires exactly ``len(batch)`` rows (map); without it any common
+    length is accepted (flat_map, whose vectorized form must emit
+    outputs in per-record concatenation order).  Returns a ColumnBatch
+    or None — the caller re-applies ``f`` per record, so ``f`` must be
+    pure."""
+    n = len(batch)
+    try:
+        out = f((batch.keys, batch.vals))
+    except Exception:
+        return None
+    if not (isinstance(out, tuple) and len(out) == 2):
+        return None
+    k, v = out
+    k_arr = isinstance(k, np.ndarray)
+    v_arr = isinstance(v, np.ndarray)
+    if not (k_arr or v_arr):
+        return None
+    # ONLY plain Python literals broadcast (the (key, 1) wordcount
+    # shape).  A numpy scalar is the result of a column REDUCTION
+    # (kv[1].max() etc.) — broadcasting it would silently replace every
+    # value with the partition aggregate, so reductions must fall back
+    # to the per-record loop where they keep identity semantics.
+    scalar_kinds = (bool, int, float, bytes, str)
+    if not k_arr:
+        if isinstance(k, np.generic) or not isinstance(k, scalar_kinds):
+            return None
+        k = np.full(len(v), k)
+    if not v_arr:
+        if isinstance(v, np.generic) or not isinstance(v, scalar_kinds):
+            return None
+        v = np.full(len(k), v)
+    if k.ndim != 1 or v.ndim != 1 or k.shape != v.shape:
+        return None
+    if k.dtype.hasobject or v.dtype.hasobject:
+        return None
+    if elementwise and k.shape[0] != n:
+        return None
+    try:
+        return ColumnBatch(k, v)
+    except Exception:
+        return None
+
+
 def _try_vectorized(f, arg, n: int, kinds: str = ""):
     """Apply ``f`` to a whole column (or column pair) and accept the
     result only when it is a clean elementwise vector: an ndarray of
@@ -428,7 +508,24 @@ class Dataset:
         return Dataset(self.ctx, self._parts, fused)
 
     def map(self, f: Callable[[Any], Any]) -> "Dataset":
-        return self._chain(lambda part: [f(x) for x in part])
+        """Columnar partitions first try ``f`` VECTORIZED over the
+        ``(keys, vals)`` column pair: a key+value producing map like
+        ``lambda kv: (kv[0] % 10, kv[1] * 2)`` runs as numpy passes and
+        the chain STAYS columnar; anything that doesn't evaluate to a
+        clean same-length column pair (including maps to non-pair
+        records, e.g. ``keys()``) falls back to the per-record loop.
+        ``f`` must be pure — the fallback re-applies it."""
+
+        def m(part, _pidx, f=f):
+            if isinstance(part, ColumnBatch):
+                out = _try_vectorized_pair(f, part, elementwise=True)
+                if out is not None:
+                    return out
+                part = list(part)
+            return [f(x) for x in part]
+
+        m._columnar_ok = True
+        return self._chain_indexed(m)
 
     def filter(self, f: Callable[[Any], bool]) -> "Dataset":
         """Columnar partitions first try ``f`` VECTORIZED over the
@@ -455,7 +552,31 @@ class Dataset:
         return self._chain_indexed(fl)
 
     def flat_map(self, f: Callable[[Any], Iterable[Any]]) -> "Dataset":
-        return self._chain(lambda part: [y for x in part for y in f(x)])
+        """Columnar partitions stay columnar when ``f`` returns a
+        :class:`ColumnBatch` (e.g. ``lambda kv: ColumnBatch(
+        np.repeat(kv[0], 2), np.repeat(kv[1], 2))``) — the ONE return
+        shape whose semantics agree between the vectorized call (whole
+        column pair in, batch out) and the per-record fallback
+        (iterating a ColumnBatch yields its (key, value) records, so
+        ``[y for x in part for y in f(x)]`` flattens to the same
+        stream).  A plain tuple return is deliberately NOT treated as
+        a column pair: the fallback would flatten it into its two
+        elements, a different dataset.  ``f`` must be pure and emit
+        outputs in per-record concatenation order."""
+
+        def fm(part, _pidx, f=f):
+            if isinstance(part, ColumnBatch):
+                try:
+                    out = f((part.keys, part.vals))
+                except Exception:
+                    out = None
+                if isinstance(out, ColumnBatch):
+                    return out
+                part = list(part)
+            return [y for x in part for y in f(x)]
+
+        fm._columnar_ok = True
+        return self._chain_indexed(fm)
 
     def map_partitions(self, f: Callable[[List[Any]], Iterable[Any]]) -> "Dataset":
         return self._chain(lambda part: list(f(part)))

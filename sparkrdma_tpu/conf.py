@@ -240,11 +240,14 @@ class TpuShuffleConf:
     @property
     def read_plane(self) -> str:
         """Bulk fetch plane: ``host`` (loopback/TCP one-sided byte
-        reads), ``collective`` (fetches between mesh-resident executors
-        batch into all_to_all tile rounds over ICI — the SURVEY §7
+        reads), ``windowed`` (the unified device plane — reducers issue
+        reads through get_reader and the bytes ride driver-planned
+        window collectives, reactive AND multi-process; SURVEY §7
         "one-sided READ pull model" inversion), or ``bulk``
-        (bulk-synchronous: ONE plan barrier + ONE symmetric collective
-        per shuffle, the multi-host mode — shuffle/bulk.py)."""
+        (bulk-synchronous whole-shuffle exchange via BulkExchangeReader
+        — shuffle/bulk.py).  ``collective`` (the in-process
+        opportunistic coordinator, parallel/collective_read.py) is a
+        test fixture superseded by ``windowed``."""
         return str(self.get("readPlane", "host")).lower()
 
     @property

@@ -1,5 +1,17 @@
-"""The collective read plane: grouped block fetches executed as
-all_to_all tile rounds over the device mesh.
+"""TEST FIXTURE — the in-process opportunistic collective read plane.
+
+Superseded by the unified windowed plane (``readPlane=windowed``,
+shuffle/bulk.py WindowedReadPlane), which is reactive AND
+multi-process: cross-process agreement on collective launches comes
+from the driver's window plans instead of this module's per-process
+batching, so a pod job gets both properties at once.  Production
+configs that ask for ``readPlane=collective`` are routed to the
+windowed plane; tests opt into this fixture by passing an explicit
+``CollectiveNetwork`` to ``TpuShuffleContext`` (arena/ODP mechanics
+are still exercised here and in tests/test_lazy_staging.py).
+
+Original design: grouped block fetches executed as all_to_all tile
+rounds over the device mesh.
 
 This is the integration the north star demands (SURVEY.md §7 "One-sided
 READ pull model", VERDICT round-1 item 1): the control plane still

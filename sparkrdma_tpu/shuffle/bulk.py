@@ -40,7 +40,10 @@ import numpy as np
 
 from sparkrdma_tpu.parallel.exchange import TileExchange
 from sparkrdma_tpu.rpc.messages import FetchExchangePlanMsg
-from sparkrdma_tpu.shuffle.reader import MetadataFetchFailedError
+from sparkrdma_tpu.shuffle.reader import (
+    FetchFailedError,
+    MetadataFetchFailedError,
+)
 
 
 class BulkShuffleSession:
@@ -66,6 +69,11 @@ class BulkShuffleSession:
         # round's outcome, not the latest
         self._results = {}
         self._gen = 0
+        # explicitly keyed rounds ((shuffle_id, window) from the
+        # windowed plane): CONCURRENT shuffles on one session each get
+        # their own barrier instead of cross-contributing rows into a
+        # shared generation
+        self._keyed: dict = {}
         self._aborted = None  # sticky: a failed participant poisons all
 
     def abort(self, error: BaseException) -> None:
@@ -76,10 +84,18 @@ class BulkShuffleSession:
             self._aborted = error
             self._cv.notify_all()
 
-    def run(self, me: int, row: List[bytes], lengths: np.ndarray):
+    def run(self, me: int, row: List[bytes], lengths: np.ndarray,
+            round_key=None):
         """Contribute source row ``me``; blocks until every host
         contributed and the one exchange ran.  Returns the shared
-        result."""
+        result.
+
+        ``round_key`` (e.g. ``(shuffle_id, window)``) isolates this
+        round's barrier: callers that may run several shuffles
+        concurrently through ONE session MUST pass it — unkeyed rounds
+        share a single generation counter and would cross-contribute."""
+        if round_key is not None:
+            return self._run_keyed(me, row, lengths, round_key)
         with self._cv:
             if self._aborted is not None:
                 raise RuntimeError(
@@ -134,6 +150,320 @@ class BulkShuffleSession:
             if error is not None:
                 raise error
             return result
+
+    def _run_keyed(self, me: int, row: List[bytes], lengths: np.ndarray,
+                   key) -> object:
+        with self._cv:
+            if self._aborted is not None:
+                raise RuntimeError(
+                    "bulk exchange aborted by a failed participant"
+                ) from self._aborted
+            st = self._keyed.get(key)
+            if st is None:
+                st = self._keyed[key] = {
+                    "rows": {}, "lengths": np.asarray(lengths),
+                    "result": None, "error": None, "done": False,
+                    "delivered": 0,
+                }
+            elif not np.array_equal(st["lengths"], lengths):
+                raise ValueError(
+                    f"contributors disagree on the lengths matrix "
+                    f"(round {key})"
+                )
+            if me in st["rows"]:
+                raise ValueError(
+                    f"row {me} contributed twice (round {key})"
+                )
+            st["rows"][me] = row
+            if len(st["rows"]) == self.n_hosts:
+                E = self.n_hosts
+                streams = [[b""] * E for _ in range(E)]
+                for s, r in st["rows"].items():
+                    streams[s] = list(r)
+                try:
+                    st["result"] = self.exchange.exchange_bytes(
+                        streams, lengths=st["lengths"],
+                        local_sources=frozenset(st["rows"]),
+                    )
+                except BaseException as e:
+                    st["error"] = e
+                st["done"] = True
+                self._cv.notify_all()
+            else:
+                deadline = time.monotonic() + self.timeout_s
+                while not st["done"] and self._aborted is None:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cv.wait(timeout=left):
+                        raise TimeoutError(
+                            f"bulk exchange barrier (round {key}): not "
+                            f"every host contributed within "
+                            f"{self.timeout_s:.0f}s (conf "
+                            f"spark.shuffle.tpu.bulkBarrierTimeout)"
+                        )
+                if self._aborted is not None:
+                    raise RuntimeError(
+                        "bulk exchange aborted by a failed participant"
+                    ) from self._aborted
+            result, error = st["result"], st["error"]
+            st["delivered"] += 1
+            if st["delivered"] >= self.n_hosts:
+                self._keyed.pop(key, None)  # all participants served
+            if error is not None:
+                raise error
+            return result
+
+
+def iter_plan_blocks(plan, E: int, row):
+    """Walk one exchange result row by its plan manifest: yields
+    ``(source, map_id, reduce_id, block bytes)`` for every block this
+    host received — the ONE offset-slicing loop shared by the windowed
+    pump and both bulk consumption paths (a second copy drifting on
+    manifest layout would silently misalign block boundaries)."""
+    for s in range(E):
+        data = row[s]
+        off = 0
+        for map_id, reduce_id, n in plan.manifest[s]:
+            yield s, map_id, reduce_id, data[off : off + n]
+            off += n
+
+
+class _ShuffleWindows:
+    """Per-shuffle receive state shared by every reader on one executor:
+    windows of (map_id, reduce_id, block bytes) delivered by the pump,
+    a final flag, and a sticky error."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._windows: List[List[tuple]] = []
+        self._events: List[tuple] = []  # (window, t, bytes) per deliver
+        self.hosts = None   # canonical host order, pinned at window 0
+        self.me = -1        # this executor's index in hosts
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    def deliver(self, blocks: List[tuple], final: bool, hosts,
+                me: int, payload_bytes: int) -> None:
+        with self._cv:
+            if self.hosts is None:
+                self.hosts = tuple(hosts)
+                self.me = me
+            self._windows.append(blocks)
+            self._events.append(
+                (len(self._windows) - 1, time.monotonic(), payload_bytes)
+            )
+            if final:
+                self._done = True
+            self._cv.notify_all()
+
+    def fail(self, err: BaseException) -> None:
+        with self._cv:
+            if self._error is None:
+                self._error = err
+            self._done = True
+            self._cv.notify_all()
+
+    def wait_beyond(self, idx: int, timeout_s: float):
+        """Block until there are windows past ``idx`` (or the shuffle
+        finished/failed); returns (new windows, done)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while len(self._windows) <= idx and not self._done:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    raise TimeoutError(
+                        f"no exchange window beyond {idx} within "
+                        f"{timeout_s:.0f}s"
+                    )
+            if self._error is not None:
+                raise self._error
+            return list(self._windows[idx:]), self._done
+
+    @property
+    def window_events(self) -> List[tuple]:
+        with self._cv:
+            return list(self._events)
+
+
+class WindowedReadPlane:
+    """The unified reactive device plane (readPlane=windowed).
+
+    Reducers issue partition reads through ``manager.get_reader`` —
+    the reference's reactive pull model
+    (RdmaShuffleFetcherIterator.scala:241-251) — and the bytes move as
+    the driver's incremental window plans land: ONE symmetric
+    TileExchange collective per window per shuffle, shared by every
+    reader on this executor (the window pump).  Reactive AND
+    multi-process: the same plan RPCs + collectives the bulk plane
+    uses across OS processes, with blocks surfacing to readers
+    window-by-window while straggler maps still write.
+
+    This supersedes the in-process-only opportunistic coordinator
+    (parallel/collective_read.py, now a test fixture): cross-process
+    agreement on collective launches comes from the driver's window
+    plans instead of per-process batching heuristics."""
+
+    def __init__(self, manager, exchange: Optional[TileExchange] = None,
+                 mesh=None, session: Optional[BulkShuffleSession] = None):
+        self.manager = manager
+        self._bulk = BulkExchangeReader(
+            manager, exchange=exchange, mesh=mesh, session=session
+        )
+        self._lock = threading.Lock()
+        self._shuffles = {}
+
+    # -- reader factory (manager.get_reader hook) ---------------------------
+    def reader(self, handle, start_partition: int, end_partition: int):
+        return WindowedShuffleReader(
+            self, handle, start_partition, end_partition
+        )
+
+    def join(self, shuffle_id: int) -> None:
+        """Start this executor's window pump for a shuffle even when it
+        owns no partitions: every host in the plan must join each
+        window's collective (symmetric participation), reader or not."""
+        self._state(shuffle_id)
+
+    def forget(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._shuffles.pop(shuffle_id, None)
+
+    def window_events(self, shuffle_id: int) -> List[tuple]:
+        """(window, completion time, payload bytes) per landed window —
+        the straggler-overlap observability hook."""
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+        return st.window_events if st is not None else []
+
+    # -- the pump -----------------------------------------------------------
+    def _state(self, shuffle_id: int) -> _ShuffleWindows:
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+            if st is None:
+                st = self._shuffles[shuffle_id] = _ShuffleWindows()
+                t = threading.Thread(
+                    target=self._pump, args=(shuffle_id, st),
+                    name=f"windowed-read-{shuffle_id}", daemon=True,
+                )
+                t.start()
+            return st
+
+    def _pump(self, shuffle_id: int, st: _ShuffleWindows) -> None:
+        """One thread per (executor, shuffle): runs the windowed
+        exchanges in order and feeds received blocks to the readers."""
+        try:
+            legacy = self.manager.conf.bulk_window_maps <= 0
+            w = 0
+            while True:
+                plan, E, row = self._bulk._exchange_rows(
+                    shuffle_id, window=(-1 if legacy else w)
+                )
+                me = list(plan.hosts).index(self.manager.local_smid)
+                blocks = list(iter_plan_blocks(plan, E, row))
+                payload = sum(len(b) for _s, _m, _r, b in blocks)
+                final = legacy or plan.final
+                st.deliver(blocks, final, plan.hosts, me, payload)
+                if final:
+                    return
+                w += 1
+        except BaseException as e:
+            st.fail(e)
+
+
+class WindowedShuffleReader:
+    """Reactive reader over the windowed plane: same ``read()``
+    contract as the pull :class:`~sparkrdma_tpu.shuffle.reader
+    .ShuffleReader` (deserialize → aggregate → sort), with block
+    payloads arriving window-by-window.  Partition ownership follows
+    the plan convention ``reduce_id % n_hosts == my index``; asking
+    for a partition another host owns fails loudly."""
+
+    def __init__(self, plane: WindowedReadPlane, handle,
+                 start_partition: int, end_partition: int):
+        from sparkrdma_tpu.shuffle.reader import ReadMetrics
+
+        self.plane = plane
+        self.handle = handle
+        self.start_partition = start_partition
+        self.end_partition = end_partition
+        self.metrics = ReadMetrics()
+
+    def _iter_block_bytes(self):
+        mgr = self.plane.manager
+        st = self.plane._state(self.handle.shuffle_id)
+        timeout_s = max(
+            mgr.conf.partition_location_fetch_timeout_ms,
+            mgr.conf.bulk_barrier_timeout_ms,
+        ) / 1000.0
+        idx = 0
+        checked = False
+        while True:
+            try:
+                wins, done = st.wait_beyond(idx, timeout_s)
+            except FetchFailedError:
+                raise
+            except BaseException as e:
+                raise FetchFailedError(
+                    mgr.local_smid.host, self.handle.shuffle_id, str(e)
+                ) from e
+            if not checked:
+                E = len(st.hosts)
+                for rid in range(self.start_partition,
+                                 self.end_partition):
+                    if rid % E != st.me:
+                        raise FetchFailedError(
+                            mgr.local_smid.host, self.handle.shuffle_id,
+                            f"partition {rid} belongs to host "
+                            f"{rid % E} in the exchange plan, not this "
+                            f"host ({st.me}) — windowed readers must "
+                            f"follow reduce_id % n_hosts ownership",
+                        )
+                checked = True
+            for blocks in wins:
+                for s, _map_id, rid, data in blocks:
+                    if not (
+                        self.start_partition <= rid < self.end_partition
+                    ):
+                        continue
+                    if s == st.me:
+                        self.metrics.local_blocks += 1
+                        self.metrics.local_bytes += len(data)
+                    else:
+                        self.metrics.remote_blocks += 1
+                        self.metrics.remote_bytes += len(data)
+                    yield data
+            idx += len(wins)
+            if done:
+                return
+
+    def read(self):
+        """fetch (window-by-window) → deserialize → aggregate → sort."""
+        from sparkrdma_tpu.shuffle.manager import ColumnarAggregator
+        from sparkrdma_tpu.shuffle.reader import (
+            postprocess_column_batches,
+            postprocess_records,
+        )
+
+        mgr = self.plane.manager
+        agg = self.handle.aggregator
+        if getattr(mgr.serializer, "supports_columns", False) and (
+            agg is None or isinstance(agg, ColumnarAggregator)
+        ):
+            deser = mgr.serializer.deserialize_columns
+            batches = []
+            for data in self._iter_block_bytes():
+                for b in deser(data):
+                    self.metrics.records_read += len(b)
+                    batches.append(b)
+            return postprocess_column_batches(batches, self.handle)
+
+        def _records():
+            deser = mgr.serializer.deserialize
+            for data in self._iter_block_bytes():
+                for rec in deser(data):
+                    self.metrics.records_read += 1
+                    yield rec
+
+        return postprocess_records(_records(), self.handle)
 
 
 class BulkExchangeReader:
@@ -198,9 +528,16 @@ class BulkExchangeReader:
             )
         return box["plan"]
 
-    def _run_exchange(self, shuffle_id: int, me: int, streams, lengths):
+    def _run_exchange(self, shuffle_id: int, me: int, streams, lengths,
+                      window: int = -1):
         if self.session is not None:
-            return self.session.run(me, streams[me], lengths)
+            # key the in-process barrier by (shuffle, window) so
+            # concurrent shuffles through one shared session never
+            # cross-contribute rows
+            return self.session.run(
+                me, streams[me], lengths,
+                round_key=(shuffle_id, window),
+            )
         import jax
 
         dev = self.exchange.devices[me]
@@ -304,7 +641,9 @@ class BulkExchangeReader:
             "shuffle.bulk.exchange", shuffle=shuffle_id, hosts=E,
             window=window, payload_bytes=int(lengths.sum()),
         ):
-            result = self._run_exchange(shuffle_id, me, streams, lengths)
+            result = self._run_exchange(
+                shuffle_id, me, streams, lengths, window=window
+            )
         self.window_events.append(
             (window, time.monotonic(), int(lengths.sum()))
         )
@@ -319,13 +658,8 @@ class BulkExchangeReader:
 
         def _records():
             for plan, E, row in exchanged:
-                for s in range(E):
-                    data = row[s]
-                    off = 0
-                    for _map_id, _reduce_id, n in plan.manifest[s]:
-                        block = data[off : off + n]
-                        off += n
-                        yield from deser(block)
+                for _s, _m, _r, block in iter_plan_blocks(plan, E, row):
+                    yield from deser(block)
 
         return _records()
 
@@ -349,12 +683,9 @@ class BulkExchangeReader:
 
         def _blocks():
             for plan, E, row in exchanged:
-                for s in range(E):
-                    data = row[s]
-                    off = 0
-                    for _map_id, reduce_id, n in plan.manifest[s]:
-                        block = data[off : off + n]
-                        off += n
-                        yield reduce_id, block
+                for _s, _m, reduce_id, block in iter_plan_blocks(
+                    plan, E, row
+                ):
+                    yield reduce_id, block
 
         return _blocks()

@@ -320,6 +320,10 @@ class TpuShuffleManager:
         self._next_callback_id = 1
         self._hello_sent = False
         self._stopped = False
+        # unified reactive device plane (readPlane=windowed): attached
+        # by the job layer (shared in-process session) or lazily built
+        # by get_reader (one exchange per process on a multi-host mesh)
+        self.windowed_plane = None
 
         # heartbeat plane (driver side): last ack time per executor +
         # monitor thread — the CM DISCONNECTED/onBlockManagerRemoved
@@ -1226,7 +1230,20 @@ class TpuShuffleManager:
     ):
         """maps_by_host plays the MapOutputTracker's
         getMapSizesByExecutorId role (RdmaShuffleReader.scala:44-49):
-        which host ran which map tasks — known to the job scheduler."""
+        which host ran which map tasks — known to the job scheduler.
+
+        With ``readPlane=windowed`` the reader instead rides the
+        unified device plane: blocks arrive via driver-planned window
+        collectives (maps_by_host is unused — the plan carries the
+        manifest)."""
+        if self.conf.read_plane == "windowed":
+            from sparkrdma_tpu.shuffle.bulk import WindowedReadPlane
+
+            if self.windowed_plane is None:
+                self.windowed_plane = WindowedReadPlane(self)
+            return self.windowed_plane.reader(
+                handle, start_partition, end_partition
+            )
         from sparkrdma_tpu.shuffle.reader import ShuffleReader
 
         return ShuffleReader(
@@ -1250,6 +1267,8 @@ class TpuShuffleManager:
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self.resolver.remove_shuffle(shuffle_id)
+        if self.windowed_plane is not None:
+            self.windowed_plane.forget(shuffle_id)
         with self._plan_lock:
             self._plan_cache.pop(shuffle_id, None)
             self._shuffle_epoch.pop(shuffle_id, None)

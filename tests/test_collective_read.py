@@ -28,6 +28,20 @@ def _collective_conf(**extra):
     return conf
 
 
+def _collective_ctx(num_executors, conf, base_port):
+    """The coordinator plane is a TEST FIXTURE now (superseded by the
+    windowed plane): contexts opt in by passing the network explicitly,
+    exactly what production configs can no longer reach."""
+    return TpuShuffleContext(
+        num_executors=num_executors, conf=conf, base_port=base_port,
+        network=CollectiveNetwork(
+            mesh=make_mesh(num_executors),
+            tile_bytes=conf.exchange_tile_bytes,
+            flush_ms=conf.exchange_flush_ms,
+        ),
+    )
+
+
 # -- DeviceArena unit coverage ----------------------------------------------
 
 def test_arena_alloc_write_read_roundtrip(devices):
@@ -82,9 +96,7 @@ def test_arena_writes_are_isolated(devices):
 def test_collective_group_by_key(devices):
     """Full shuffle on 4 mesh-attached executors: results correct AND the
     bulk plane actually ran collective rounds with no host fallbacks."""
-    with TpuShuffleContext(
-        num_executors=4, conf=_collective_conf(), base_port=41000
-    ) as ctx:
+    with _collective_ctx(4, _collective_conf(), 41000) as ctx:
         assert isinstance(ctx.network, CollectiveNetwork)
         data = [(i % 37, i) for i in range(4000)]
         out = (
@@ -108,9 +120,13 @@ def test_collective_matches_host_plane(devices):
     data = [(i % 11, i * 3) for i in range(2500)]
 
     def run(conf, port):
-        with TpuShuffleContext(
-            num_executors=3, conf=conf, base_port=port
-        ) as ctx:
+        maker = (
+            _collective_ctx if conf.read_plane == "collective"
+            else lambda n, c, p: TpuShuffleContext(
+                num_executors=n, conf=c, base_port=p
+            )
+        )
+        with maker(3, conf, port) as ctx:
             return sorted(
                 ctx.parallelize(data, num_slices=6)
                 .reduce_by_key(lambda a, b: a + b, num_partitions=6)
@@ -123,9 +139,7 @@ def test_collective_matches_host_plane(devices):
 
 
 def test_collective_sort_by_key(devices):
-    with TpuShuffleContext(
-        num_executors=4, conf=_collective_conf(), base_port=44000
-    ) as ctx:
+    with _collective_ctx(4, _collective_conf(), 44000) as ctx:
         rng = np.random.default_rng(7)
         keys = rng.integers(0, 1 << 30, 3000).tolist()
         out = (
@@ -141,9 +155,7 @@ def test_collective_columnar_shuffle(devices):
     """Columnar serializer + collective bulk plane: the two round-2 perf
     paths composed."""
     conf = _collective_conf(serializer="columnar")
-    with TpuShuffleContext(
-        num_executors=4, conf=conf, base_port=45000
-    ) as ctx:
+    with _collective_ctx(4, conf, 45000) as ctx:
         n = 6000
         keys = np.arange(n, dtype=np.int64) % 101
         vals = np.arange(n, dtype=np.int64)
@@ -177,9 +189,7 @@ def test_unattached_executor_falls_back_to_host(devices):
     the host fallback path (lazy membership: the reference's executors
     join the mesh lazily, RdmaShuffleManager.scala:277-318)."""
     conf = _collective_conf()
-    with TpuShuffleContext(
-        num_executors=3, conf=conf, base_port=47000
-    ) as ctx:
+    with _collective_ctx(3, conf, 47000) as ctx:
         # executor 2 leaves the mesh: its commits stay arena-resident but
         # fetches touching it must take the one-sided host path
         ctx.network.coordinator.detach(2)
@@ -238,9 +248,7 @@ def test_shuffle_larger_than_arena_completes(devices):
     conf = _collective_conf(deviceArenaBytes=1 << 20)
     data = [(i % 23, bytes(1000) + i.to_bytes(4, "big"))
             for i in range(6000)]
-    with TpuShuffleContext(
-        num_executors=4, conf=conf, base_port=45500
-    ) as ctx:
+    with _collective_ctx(4, conf, 45500) as ctx:
         out = (
             ctx.parallelize(data, num_slices=8)
             .group_by_key(num_partitions=8)

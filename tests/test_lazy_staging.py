@@ -17,6 +17,22 @@ from sparkrdma_tpu.memory.arena import ArenaSpanSegment
 from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
 
 
+def _fixture_ctx(num_executors, conf, base_port):
+    """Coordinator plane = test fixture: pass the network explicitly
+    (production readPlane=collective now routes to the windowed plane)."""
+    from sparkrdma_tpu.parallel.collective_read import CollectiveNetwork
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    return TpuShuffleContext(
+        num_executors=num_executors, conf=conf, base_port=base_port,
+        network=CollectiveNetwork(
+            mesh=make_mesh(num_executors),
+            tile_bytes=conf.exchange_tile_bytes,
+            flush_ms=conf.exchange_flush_ms,
+        ),
+    )
+
+
 def _conf(lazy: bool):
     conf = TpuShuffleConf()
     conf.set("readPlane", "collective")
@@ -44,9 +60,7 @@ def _run_one_map(ctx, shuffle_id, ex_index=0):
 
 
 def test_eager_commit_is_arena_resident(devices):
-    with TpuShuffleContext(
-        num_executors=2, conf=_conf(lazy=False), base_port=51000
-    ) as ctx:
+    with _fixture_ctx(2, _conf(lazy=False), 51000) as ctx:
         _, ex = _run_one_map(ctx, 0)
         segs = _segments(ex)
         assert segs and all(
@@ -55,9 +69,7 @@ def test_eager_commit_is_arena_resident(devices):
 
 
 def test_lazy_commit_stays_on_host_then_faults_in(devices):
-    with TpuShuffleContext(
-        num_executors=2, conf=_conf(lazy=True), base_port=52000
-    ) as ctx:
+    with _fixture_ctx(2, _conf(lazy=True), 52000) as ctx:
         part = HashPartitioner(4)
         handle = ctx.driver.register_shuffle(7, 2, part)
         from collections import defaultdict
@@ -105,9 +117,7 @@ def test_lazy_commit_stays_on_host_then_faults_in(devices):
 
 
 def test_prefetch_sweep_stages_everything(devices):
-    with TpuShuffleContext(
-        num_executors=2, conf=_conf(lazy=True), base_port=53000
-    ) as ctx:
+    with _fixture_ctx(2, _conf(lazy=True), 53000) as ctx:
         _, ex = _run_one_map(ctx, 3)
         assert not any(
             isinstance(s, ArenaSpanSegment) for s in _segments(ex)
