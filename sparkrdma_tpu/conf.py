@@ -251,6 +251,26 @@ class TpuShuffleConf:
         return str(self.get("readPlane", "host")).lower()
 
     @property
+    def direct_io(self) -> str:
+        """Disk write mode for spills and file-backed commits:
+        ``auto`` (O_DIRECT when the spill directory supports it —
+        virtualized hosts writeback-throttle buffered writes to a
+        fraction of device bandwidth), ``on`` (force, still falls back
+        per-file if the open fails), or ``off`` (buffered)."""
+        v = str(self.get("directIO", "auto")).lower()
+        return v if v in ("auto", "on", "off") else "auto"
+
+    @property
+    def spill_partition_files(self) -> int:
+        """Spills write one file PER PARTITION up to this many
+        partitions (the zero-copy commit: each spill file registers
+        directly as the shuffle file, no consolidation rewrite).
+        Shuffles with more partitions use the legacy single spill file
+        to bound open descriptors; 0 disables the per-partition
+        layout."""
+        return self._int_in_range("spillPartitionFiles", 64, 0, 4096)
+
+    @property
     def bulk_window_maps(self) -> int:
         """Bulk mode's incremental-plan window: the driver cuts an
         exchange plan every time this many NEW maps have published and

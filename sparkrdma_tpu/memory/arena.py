@@ -69,6 +69,14 @@ class DeviceSegment:
                 f"of {self.nbytes}B"
             )
         if self.zero_copy_ok:
+            ka = self.keepalive
+            if length >= DIRECT_READ_MIN and hasattr(ka, "pread"):
+                # big file-backed blocks read O_DIRECT: buffered mmap
+                # faults are writeback/readahead-throttled on
+                # virtualized hosts (~5x slower — memory/direct_io.py)
+                got = ka.pread(offset, length)
+                if got is not None:
+                    return got
             view = self.array[offset:end].view()
             view.flags.writeable = False
             return view
@@ -102,6 +110,10 @@ class DeviceSegment:
 # small blocks at opposite ends of a big segment) must not materialize
 # the whole gap to host
 READ_MANY_MAX_GAP = 8 << 20
+
+# blocks at least this large take the O_DIRECT pread path on
+# file-backed segments; smaller ones stay zero-copy mmap views
+DIRECT_READ_MIN = 1 << 20
 
 
 def _read_spans_clustered(spans, fetch):

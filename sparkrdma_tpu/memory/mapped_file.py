@@ -26,6 +26,20 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _advise_sequential(arr) -> None:
+    """MADV_SEQUENTIAL on the backing mmap: shuffle blocks are read
+    front-to-back, and aggressive readahead is worth 2-4x over default
+    page faulting on the O_DIRECT-written (cache-cold) files."""
+    import mmap as _mmap
+
+    mm = getattr(arr, "_mmap", None)
+    if mm is not None and hasattr(mm, "madvise"):
+        try:
+            mm.madvise(_mmap.MADV_SEQUENTIAL)
+        except (OSError, ValueError):
+            pass
+
+
 class MappedFile:
     """One shuffle data file: write once, then serve reads via mmap.
 
@@ -53,14 +67,93 @@ class MappedFile:
                     # byte so an all-empty-partitions commit still maps
                     # (the segment serves only EMPTY locations anyway)
                     f.write(b"\x00")
-            # read-only mapping: serves get_local_block / transport reads
-            # without a resident copy (page cache backs it)
-            self.array = np.memmap(self.path, dtype=np.uint8, mode="r",
-                                   shape=(max(total, 1),))
+            self._map(total)
         except BaseException:
             self._unlink()
             raise
         self._freed = False
+
+    # set False (e.g. conf directIO=off) to force the mmap view path
+    direct_read_enabled = True
+
+    def pread(self, offset: int, length: int):
+        """O_DIRECT read of ``[offset, offset+length)`` into a fresh
+        page-aligned buffer, bypassing the buffered fault path that
+        virtualized hosts throttle to a fraction of device bandwidth
+        (measured 181 MB/s faulted vs 893 MB/s O_DIRECT on the same
+        file — BASELINE.md round-4 notes).  Returns a read-only uint8
+        array, or None when O_DIRECT is unavailable/disabled (caller
+        falls back to the mmap view).
+
+        The descriptor is opened PER CALL by path: a concurrent
+        ``free()`` (segment superseded by a task retry) at worst makes
+        the open fail — never an fd-reuse read of the wrong file — and
+        the fallback mmap view keeps the old loud-failure semantics."""
+        import mmap as _mmap
+
+        from sparkrdma_tpu.memory.direct_io import ALIGN
+
+        if (self._freed or not self.direct_read_enabled
+                or not hasattr(os, "O_DIRECT")):
+            return None
+        try:
+            fd = os.open(self.path, os.O_RDONLY | os.O_DIRECT)
+        except OSError:
+            return None
+        lo = offset // ALIGN * ALIGN
+        hi = (offset + length + ALIGN - 1) // ALIGN * ALIGN
+        mm = _mmap.mmap(-1, hi - lo)
+        pos = 0
+        want = hi - lo
+        need = (offset - lo) + length
+        view = memoryview(mm)
+        try:
+            while pos < need:
+                n = os.preadv(fd, [view[pos:want]], lo + pos)
+                if n <= 0:
+                    break  # EOF inside the final alignment block
+                pos += n
+        except OSError:
+            pos = -1
+        finally:
+            view.release()
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        if pos < need:
+            mm.close()
+            return None  # failed / short before the span ended
+        arr = np.frombuffer(mm, np.uint8)[
+            offset - lo : offset - lo + length
+        ]
+        arr.flags.writeable = False
+        return arr
+
+    @classmethod
+    def from_path(cls, path: str, length: int) -> "MappedFile":
+        """Adopt an EXISTING data file (e.g. a per-partition spill file
+        written through the O_DIRECT appender) as a registered mapped
+        segment — the zero-copy commit: spilled bytes are never
+        rewritten, the spill file IS the shuffle file.  Takes ownership
+        (unlinked on free)."""
+        mf = cls.__new__(cls)
+        mf.path = path
+        try:
+            mf._map(length)
+        except BaseException:
+            mf._unlink()
+            raise
+        mf._freed = False
+        return mf
+
+    def _map(self, length: int) -> None:
+        """Shared read-only mapping setup (serves get_local_block /
+        transport reads without a resident copy; page cache backs it)."""
+        self.array = np.memmap(
+            self.path, dtype=np.uint8, mode="r", shape=(max(length, 1),)
+        )
+        _advise_sequential(self.array)
 
     def _unlink(self) -> None:
         try:

@@ -272,6 +272,7 @@ class TpuShuffleManager:
             spill_dir=conf.spill_dir,
             lazy_staging=conf.lazy_staging,
             write_block_size=conf.shuffle_write_block_size,
+            direct_io=conf.direct_io,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)

@@ -84,6 +84,12 @@ class ShuffleWriter:
         self._spilled: List[List[Tuple[int, int]]] = [
             [] for _ in range(handle.partitioner.num_partitions)
         ]
+        # per-partition spill layout (conf spillPartitionFiles): one
+        # O_DIRECT appender per partition, promoted at commit into the
+        # shuffle files themselves (no consolidation rewrite)
+        self._spill_appenders = None
+        self._spill_io = None  # shared 1-thread flush executor
+        self._spill_direct = False
 
     # -- write --------------------------------------------------------------
     def write(self, records) -> None:
@@ -272,32 +278,65 @@ class ShuffleWriter:
         if self._col_pending:
             self._materialize_pending()
         serializer = self.manager.serializer
-        if self._spill_file is None:
+        P = self.handle.partitioner.num_partitions
+        pid_layout = (
+            0 < P <= self.manager.conf.spill_partition_files
+        )
+        if self._spill_file is None and self._spill_appenders is None:
             spill_dir = self.manager.conf.spill_dir
             os.makedirs(spill_dir, exist_ok=True)
-            fd, path = tempfile.mkstemp(
-                prefix=f"sparkrdma_tpu_spill_{self.handle.shuffle_id}_"
-                       f"{self.map_id}_",
-                dir=spill_dir,
-            )
-            self._spill_file = os.fdopen(fd, "w+b")
-            self._spill_path = path
-        f = self._spill_file
-        f.seek(0, os.SEEK_END)
+            if pid_layout:
+                from sparkrdma_tpu.memory.direct_io import direct_supported
+
+                mode = self.manager.conf.direct_io
+                self._spill_direct = mode == "on" or (
+                    mode == "auto" and direct_supported(spill_dir)
+                )
+                self._spill_appenders = [None] * P
+            else:
+                fd, path = tempfile.mkstemp(
+                    prefix=f"sparkrdma_tpu_spill_"
+                           f"{self.handle.shuffle_id}_{self.map_id}_",
+                    dir=spill_dir,
+                )
+                self._spill_file = os.fdopen(fd, "w+b")
+                self._spill_path = path
         if self._col_buckets is not None:
             sources = self._columnar_sources()
         elif self._combined is not None:
             sources = [d.items() if d else None for d in self._combined]
         else:
             sources = [b if b else None for b in self._buckets]
-        for pid, src in enumerate(sources):
-            if src is None:
-                continue
-            raw = serializer.serialize(src)
-            off = f.tell()
-            f.write(raw)
-            self._spilled[pid].append((off, len(raw)))
-            self.metrics.bytes_spilled += len(raw)
+        if self._spill_appenders is not None:
+            # stream header + column VIEWS straight into the appender's
+            # aligned buffers — no per-partition bytes join (each byte
+            # is copied once between the batch and the bounce buffer)
+            chunked = getattr(serializer, "serialize_chunks", None)
+            for pid, src in enumerate(sources):
+                if src is None:
+                    continue
+                app = self._appender(pid)
+                if chunked is not None:
+                    total_n, chunks = chunked(src)
+                    off = app.size
+                    for c in chunks():
+                        app.append(c)
+                    n = total_n
+                else:
+                    off, n = app.append(serializer.serialize(src))
+                self._spilled[pid].append((off, n))
+                self.metrics.bytes_spilled += n
+        else:
+            f = self._spill_file
+            f.seek(0, os.SEEK_END)
+            for pid, src in enumerate(sources):
+                if src is None:
+                    continue
+                raw = serializer.serialize(src)
+                off = f.tell()
+                f.write(raw)
+                self._spilled[pid].append((off, len(raw)))
+                self.metrics.bytes_spilled += len(raw)
         if self._col_buckets is not None:
             self._col_buckets = [[] for _ in self._col_buckets]
         elif self._combined is not None:
@@ -333,6 +372,35 @@ class ShuffleWriter:
                 out.append(b if len(b) else None)
         return out
 
+    def _appender(self, pid: int):
+        """Lazily create partition ``pid``'s spill appender."""
+        app = self._spill_appenders[pid]
+        if app is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from sparkrdma_tpu.memory.direct_io import DirectAppender
+
+            if self._spill_io is None:
+                self._spill_io = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="spill-io"
+                )
+            spill_dir = self.manager.conf.spill_dir
+            fd, path = tempfile.mkstemp(
+                prefix=f"sparkrdma_tpu_shuffle_"
+                       f"{self.handle.shuffle_id}_{self.map_id}_"
+                       f"p{pid}_",
+                dir=spill_dir,
+            )
+            os.close(fd)  # DirectAppender reopens with its own flags
+            P = self.handle.partitioner.num_partitions
+            app = DirectAppender(
+                path, use_direct=self._spill_direct,
+                buf_bytes=(1 << 20) if P <= 32 else (256 << 10),
+                executor=self._spill_io,
+            )
+            self._spill_appenders[pid] = app
+        return app
+
     def _iter_partition_chunks(self, pid: int, final: bytes):
         """Yield a partition's spilled chunks (read back one at a time)
         followed by the final in-memory remainder — at most one spill
@@ -353,6 +421,16 @@ class ShuffleWriter:
                     os.unlink(self._spill_path)
                 except OSError:
                     pass
+        if self._spill_appenders is not None:
+            # still owned here = the commit never promoted them
+            # (failure / unsuccessful stop): discard
+            apps, self._spill_appenders = self._spill_appenders, None
+            for app in apps:
+                if app is not None:
+                    app.abandon()
+        if self._spill_io is not None:
+            io, self._spill_io = self._spill_io, None
+            io.shutdown(wait=True)
 
     # -- commit + publish ---------------------------------------------------
     def stop(self, success: bool = True) -> Optional[MapTaskOutput]:
@@ -382,6 +460,7 @@ class ShuffleWriter:
             )
             if (
                 self._spill_file is None
+                and self._spill_appenders is None
                 and (self._col_buckets is None
                      or not any(self._col_buckets))
                 and (kind is None or kind == "group")
@@ -391,7 +470,8 @@ class ShuffleWriter:
             self._materialize_pending()
         if self._col_buckets is not None:
             chunked = getattr(serializer, "serialize_chunks", None)
-            if chunked is not None and self._spill_file is None:
+            if chunked is not None and self._spill_file is None \
+                    and self._spill_appenders is None:
                 # zero-copy commit: headers + uint8 column views stream
                 # straight into the resolver's staging buffer
                 return self._commit_payloads([
@@ -412,6 +492,8 @@ class ShuffleWriter:
             finals = [
                 serializer.serialize(b) if b else b"" for b in self._buckets
             ]
+        if self._spill_appenders is not None:
+            return self._commit_spilled_files(finals, t0)
         if self._spill_file is not None:
             # merge = chunk concatenation (both serializers frame
             # concatenation-safely), STREAMED through ChunkedPayload so
@@ -501,6 +583,42 @@ class ShuffleWriter:
         mto = self.manager.resolver.commit_assembled(
             self.handle.shuffle_id, self.map_id, buf[:total], ranges,
         )
+        self.manager.publish_map_output(
+            self.handle.shuffle_id, self.map_id, mto
+        )
+        self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
+        return mto
+
+    def _commit_spilled_files(self, finals, t0: float) -> MapTaskOutput:
+        """Promote the per-partition spill files into the shuffle files
+        (resolver.commit_spilled_files): append each partition's final
+        in-memory remainder to its spill file, seal, and register the
+        files as the map output's segments — the spilled bytes are
+        written to disk exactly ONCE."""
+        entries = []
+        total = 0
+        for pid, final in enumerate(finals):
+            if self._spill_appenders[pid] is None and not final:
+                entries.append(None)
+                continue
+            app = self._appender(pid)
+            if final:
+                app.append(final)
+            n = app.finish()
+            entries.append((app.path, n))
+            total += n
+        appenders, self._spill_appenders = self._spill_appenders, None
+        try:
+            mto = self.manager.resolver.commit_spilled_files(
+                self.handle.shuffle_id, self.map_id, entries
+            )
+        except BaseException:
+            # resolver cleans up what it registered; unlink the rest
+            for app in appenders:
+                if app is not None:
+                    app.abandon()
+            raise
+        self.metrics.bytes_written = total
         self.manager.publish_map_output(
             self.handle.shuffle_id, self.map_id, mto
         )
