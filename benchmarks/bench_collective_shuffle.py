@@ -41,7 +41,12 @@ def main():
     conf = TpuShuffleConf()
     conf.set("serializer", "columnar")
     conf.set("readPlane", "windowed")
-    conf.set("bulkWindowMaps", "2")
+    # bulkWindowMaps trades throughput for straggler overlap: each plan
+    # window is one collective (its own dispatch + tile padding).  The
+    # throughput configuration is a single window (0); measured on the
+    # 8-device CPU mesh: wm=0 0.122 GB/s, wm=4 0.060, wm=2 0.035 —
+    # overlap-hungry jobs pick fine windows, throughput jobs coarse
+    conf.set("bulkWindowMaps", "0")
     conf.set("exchangeTileBytes", "16m")
 
     with TpuShuffleContext(num_executors=4, conf=conf) as ctx:
