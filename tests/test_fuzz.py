@@ -3,6 +3,7 @@ randomized sizes, key ranges, skews, and paddings (the systematic test
 strategy SURVEY.md §4 notes the reference never had)."""
 
 import collections
+import time
 
 import numpy as np
 import pytest
@@ -186,3 +187,75 @@ def test_fuzz_grouped_topk(mesh, devices):
         for kk in np.unique(keys):
             want = np.sort(vals[keys == kk])[::-1][:k].tolist()
             assert got[int(kk)] == want, (i, kk, k)
+
+
+def test_fuzz_windowed_plane_random_topologies(devices):
+    """Property test for the unified windowed plane: random executor
+    counts, window sizes, partition counts, and per-map record loads —
+    reducer-issued per-partition reads must recover every record
+    exactly once, whatever the plan cut."""
+    import threading
+
+    from tests.test_bulk_shuffle import _windowed_plane_cluster
+
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+
+    rng = np.random.default_rng(17)
+    for trial in range(4):
+        E = int(rng.integers(2, 5))
+        num_maps = int(rng.integers(1, 7))
+        num_parts = int(rng.integers(E, 3 * E + 1))
+        window_maps = int(rng.integers(0, 4))
+        net, conf, driver, executors = _windowed_plane_cluster(
+            window_maps, 49700 + trial * 200, n_exec=E
+        )
+        try:
+            part = HashPartitioner(num_parts)
+            handle = driver.register_shuffle(77, num_maps, part)
+            expect = []
+            for m in range(num_maps):
+                n = int(rng.integers(0, 300))
+                recs = [
+                    (int(rng.integers(0, 40)), (m, j)) for j in range(n)
+                ]
+                expect.extend(recs)
+                w = executors[m % E].get_writer(handle, m)
+                w.write(recs)
+                w.stop(True)
+            for e in executors:
+                e.windowed_plane.join(77)
+            results = {}
+            errors = {}
+
+            def reduce_task(pid):
+                try:
+                    r = executors[pid % E].get_reader(
+                        handle, pid, pid + 1, {}
+                    )
+                    results[pid] = list(r.read())
+                except BaseException as err:
+                    errors[pid] = err
+
+            threads = [
+                threading.Thread(target=reduce_task, args=(p,),
+                                 daemon=True)
+                for p in range(num_parts)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            assert not any(t.is_alive() for t in threads), (
+                "hung reducer", trial, E, num_maps, num_parts,
+                window_maps,
+            )
+            assert not errors, (trial, E, num_maps, num_parts,
+                                window_maps, errors)
+            got = [kv for recs in results.values() for kv in recs]
+            assert sorted(map(repr, got)) == sorted(map(repr, expect)), (
+                trial, E, num_maps, num_parts, window_maps,
+                len(got), len(expect),
+            )
+        finally:
+            for m in executors + [driver]:
+                m.stop()
