@@ -19,7 +19,7 @@ import jax
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import emit, time_iters
+from benchmarks.common import emit, maybe_spoof_cpu, time_iters
 
 from sparkrdma_tpu.models.ring_attention import ring_attention
 from sparkrdma_tpu.parallel.mesh import make_mesh
@@ -28,6 +28,7 @@ BASELINE_TFLOPS = 10.0
 
 
 def main():
+    maybe_spoof_cpu()
     S = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     H = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     d = int(sys.argv[3]) if len(sys.argv) > 3 else 128

@@ -14,7 +14,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import emit
+from benchmarks.common import emit, maybe_spoof_cpu
 
 from sparkrdma_tpu.api import TpuShuffleContext
 
@@ -23,6 +23,7 @@ N_KEYS = 1024
 
 
 def main():
+    maybe_spoof_cpu()
     rng = np.random.default_rng(1)
     records = [(int(k), 1) for k in rng.integers(0, N_KEYS, N_RECORDS)]
 

@@ -16,13 +16,14 @@ import jax
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import ROCE_LINE_RATE_GBPS, emit, time_iters
+from benchmarks.common import ROCE_LINE_RATE_GBPS, emit, maybe_spoof_cpu, time_iters
 
 from sparkrdma_tpu.models.terasort import TeraSorter
 from sparkrdma_tpu.parallel.mesh import make_mesh
 
 
 def main():
+    maybe_spoof_cpu()
     log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 24
     n = 1 << log2
     mesh = make_mesh()

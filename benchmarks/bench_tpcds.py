@@ -28,7 +28,7 @@ import jax
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import ROCE_LINE_RATE_GBPS, emit, time_iters
+from benchmarks.common import ROCE_LINE_RATE_GBPS, emit, maybe_spoof_cpu, time_iters
 
 from sparkrdma_tpu.models.aggregate import make_aggregate_step
 from sparkrdma_tpu.models.join import (
@@ -40,6 +40,7 @@ from sparkrdma_tpu.parallel.mesh import make_mesh
 
 
 def main():
+    maybe_spoof_cpu()
     import functools
 
     import jax.numpy as jnp

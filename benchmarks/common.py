@@ -63,6 +63,19 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
 SPOOF_ENV = "SPARKRDMA_TPU_BENCH_SPOOFED"
 
 
+def maybe_spoof_cpu() -> None:
+    """When the spoof env is set, force the CPU platform BEFORE any
+    backend init: the axon sitecustomize overrides a JAX_PLATFORMS env
+    var, and a wedged tunnel grant hangs init forever — single-chip
+    benches call this first so they can be gauged off-silicon."""
+    import os
+
+    if os.environ.get(SPOOF_ENV):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def ensure_multidevice(script_path: str, min_devices: int = 4) -> None:
     """Benches that need a multi-device mesh call this FIRST: on the
     single-chip bench host it re-execs the script onto a spoofed
@@ -74,8 +87,7 @@ def ensure_multidevice(script_path: str, min_devices: int = 4) -> None:
 
     import jax as _jax
 
-    if os.environ.get(SPOOF_ENV):
-        _jax.config.update("jax_platforms", "cpu")
+    maybe_spoof_cpu()
     if len(_jax.devices()) >= min_devices:
         return
     if os.environ.get(SPOOF_ENV):

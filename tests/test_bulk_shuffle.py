@@ -617,11 +617,13 @@ def test_bulk_mixed_plan_modes_rejected(devices):
 
         def _r0_read():
             # r0 is expected to fail too once the skewed request dooms
-            # the shuffle — catch in-thread so pytest's unhandled-
-            # thread-exception warning stays meaningful for real leaks
+            # the shuffle (the teardown abort below wakes its barrier
+            # wait) — catch in-thread so pytest's unhandled-thread-
+            # exception warning stays meaningful for real leaks
             try:
                 results["ok"] = list(r0.read(64))
-            except MetadataFetchFailedError as e:
+            except (MetadataFetchFailedError, RuntimeError,
+                    TimeoutError) as e:
                 results["r0_err"] = e
 
         t0 = threading.Thread(target=_r0_read, daemon=True)
@@ -644,6 +646,14 @@ def test_bulk_mixed_plan_modes_rejected(devices):
             assert time.monotonic() - t_start < 10
         finally:
             ex1.conf = old
+            # r0 may be parked in its round's contribution barrier
+            # (its partner never contributes): abort so the thread
+            # exits NOW instead of riding out the 120s timeout past
+            # the test
+            session.abort(RuntimeError("mode-mismatch test teardown"))
+            t0.join(timeout=10)
+            assert not t0.is_alive(), "r0 reader thread leaked"
+            assert "ok" not in results, results
     finally:
         for m in executors + [driver]:
             m.stop()

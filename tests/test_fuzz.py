@@ -3,7 +3,6 @@ randomized sizes, key ranges, skews, and paddings (the systematic test
 strategy SURVEY.md §4 notes the reference never had)."""
 
 import collections
-import time
 
 import numpy as np
 import pytest

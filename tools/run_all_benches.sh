@@ -36,7 +36,24 @@ rm -f "$probe_log"
 run() {
   echo "== $* ==" | tee -a "$out"
   timeout 1200 "$@" 2>&1 | grep -v -E "WARNING|^I[0-9]" | tee -a "$out"
-  return "${PIPESTATUS[0]}"
+  local rc="${PIPESTATUS[0]}"
+  if [ "$rc" -eq 124 ]; then
+    # a step timing out may mean the grant wedged mid-RPC (the SIGTERM
+    # itself can wedge it — tools/TPU_TODO.md); re-probe before letting
+    # the remaining steps burn 1200s each against a dead backend
+    local recheck
+    recheck=$(mktemp)
+    timeout 150 python -c \
+      "import jax, jax.numpy as jnp; assert int(jnp.sum(jnp.arange(100))) == 4950; print('ALIVE')" \
+      > "$recheck" 2>&1
+    if ! grep -q ALIVE "$recheck"; then
+      rm -f "$recheck"
+      echo "backend wedged after a step timeout — aborting the sweep" | tee -a "$out"
+      exit 3
+    fi
+    rm -f "$recheck"
+  fi
+  return "$rc"
 }
 
 if ! run python tools/profile_tpu_scans.py 22; then
