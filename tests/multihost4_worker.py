@@ -1,0 +1,158 @@
+"""Worker for the 4-process unified-plane test (test_multihost.py).
+
+Run as: python multihost4_worker.py <process_id> <coordinator_port>
+
+Scales the windowed read plane's cross-process proof from 2 to 4 OS
+processes: 4 executors over a 4-device global mesh (one device per
+process), a TCP control plane, uneven plan windows (8 maps, window of
+3 → 3/3/2), reducer-issued per-partition reads, and the straggler
+overlap — window 0's collective completes on every host while each
+process's second map is still unwritten.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N_PROCS = 4
+NUM_PARTS = 8
+NUM_MAPS = 8
+SHUFFLE = 73
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    import threading
+    import time
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.parallel import multihost
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+    from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS
+    from sparkrdma_tpu.shuffle.bulk import WindowedReadPlane
+    from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+    from sparkrdma_tpu.transport import TcpNetwork
+
+    driver_port = int(port) + 41
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "60s",
+        "spark.shuffle.tpu.connectTimeout": "10s",
+        "spark.shuffle.tpu.bulkWindowMaps": "3",
+        "spark.shuffle.tpu.readPlane": "windowed",
+    })
+    part = HashPartitioner(NUM_PARTS)
+    driver = None
+    if pid == 0:
+        driver = TpuShuffleManager(
+            conf, is_driver=True, network=TcpNetwork(), port=driver_port,
+        )
+        driver.register_shuffle(SHUFFLE, NUM_MAPS, part)
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=N_PROCS,
+        process_id=pid,
+    )
+    assert jax.process_count() == N_PROCS, jax.process_count()
+
+    ex_mgr = TpuShuffleManager(
+        conf, is_driver=False, network=TcpNetwork(),
+        port=driver_port + 10 + pid, executor_id=str(pid),
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline and len(ex_mgr._peers) < N_PROCS:
+        time.sleep(0.02)
+    assert len(ex_mgr._peers) == N_PROCS, "announce did not reach everyone"
+
+    # one mesh device per process, ordered by process index — every
+    # process derives the identical mesh, matching the plan's canonical
+    # host order (ports ascend with pid)
+    per_proc = {}
+    for dev in jax.devices():
+        per_proc.setdefault(dev.process_index, dev)
+    mesh = Mesh(
+        np.array([per_proc[i] for i in sorted(per_proc)]),
+        (EXCHANGE_AXIS,),
+    )
+    ex_mgr.windowed_plane = WindowedReadPlane(
+        ex_mgr, exchange=TileExchange(mesh, tile_bytes=1 << 12)
+    )
+
+    handle = ShuffleHandle(SHUFFLE, NUM_MAPS, part)
+    recs = {
+        m: [(f"q{m}-k{j}", (m, j)) for j in range(40)]
+        for m in range(NUM_MAPS)
+    }
+    w = ex_mgr.get_writer(handle, pid)
+    w.write(recs[pid])
+    w.stop(True)
+
+    my_parts = [r for r in range(NUM_PARTS) if r % N_PROCS == pid]
+    results = {}
+    errors = {}
+
+    def reduce_task(p):
+        try:
+            r = ex_mgr.get_reader(handle, p, p + 1, {})
+            results[p] = list(r.read())
+        except BaseException as e:
+            errors[p] = e
+
+    threads = [
+        threading.Thread(target=reduce_task, args=(p,), daemon=True)
+        for p in my_parts
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 60
+    while (time.time() < deadline
+           and not ex_mgr.windowed_plane.window_events(SHUFFLE)):
+        time.sleep(0.02)
+    assert ex_mgr.windowed_plane.window_events(SHUFFLE), (
+        f"proc {pid}: no window landed before the stragglers"
+    )
+    assert not results, (
+        f"proc {pid}: a reducer finished before the straggler maps"
+    )
+
+    w = ex_mgr.get_writer(handle, pid + N_PROCS)
+    w.write(recs[pid + N_PROCS])
+    w.stop(True)
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads), f"proc {pid}: hung reducer"
+    assert not errors, f"proc {pid}: {errors!r}"
+    # 8 maps / window of 3 → windows 3/3/2 on every host
+    wins = [wn for wn, _t, _b in ex_mgr.windowed_plane.window_events(SHUFFLE)]
+    assert wins == [0, 1, 2], f"proc {pid}: windows {wins}"
+    all_recs = [kv for m in range(NUM_MAPS) for kv in recs[m]]
+    for p in my_parts:
+        expect = [(k, v) for k, v in all_recs if part.partition(k) == p]
+        assert sorted(results.get(p, [])) == sorted(expect), (
+            f"proc {pid}: partition {p}: "
+            f"{len(results.get(p, []))} != {len(expect)}"
+        )
+
+    ex_mgr.stop()
+    if driver is not None:
+        driver.stop()
+
+    print(f"proc {pid}: 4-process windowed plane OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

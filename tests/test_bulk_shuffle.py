@@ -654,6 +654,15 @@ def test_bulk_mixed_plan_modes_rejected(devices):
             t0.join(timeout=10)
             assert not t0.is_alive(), "r0 reader thread leaked"
             assert "ok" not in results, results
+            # r0 must have failed for one of the EXPECTED reasons (the
+            # doomed shuffle or the teardown abort), not something else
+            err = results.get("r0_err")
+            assert err is not None and (
+                isinstance(err, MetadataFetchFailedError)
+                or "mode-mismatch test teardown" in str(
+                    getattr(err, "__cause__", None) or err
+                )
+            ), repr(err)
     finally:
         for m in executors + [driver]:
             m.stop()

@@ -18,8 +18,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_collectives():
-    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+def _run_workers(worker_file: str, n_procs: int, timeout: int,
+                 ok_msg: str) -> None:
+    worker = os.path.join(os.path.dirname(__file__), worker_file)
     port = _free_port()
     env = {
         k: v for k, v in os.environ.items()
@@ -31,17 +32,32 @@ def test_two_process_collectives():
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
-        for pid in (0, 1)
+        for pid in range(n_procs)
     ]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("multihost worker hung")
+            pytest.fail(f"{worker_file} hung")
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
-        assert f"proc {pid}: multihost collectives OK" in out, out
+        assert f"proc {pid}: {ok_msg}" in out, out
+
+
+def test_two_process_collectives():
+    _run_workers(
+        "multihost_worker.py", 2, 150, "multihost collectives OK"
+    )
+
+
+def test_four_process_windowed_plane():
+    """The unified plane at 4 OS processes: uneven plan windows,
+    reducer-issued reads, straggler overlap — the NCCL/MPI-style
+    multi-host scaling story beyond the 2-process proof."""
+    _run_workers(
+        "multihost4_worker.py", 4, 240, "4-process windowed plane OK"
+    )

@@ -17,21 +17,30 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 out="tools/bench_results_$(date +%m%d_%H%M).log"
 
-echo "== backend probe ==" | tee -a "$out"
 # probe to a file, grep the file AFTER the pipeline: grep -q in the
 # pipeline would SIGPIPE tee on post-ALIVE teardown output and
-# pipefail would read a healthy probe as wedged
-probe_log=$(mktemp)
-timeout 150 python -c \
-  "import jax, jax.numpy as jnp; assert int(jnp.sum(jnp.arange(100))) == 4950; print('ALIVE')" \
-  > "$probe_log" 2>&1
-cat "$probe_log" >> "$out"
-if ! grep -q ALIVE "$probe_log"; then
+# pipefail would read a healthy probe as wedged.  Output is appended
+# to $out either way — a wedged probe's error IS the diagnostic.
+probe_backend() {
+  local probe_log
+  probe_log=$(mktemp)
+  timeout 150 python -c \
+    "import jax, jax.numpy as jnp; assert int(jnp.sum(jnp.arange(100))) == 4950; print('ALIVE')" \
+    > "$probe_log" 2>&1
+  cat "$probe_log" >> "$out"
+  if ! grep -q ALIVE "$probe_log"; then
+    rm -f "$probe_log"
+    return 1
+  fi
   rm -f "$probe_log"
+  return 0
+}
+
+echo "== backend probe ==" | tee -a "$out"
+if ! probe_backend; then
   echo "backend unreachable (wedged grant?) — aborting sweep; see tools/TPU_TODO.md" | tee -a "$out"
   exit 3
 fi
-rm -f "$probe_log"
 
 run() {
   echo "== $* ==" | tee -a "$out"
@@ -41,17 +50,10 @@ run() {
     # a step timing out may mean the grant wedged mid-RPC (the SIGTERM
     # itself can wedge it — tools/TPU_TODO.md); re-probe before letting
     # the remaining steps burn 1200s each against a dead backend
-    local recheck
-    recheck=$(mktemp)
-    timeout 150 python -c \
-      "import jax, jax.numpy as jnp; assert int(jnp.sum(jnp.arange(100))) == 4950; print('ALIVE')" \
-      > "$recheck" 2>&1
-    if ! grep -q ALIVE "$recheck"; then
-      rm -f "$recheck"
+    if ! probe_backend; then
       echo "backend wedged after a step timeout — aborting the sweep" | tee -a "$out"
       exit 3
     fi
-    rm -f "$recheck"
   fi
   return "$rc"
 }
