@@ -68,6 +68,12 @@ def _load_native():
     if hasattr(lib, "radix_scratch_trim"):
         lib.radix_scratch_trim.restype = None
         lib.radix_scratch_trim.argtypes = []
+    if hasattr(lib, "kway_merge_i64"):
+        lib.kway_merge_i64.restype = ctypes.c_int
+        lib.kway_merge_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
     return lib
 
 
@@ -114,6 +120,34 @@ def native_radix_argsort(keys: np.ndarray):
     order = np.empty(keys.shape[0], np.int64)
     rc = _NATIVE.radix_argsort_i64(
         keys.ctypes.data, keys.shape[0], order.ctypes.data
+    )
+    if rc != 0:
+        return None
+    return order
+
+
+def native_kway_merge(keys: np.ndarray, run_offsets: np.ndarray):
+    """Stable merge order over concatenated PRE-SORTED int64 runs (the
+    loser tree in staging_allocator.cpp) — bit-exact with numpy's
+    stable argsort of the concatenation, ~2.8x the radix argsort on
+    the sorted-runs shape.  Returns the int64 gather order or None
+    when unavailable/ineligible (caller falls back)."""
+    if _NATIVE is None or not hasattr(_NATIVE, "kway_merge_i64"):
+        return None
+    if (
+        keys.ndim != 1 or keys.dtype != np.int64
+        or (len(keys) and keys.strides[0] != 8)
+        or run_offsets.ndim != 1 or run_offsets.dtype != np.int64
+        or run_offsets.strides[0] != 8
+        or len(run_offsets) < 1
+        or run_offsets[0] != 0 or run_offsets[-1] != len(keys)
+        or (np.diff(run_offsets) < 0).any()
+    ):
+        return None
+    order = np.empty(len(keys), np.int64)
+    rc = _NATIVE.kway_merge_i64(
+        keys.ctypes.data, run_offsets.ctypes.data,
+        len(run_offsets) - 1, order.ctypes.data,
     )
     if rc != 0:
         return None
