@@ -23,8 +23,6 @@ import logging
 import queue
 import threading
 import time
-
-import numpy as np
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -373,6 +371,7 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
         combine_columns,
         concat_batches,
         group_columns,
+        sorted_runs_order,
         stable_key_order,
     )
 
@@ -397,28 +396,14 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
             # Python loop stays small next to the moved bytes
             if entries <= max(1 << 15, total // 8):
                 return merge_sorted_groups(per)
-        uk, groups = group_columns(concat_batches(batches))
+        cat = concat_batches(batches)
+        uk, groups = group_columns(
+            cat, order=sorted_runs_order(batches, cat)
+        )
         return iter(zip(uk.tolist(), groups))
     batch = concat_batches(batches)
     if handle.key_ordering:
-        order = None
-        if all(b.key_sorted for b in batches):
-            if len(batches) == 1:
-                # one sorted run: the order IS identity
-                order = np.arange(len(batch.keys), dtype=np.int64)
-            elif batch.keys.dtype == np.int64:
-                # key-sorted runs merge in K log K compares per row
-                # (native loser tree) instead of a full re-sort — the
-                # radix path is ~2.8x slower on this shape
-                from sparkrdma_tpu.memory.staging import (
-                    native_kway_merge,
-                )
-
-                offs = np.zeros(len(batches) + 1, np.int64)
-                np.cumsum([len(b) for b in batches], out=offs[1:])
-                order = native_kway_merge(
-                    np.ascontiguousarray(batch.keys), offs
-                )
+        order = sorted_runs_order(batches, batch)
         if order is None:
             order = stable_key_order(batch.keys)
         return iter(zip(
