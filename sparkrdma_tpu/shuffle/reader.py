@@ -398,7 +398,12 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
                 return merge_sorted_groups(per)
         cat = concat_batches(batches)
         uk, groups = group_columns(
-            cat, order=sorted_runs_order(batches, cat)
+            cat,
+            # an already-key_sorted concat takes group_columns' own
+            # fast path; computing the (identity) order would only
+            # allocate
+            order=None if cat.key_sorted
+            else sorted_runs_order(batches, cat),
         )
         return iter(zip(uk.tolist(), groups))
     batch = concat_batches(batches)

@@ -397,6 +397,10 @@ class ShuffleWriter:
                 path, use_direct=self._spill_direct,
                 buf_bytes=(1 << 20) if P <= 32 else (256 << 10),
                 executor=self._spill_io,
+                # round-robin appends across P files fragment extents
+                # at bounce-buffer size; 32 MiB preallocation steps
+                # keep each shuffle file's later sequential read fast
+                prealloc_bytes=32 << 20,
             )
             self._spill_appenders[pid] = app
         return app
