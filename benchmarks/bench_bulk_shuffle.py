@@ -37,10 +37,14 @@ def main():
     conf.set("readPlane", "bulk")
     conf.set("exchangeTileBytes", "16m")
 
-    # stage_to_device stays default-on so BOTH planes pay the same
-    # map-output device-staging cost (the BASELINE cross-plane ratio
-    # must compare plane design, not skipped staging)
-    with TpuShuffleContext(num_executors=4, conf=conf) as ctx:
+    # stage_to_device pinned False on BOTH compared planes (it is now
+    # the windowed/bulk default too): their exchanges read blocks
+    # host-side, so HBM staging would only add a per-block device
+    # round-trip; the BASELINE cross-plane ratio compares plane design
+    # with identical staging either way
+    with TpuShuffleContext(
+        num_executors=4, conf=conf, stage_to_device=False
+    ) as ctx:
         best = time_group_by_key(ctx, keys, vals, n_keys)
 
     gbps = n_records * payload / best / 1e9

@@ -49,7 +49,10 @@ def main():
     conf.set("bulkWindowMaps", "0")
     conf.set("exchangeTileBytes", "16m")
 
-    with TpuShuffleContext(num_executors=4, conf=conf) as ctx:
+    # staging pinned False to match bench_bulk_shuffle (like-for-like)
+    with TpuShuffleContext(
+        num_executors=4, conf=conf, stage_to_device=False
+    ) as ctx:
         best = time_group_by_key(ctx, keys, vals, n_keys)
         stats = ctx.executors[0].windowed_plane._bulk.exchange.stats()
         assert stats["rounds_executed"] > 0, "windowed plane never ran"
