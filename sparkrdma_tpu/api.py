@@ -92,17 +92,9 @@ class TpuShuffleContext:
                         f"{num_executors} mesh devices, have {n_dev}"
                     )
             self.network = LoopbackNetwork()
-        if stage_to_device is None:
-            # resolved AFTER the collective->windowed rewrite above:
-            # windowed/bulk planes build their exchange streams from
-            # HOST block reads (the collective stages them itself), so
-            # committing map outputs into HBM first would pay a device
-            # round-trip per block for nothing — on the tunneled chip,
-            # milliseconds each.  The host/fixture planes keep HBM
-            # staging (their reads serve straight from the arena).
-            stage_to_device = self.conf.read_plane not in (
-                "bulk", "windowed",
-            )
+        # stage_to_device=None defers to TpuShuffleManager's
+        # plane-aware default (resolved from the conf AFTER the
+        # collective->windowed rewrite above)
         self.driver = TpuShuffleManager(
             self.conf, is_driver=True, network=self.network,
             port=self.conf.driver_port or base_port,

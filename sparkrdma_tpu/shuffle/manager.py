@@ -198,8 +198,17 @@ class TpuShuffleManager:
         port: int = 0,
         executor_id: str = "driver",
         serializer: Optional[Serializer] = None,
-        stage_to_device: bool = True,
+        stage_to_device: Optional[bool] = None,
     ):
+        if stage_to_device is None:
+            # plane-aware default: windowed/bulk exchanges source their
+            # streams from HOST block reads (the collective stages the
+            # bytes itself), so committing map outputs into HBM first
+            # would only add a per-block device round-trip —
+            # milliseconds each on the tunneled chip.  The host plane
+            # and the collective fixture (whose conf keeps
+            # readPlane=collective) resolve to HBM staging.
+            stage_to_device = conf.read_plane not in ("bulk", "windowed")
         self.conf = conf
         self.is_driver = is_driver
         self.network = network

@@ -1085,3 +1085,41 @@ def test_windowed_plane_concurrent_shuffles_one_session(devices):
             for k, v in zip(keys.tolist(), vals.tolist()):
                 expect[k] = expect.get(k, 0) + v
             assert out[tag] == expect, f"shuffle {tag} corrupted"
+
+
+def test_windowed_plane_over_spilled_file_backed_commits(devices, tmp_path):
+    """Composition: the unified plane's window collectives source their
+    streams from SPILLED, file-backed map outputs (per-partition
+    O_DIRECT spill files promoted to shuffle files) — the GB-scale disk
+    path and the device plane working as one system."""
+    import numpy as np
+
+    from sparkrdma_tpu.api import TpuShuffleContext
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.serializer": "columnar",
+        "spark.shuffle.tpu.readPlane": "windowed",
+        "spark.shuffle.tpu.bulkWindowMaps": "2",
+        "spark.shuffle.tpu.shuffleSpillRecordThreshold": "1000",
+        "spark.shuffle.tpu.spillDir": str(tmp_path),
+    })
+    with TpuShuffleContext(
+        num_executors=2, conf=conf, base_port=48500
+    ) as ctx:
+        keys = np.arange(40000, dtype=np.int64) % 29
+        vals = np.arange(40000, dtype=np.int64)
+        got = dict(
+            ctx.parallelize_columns(keys, vals, num_slices=6)
+            .reduce_by_key("sum", num_partitions=6)
+            .collect()
+        )
+        # the exchange really ran collective rounds over spilled bytes
+        stats = ctx.executors[0].windowed_plane._bulk.exchange.stats()
+        assert stats["payload_bytes_moved"] > 0
+    expect = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        expect[k] = expect.get(k, 0) + v
+    assert got == expect
+    import glob
+
+    assert not glob.glob(str(tmp_path / "sparkrdma*")), "files leaked"
