@@ -397,6 +397,7 @@ class WindowedShuffleReader:
         idx = 0
         checked = False
         while True:
+            t0 = time.monotonic()
             try:
                 wins, done = st.wait_beyond(idx, timeout_s)
             except FetchFailedError:
@@ -405,6 +406,11 @@ class WindowedShuffleReader:
                 raise FetchFailedError(
                     mgr.local_smid.host, self.handle.shuffle_id, str(e)
                 ) from e
+            # blocked-on-window time is the plane's fetch-wait analog
+            # (RdmaShuffleReaderStats' latency accounting)
+            self.metrics.fetch_wait_ms += (
+                time.monotonic() - t0
+            ) * 1000
             if not checked:
                 E = len(st.hosts)
                 for rid in range(self.start_partition,
