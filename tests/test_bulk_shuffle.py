@@ -1035,6 +1035,31 @@ def test_windowed_failure_then_stage_retry_completes(devices):
             m.stop()
 
 
+def _run_concurrent_jobs(jobs, timeout=120):
+    """Run callables concurrently; returns {tag: result}.  Fails loudly
+    on a hung job (join timeout) or any job error."""
+    out = {}
+    errs = {}
+
+    def wrap(tag, fn):
+        try:
+            out[tag] = fn()
+        except BaseException as e:
+            errs[tag] = e
+
+    ts = [
+        threading.Thread(target=wrap, args=(tag, fn), daemon=True)
+        for tag, fn in jobs
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in ts), "hung job"
+    assert not errs, errs
+    return out
+
+
 def test_windowed_plane_concurrent_shuffles_one_session(devices):
     """Two shuffles running CONCURRENTLY through one context must not
     cross-contribute rows into the shared session barrier (rounds are
@@ -1055,30 +1080,17 @@ def test_windowed_plane_concurrent_shuffles_one_session(devices):
         vals_a = np.arange(4000, dtype=np.int64)
         keys_b = np.arange(4000, dtype=np.int64) % 7  # same shapes →
         vals_b = np.arange(4000, dtype=np.int64) * 10  # same lengths
-        out = {}
-        errs = {}
 
-        def job(tag, keys, vals):
-            try:
-                out[tag] = dict(
-                    ctx.parallelize_columns(keys, vals, num_slices=4)
-                    .reduce_by_key("sum", num_partitions=4)
-                    .collect()
-                )
-            except BaseException as e:
-                errs[tag] = e
+        def job(keys, vals):
+            return lambda: dict(
+                ctx.parallelize_columns(keys, vals, num_slices=4)
+                .reduce_by_key("sum", num_partitions=4)
+                .collect()
+            )
 
-        ts = [
-            threading.Thread(target=job, args=("a", keys_a, vals_a),
-                             daemon=True),
-            threading.Thread(target=job, args=("b", keys_b, vals_b),
-                             daemon=True),
-        ]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout=120)
-        assert not errs, errs
+        out = _run_concurrent_jobs(
+            [("a", job(keys_a, vals_a)), ("b", job(keys_b, vals_b))]
+        )
         for tag, vals in (("a", vals_a), ("b", vals_b)):
             keys = keys_a
             expect = {}
@@ -1123,3 +1135,47 @@ def test_windowed_plane_over_spilled_file_backed_commits(devices, tmp_path):
     import glob
 
     assert not glob.glob(str(tmp_path / "sparkrdma*")), "files leaked"
+
+
+def test_windowed_plane_many_concurrent_shuffles_no_leak(devices):
+    """4 shuffles in flight through one context: every job exact, and
+    the shared session's keyed-round table drains to empty (each round
+    pops once all participants are served)."""
+    import numpy as np
+
+    from sparkrdma_tpu.api import TpuShuffleContext
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.serializer": "columnar",
+        "spark.shuffle.tpu.readPlane": "windowed",
+        "spark.shuffle.tpu.bulkWindowMaps": "2",
+    })
+    with TpuShuffleContext(
+        num_executors=2, conf=conf, base_port=48900
+    ) as ctx:
+        def job(tag):
+            def run():
+                keys = np.arange(3000, dtype=np.int64) % (5 + tag)
+                vals = np.full(3000, tag + 1, np.int64)
+                return dict(
+                    ctx.parallelize_columns(keys, vals, num_slices=4)
+                    .reduce_by_key("sum", num_partitions=4)
+                    .collect()
+                )
+            return run
+
+        out = _run_concurrent_jobs([(t, job(t)) for t in range(4)])
+        for tag in range(4):
+            nk = 5 + tag
+            expect = {
+                k: (tag + 1) * len(
+                    [x for x in range(3000) if x % nk == k]
+                )
+                for k in range(nk)
+            }
+            assert out[tag] == expect, f"job {tag} corrupted"
+        session = ctx.executors[0].windowed_plane._bulk.session
+        with session._cv:
+            assert not session._keyed, (
+                f"keyed rounds leaked: {list(session._keyed)}"
+            )
