@@ -54,7 +54,7 @@ def main():
         num_executors=4, conf=conf, stage_to_device=False
     ) as ctx:
         best = time_group_by_key(ctx, keys, vals, n_keys)
-        stats = ctx.executors[0].windowed_plane._bulk.exchange.stats()
+        stats = ctx.executors[0].windowed_plane.stats()
         assert stats["rounds_executed"] > 0, "windowed plane never ran"
         assert stats["payload_bytes_moved"] > 0, "no payload exchanged"
 

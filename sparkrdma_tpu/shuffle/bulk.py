@@ -334,6 +334,14 @@ class WindowedReadPlane:
             st = self._shuffles.get(shuffle_id)
         return st.window_events if st is not None else []
 
+    def stats(self) -> dict:
+        """Exchange counters + active pump count (the coordinator-plane
+        stats() analog for this plane)."""
+        out = dict(self._bulk.exchange.stats())
+        with self._lock:
+            out["active_shuffles"] = len(self._shuffles)
+        return out
+
     # -- the pump -----------------------------------------------------------
     def _state(self, shuffle_id: int) -> _ShuffleWindows:
         with self._lock:

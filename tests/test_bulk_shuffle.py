@@ -1126,7 +1126,7 @@ def test_windowed_plane_over_spilled_file_backed_commits(devices, tmp_path):
             .collect()
         )
         # the exchange really ran collective rounds over spilled bytes
-        stats = ctx.executors[0].windowed_plane._bulk.exchange.stats()
+        stats = ctx.executors[0].windowed_plane.stats()
         assert stats["payload_bytes_moved"] > 0
     expect = {}
     for k, v in zip(keys.tolist(), vals.tolist()):
