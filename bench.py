@@ -159,7 +159,9 @@ def main():
     # hardware AND beats the lax.sort step (it has never run on real
     # silicon when slower/broken, the lax number above stands)
     n_chips = len(list(mesh.devices.flat))
-    if n_chips == 1:
+    if n_chips == 1 and not os.environ.get(
+        "SPARKRDMA_TPU_DISABLE_SORT_KERNEL"
+    ):
         try:
             dt_p = _try_pallas_engine(keys, vals, dt)
             if dt_p is not None and dt_p < dt:
