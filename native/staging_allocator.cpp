@@ -206,23 +206,33 @@ void staging_pool_trim(void* handle, uint64_t target_idle_bytes) {
 
 // Row gather with software prefetch: dst[i] = src[idx[i]] for `row`-byte
 // rows.  The record plane's hottest kernel (random 64-byte payload
-// gathers are cache-miss bound); prefetching ~24 rows ahead measures
-// 2.5-3x over numpy's take on wide rows.  Specialized small-row cases
-// let the compiler inline the copy.
+// gathers are cache-miss bound).  Prefetch with L2 residency (locality
+// hint 1): the non-temporal hint (0) evicts lines before the ~32-row
+// pipeline distance catches up and measured 1.8x SLOWER on the 1M x
+// 64B shape (19.1 ms vs 10.6; hint sweep in BASELINE.md round 4).
+// Non-temporal stores also lose here (23.7 ms) — the destination is
+// sequential and write-combines fine through the cache.  Specialized
+// small-row cases let the compiler inline the copy.
+// one tuning site for both the specialized and generic paths
+// (locality: 0=NT, 1=L2, 3=L1 — see the hint-sweep note above)
+static constexpr uint64_t GATHER_PF = 32;
+#define GATHER_PF_HINT 1
+
 template <uint64_t ROW>
 static void row_gather_fixed(const uint8_t* src, uint8_t* dst,
                              const int64_t* idx, uint64_t n) {
-  constexpr uint64_t PF = 24;
+  constexpr uint64_t PF = GATHER_PF;
   for (uint64_t i = 0; i < n; i++) {
     if (i + PF < n)
-      __builtin_prefetch(src + static_cast<uint64_t>(idx[i + PF]) * ROW, 0, 0);
+      __builtin_prefetch(src + static_cast<uint64_t>(idx[i + PF]) * ROW, 0,
+                         GATHER_PF_HINT);
     memcpy(dst + i * ROW, src + static_cast<uint64_t>(idx[i]) * ROW, ROW);
   }
 }
 
 extern "C" void row_gather(const uint8_t* src, uint8_t* dst,
                            const int64_t* idx, uint64_t n, uint64_t row) {
-  const uint64_t PF = 24;
+  const uint64_t PF = GATHER_PF;
   switch (row) {
     case 8:  row_gather_fixed<8>(src, dst, idx, n); return;
     case 16: row_gather_fixed<16>(src, dst, idx, n); return;
@@ -232,7 +242,8 @@ extern "C" void row_gather(const uint8_t* src, uint8_t* dst,
       for (uint64_t i = 0; i < n; i++) {
         if (i + PF < n)
           __builtin_prefetch(
-              src + static_cast<uint64_t>(idx[i + PF]) * row, 0, 0);
+              src + static_cast<uint64_t>(idx[i + PF]) * row, 0,
+              GATHER_PF_HINT);
         memcpy(dst + i * row, src + static_cast<uint64_t>(idx[i]) * row, row);
       }
   }
@@ -446,4 +457,57 @@ extern "C" int kway_merge_i64(const int64_t* keys,
     }
   }
   return 0;
+}
+
+// Fused group-by-key merge over key-sorted runs: the read side's
+// groupByKey combine for blocks committed key-sorted (each map task's
+// block for a partition is one run).  Replaces the per-key Python
+// dict + np.concatenate loop (which re-copies every value byte through
+// small allocations) with ONE streaming pass: for each distinct key,
+// each run's contiguous slice of that key is memcpy'd in run order —
+// sequential reads, sequential writes, |runs| big copies per key
+// instead of one small allocation per key.  Output values for a key
+// are run-0's rows then run-1's ... (bit-exact with the Python merge's
+// batch order).  Returns the number of groups g; out_keys[0..g),
+// out_offs[0..g] hold the group keys and value-row offsets
+// (out_offs[g] = total rows).
+extern "C" int64_t merge_runs_groups_i64(
+    const int64_t* const* run_keys, const uint8_t* const* run_vals,
+    const int64_t* run_len, uint64_t n_runs, uint64_t row,
+    uint8_t* out_vals, int64_t* out_keys, int64_t* out_offs) {
+  std::vector<int64_t> pos(n_runs, 0);
+  int64_t g = 0;
+  int64_t written = 0;
+  for (;;) {
+    bool any = false;
+    int64_t k = 0;
+    for (uint64_t r = 0; r < n_runs; r++) {
+      if (pos[r] < run_len[r]) {
+        const int64_t h = run_keys[r][pos[r]];
+        if (!any || h < k) {
+          k = h;
+          any = true;
+        }
+      }
+    }
+    if (!any) break;
+    out_keys[g] = k;
+    out_offs[g] = written;
+    for (uint64_t r = 0; r < n_runs; r++) {
+      const int64_t len = run_len[r];
+      int64_t p = pos[r];
+      if (p >= len || run_keys[r][p] != k) continue;
+      int64_t e = p + 1;
+      const int64_t* kk = run_keys[r];
+      while (e < len && kk[e] == k) e++;
+      memcpy(out_vals + static_cast<uint64_t>(written) * row,
+             run_vals[r] + static_cast<uint64_t>(p) * row,
+             static_cast<uint64_t>(e - p) * row);
+      written += e - p;
+      pos[r] = e;
+    }
+    g++;
+  }
+  out_offs[g] = written;
+  return g;
 }

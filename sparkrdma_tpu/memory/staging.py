@@ -74,6 +74,13 @@ def _load_native():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_void_p,
         ]
+    if hasattr(lib, "merge_runs_groups_i64"):
+        lib.merge_runs_groups_i64.restype = ctypes.c_int64
+        lib.merge_runs_groups_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
     return lib
 
 
@@ -152,6 +159,48 @@ def native_kway_merge(keys: np.ndarray, run_offsets: np.ndarray):
     if rc != 0:
         return None
     return order
+
+
+def native_merge_runs_groups(key_runs, val_runs):
+    """Fused group-by-key merge over key-sorted runs (one streaming C
+    pass; staging_allocator.cpp merge_runs_groups_i64).  ``key_runs``
+    are contiguous int64 key columns, ``val_runs`` the matching
+    contiguous fixed-itemsize value columns.  Returns ``(uniq_keys,
+    merged_vals, group_offs)`` — group ``i``'s values are the VIEW
+    ``merged_vals[group_offs[i]:group_offs[i+1]]``, ordered run-0's
+    rows first (bit-exact with the per-key Python merge's batch
+    order) — or None when unavailable/ineligible."""
+    if _NATIVE is None or not hasattr(_NATIVE, "merge_runs_groups_i64"):
+        return None
+    if len(key_runs) != len(val_runs) or not key_runs:
+        return None
+    vdt = val_runs[0].dtype
+    for k, v in zip(key_runs, val_runs):
+        if (
+            k.ndim != 1 or k.dtype != np.int64
+            or (len(k) and k.strides[0] != 8)
+            or v.ndim != 1 or v.dtype != vdt
+            or (len(v) and v.strides[0] != vdt.itemsize)
+            or len(k) != len(v)
+        ):
+            return None
+    n = sum(len(k) for k in key_runs)
+    out_vals = np.empty(n, vdt)
+    out_keys = np.empty(n, np.int64)
+    out_offs = np.empty(n + 1, np.int64)
+    nruns = len(key_runs)
+    kptrs = (ctypes.c_void_p * nruns)(*[k.ctypes.data for k in key_runs])
+    vptrs = (ctypes.c_void_p * nruns)(*[v.ctypes.data for v in val_runs])
+    lens = (ctypes.c_int64 * nruns)(*[len(k) for k in key_runs])
+    g = _NATIVE.merge_runs_groups_i64(
+        kptrs, vptrs, lens, nruns, vdt.itemsize,
+        out_vals.ctypes.data, out_keys.ctypes.data, out_offs.ctypes.data,
+    )
+    if g < 0:
+        return None
+    # copy the (small) group-level slices so the full n-sized scratch
+    # isn't pinned behind the views for the consumer's lifetime
+    return out_keys[:g].copy(), out_vals, out_offs[: g + 1].copy()
 
 
 def native_radix_scratch_trim() -> None:

@@ -388,9 +388,30 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
         return iter(zip(batch.keys.tolist(), batch.vals.tolist()))
     if agg is not None:
         if all(b.key_sorted for b in batches):
+            nonempty = [b for b in batches if len(b)]
+            # fused native merge: ONE streaming pass copies each
+            # key's contiguous run slices into the grouped output
+            # (per-key values are then views) — beats both the
+            # per-key Python merge and the concat+gather route
+            from sparkrdma_tpu.memory.staging import (
+                native_merge_runs_groups,
+            )
+
+            res = native_merge_runs_groups(
+                [b.keys for b in nonempty],
+                [b.vals for b in nonempty],
+            )
+            if res is not None:
+                uk, merged_vals, offs = res
+
+                def _native_groups():
+                    for i, k in enumerate(uk.tolist()):
+                        yield k, merged_vals[offs[i]:offs[i + 1]]
+
+                return _native_groups()
             from sparkrdma_tpu.utils.columns import merge_sorted_groups
 
-            per = [group_columns(b) for b in batches if len(b)]
+            per = [group_columns(b) for b in nonempty]
             entries = sum(len(uk) for uk, _ in per)
             # per-key merge beats concat+gather only while the
             # Python loop stays small next to the moved bytes

@@ -342,6 +342,56 @@ def test_native_hash_partition_order_matches_numpy():
         assert np.array_equal(order, ref_order), (trial, n, P)
 
 
+def test_native_merge_runs_groups_matches_python_merge():
+    """The fused streaming group-merge must agree with the per-key
+    Python merge (merge_sorted_groups) as a mapping: same key set,
+    and per key the same value bytes in the same (run-major) order —
+    the read side's groupByKey correctness rests on it."""
+    import numpy as np
+
+    from sparkrdma_tpu.memory.staging import native_merge_runs_groups
+    from sparkrdma_tpu.utils.columns import (
+        ColumnBatch,
+        group_columns,
+        merge_sorted_groups,
+    )
+
+    rng = np.random.default_rng(11)
+    ran = 0
+    for trial in range(120):
+        nruns = int(rng.integers(1, 6))
+        itemsize = int(rng.choice([8, 16, 64]))
+        batches, per = [], []
+        for _ in range(nruns):
+            n = int(rng.integers(0, 60))
+            ks = np.sort(rng.integers(-5, 15, n)).astype(np.int64)
+            vs = np.frombuffer(rng.bytes(n * itemsize), dtype=f"S{itemsize}")
+            b = ColumnBatch(ks, vs, key_sorted=True)
+            if n:
+                batches.append(b)
+                per.append(group_columns(b))
+        res = native_merge_runs_groups(
+            [b.keys for b in batches], [b.vals for b in batches]
+        )
+        ref = {k: v for k, v in merge_sorted_groups(per)}
+        if res is None:
+            if batches:
+                import pytest
+
+                pytest.skip("native staging lib not built")
+            assert not ref
+            continue
+        ran += 1
+        uk, mv, offs = res
+        assert list(uk) == sorted(ref), trial
+        # offsets partition the merged values exactly
+        assert offs[0] == 0 and offs[-1] == len(mv)
+        for i, k in enumerate(uk.tolist()):
+            got = mv[offs[i]:offs[i + 1]]
+            assert got.tobytes() == ref[k].tobytes(), (trial, k)
+    assert ran > 50  # the fuzz actually exercised the kernel
+
+
 def test_native_radix_argsort_matches_numpy_stable():
     import numpy as np
 
