@@ -26,6 +26,25 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+_COMMIT_IO = None
+
+
+def _commit_io_executor():
+    """Shared 1-thread flush executor for commit-time DirectAppenders:
+    overlaps the chunk producer (often a spill read-back) with the
+    O_DIRECT pwrites, like the writer's spill appenders do.  Module-
+    level and never shut down, so commits issued during manager
+    teardown can't hit 'cannot schedule new futures'."""
+    global _COMMIT_IO
+    if _COMMIT_IO is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _COMMIT_IO = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="commit-io"
+        )
+    return _COMMIT_IO
+
+
 def _advise_sequential(arr) -> None:
     """MADV_SEQUENTIAL on the backing mmap: shuffle blocks are read
     front-to-back, and aggressive readahead is worth 2-4x over default
@@ -50,28 +69,57 @@ class MappedFile:
     exactly once on segment release and unlinks the file."""
 
     def __init__(self, chunks, directory: Optional[str] = None,
-                 prefix: str = "sparkrdma_tpu_shuffle_"):
+                 prefix: str = "sparkrdma_tpu_shuffle_",
+                 direct_write: bool = True):
         if isinstance(chunks, (bytes, bytearray, memoryview)):
             chunks = (chunks,)
         directory = directory or tempfile.gettempdir()
         os.makedirs(directory, exist_ok=True)
         fd, self.path = tempfile.mkstemp(prefix=prefix, dir=directory)
         try:
-            total = 0
-            with os.fdopen(fd, "wb") as f:
-                for chunk in chunks:
-                    f.write(chunk)
-                    total += len(chunk)
-                if total == 0:
-                    # mmap of a zero-byte file is invalid: pad to one
-                    # byte so an all-empty-partitions commit still maps
-                    # (the segment serves only EMPTY locations anyway)
-                    f.write(b"\x00")
+            total = self._write_chunks(fd, chunks, directory, direct_write)
             self._map(total)
         except BaseException:
             self._unlink()
             raise
         self._freed = False
+
+    def _write_chunks(self, fd: int, chunks, directory: str,
+                      direct_write: bool) -> int:
+        """Stream ``chunks`` to disk, O_DIRECT when the fs supports it:
+        commits are exactly the writes the virtualized hosts' buffered
+        writeback throttles to ~1/5 of device bandwidth (BASELINE.md
+        round 4 — this was the assembled run's largest single cost),
+        and the file is mmap'd/pread back cache-cold either way."""
+        from sparkrdma_tpu.memory.direct_io import (
+            DirectAppender,
+            direct_supported,
+        )
+
+        total = 0
+        if direct_write and direct_supported(directory):
+            os.close(fd)  # DirectAppender reopens with its own flags
+            app = DirectAppender(self.path, prealloc_bytes=32 << 20,
+                                 executor=_commit_io_executor())
+            try:
+                for chunk in chunks:
+                    _, n = app.append(chunk)
+                    total += n
+                if total == 0:
+                    # mmap of a zero-byte file is invalid: pad to one
+                    # byte so an all-empty-partitions commit still
+                    # maps (the segment serves only EMPTY locations)
+                    app.append(b"\x00")
+            finally:
+                app.finish()
+            return total
+        with os.fdopen(fd, "wb") as f:
+            for chunk in chunks:
+                f.write(chunk)
+                total += len(chunk)
+            if total == 0:
+                f.write(b"\x00")
+        return total
 
     # set False (e.g. conf directIO=off) to force the mmap view path
     direct_read_enabled = True

@@ -175,6 +175,10 @@ def native_merge_runs_groups(key_runs, val_runs):
     if len(key_runs) != len(val_runs) or not key_runs:
         return None
     vdt = val_runs[0].dtype
+    if vdt.hasobject:
+        # memcpy'ing PyObject* rows would duplicate references
+        # without INCREF — double-free on collection
+        return None
     for k, v in zip(key_runs, val_runs):
         if (
             k.ndim != 1 or k.dtype != np.int64

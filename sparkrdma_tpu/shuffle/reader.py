@@ -392,15 +392,19 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
             # fused native merge: ONE streaming pass copies each
             # key's contiguous run slices into the grouped output
             # (per-key values are then views) — beats both the
-            # per-key Python merge and the concat+gather route
+            # per-key Python merge and the concat+gather route.
+            # A single run needs no merge at all: group_columns /
+            # merge_sorted_groups below serve it with zero-copy views
             from sparkrdma_tpu.memory.staging import (
                 native_merge_runs_groups,
             )
 
-            res = native_merge_runs_groups(
-                [b.keys for b in nonempty],
-                [b.vals for b in nonempty],
-            )
+            res = None
+            if len(nonempty) >= 2:
+                res = native_merge_runs_groups(
+                    [b.keys for b in nonempty],
+                    [b.vals for b in nonempty],
+                )
             if res is not None:
                 uk, merged_vals, offs = res
 

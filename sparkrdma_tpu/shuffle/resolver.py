@@ -451,6 +451,7 @@ class ShuffleBlockResolver:
         mf = MappedFile(
             (chunk for b in partition_bytes for chunk in _payload_chunks(b)),
             directory=self.spill_dir,
+            direct_write=self.direct_io != "off",
         )
         mf.direct_read_enabled = self.direct_io != "off"
         try:
