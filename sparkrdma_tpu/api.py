@@ -871,11 +871,14 @@ class Dataset:
              how: str = "inner") -> "Dataset":
         """Equi-join: (k, v) ⋈ (k, w) — the exchange shuffle of the
         reference's SQL workloads (BASELINE configs).  ``how`` is
-        inner (→ (k, (v, w))), left_outer (w may be None), semi
-        (→ (k, v) where a match exists), or anti (→ (k, v) where
-        none does) — the record-plane analog of the device joins
-        (models/join.py JOIN_HOWS)."""
-        if how not in ("inner", "left_outer", "semi", "anti"):
+        inner (→ (k, (v, w))), left_outer (w may be None),
+        right_outer (v may be None), full_outer (either may be None),
+        semi (→ (k, v) where a match exists), or anti (→ (k, v)
+        where none does) — the record-plane analog of the device
+        joins (models/join.py JOIN_HOWS)."""
+        hows = ("inner", "left_outer", "right_outer", "full_outer",
+                "semi", "anti")
+        if how not in hows:
             raise ValueError(f"unsupported join how={how!r}")
         cg = self._cogrouped(other, num_partitions)
 
@@ -888,14 +891,47 @@ class Dataset:
                 elif how == "anti":
                     if not right:
                         out.extend((k, v) for v in left)
-                elif how == "left_outer":
-                    for v in left:
-                        out.extend(
-                            (k, (v, w)) for w in (right or [None])
-                        )
                 else:
-                    for v in left:
-                        out.extend((k, (v, w)) for w in right)
+                    ls = left or (
+                        [None] if how in ("right_outer", "full_outer")
+                        else []
+                    )
+                    rs = right or (
+                        [None] if how in ("left_outer", "full_outer")
+                        else []
+                    )
+                    for v in ls:
+                        out.extend((k, (v, w)) for w in rs)
             return out
 
         return cg.map_partitions(emit)
+
+    def aggregate_by_key(self, zero, seq_func, comb_func,
+                         num_partitions: Optional[int] = None
+                         ) -> "Dataset":
+        """Spark aggregateByKey: fold each key's values into a fresh
+        copy of ``zero`` with ``seq_func`` map-side, merge partials
+        with ``comb_func`` (one combine_by_key shuffle)."""
+        import copy as _copy
+
+        return self.combine_by_key(
+            lambda v: seq_func(_copy.deepcopy(zero), v),
+            seq_func,
+            comb_func,
+            num_partitions=num_partitions,
+        )
+
+    def fold_by_key(self, zero, func,
+                    num_partitions: Optional[int] = None) -> "Dataset":
+        """Spark foldByKey: aggregate_by_key with one function for
+        both the fold and the merge."""
+        return self.aggregate_by_key(
+            zero, func, func, num_partitions=num_partitions
+        )
+
+    def subtract_by_key(self, other: "Dataset",
+                        num_partitions: Optional[int] = None
+                        ) -> "Dataset":
+        """Spark subtractByKey: pairs whose key has NO entry in
+        ``other`` (one cogroup shuffle — the anti-join over pairs)."""
+        return self.join(other, num_partitions=num_partitions, how="anti")

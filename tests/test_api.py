@@ -133,8 +133,57 @@ def test_dataset_join_variants(ctx):
     assert semi == [(1, "x"), (1, "y"), (2, "z")]
     anti = sorted(left.join(right, how="anti").collect())
     assert anti == [(9, "q")]
+    router = sorted(
+        left.join(right, how="right_outer").collect(),
+        key=lambda kv: (kv[0], str(kv[1])),
+    )
+    assert router == [
+        (1, ("x", 10)), (1, ("y", 10)), (2, ("z", 20)), (3, (None, 30))
+    ]
+    fouter = sorted(
+        left.join(right, how="full_outer").collect(),
+        key=lambda kv: (kv[0], str(kv[1])),
+    )
+    assert fouter == [
+        (1, ("x", 10)), (1, ("y", 10)), (2, ("z", 20)),
+        (3, (None, 30)), (9, ("q", None)),
+    ]
     with pytest.raises(ValueError, match="how"):
         left.join(right, how="cross")
+
+
+def test_dataset_aggregate_fold_subtract_by_key(ctx):
+    kv = ctx.parallelize(
+        [(k % 3, v) for k, v in enumerate(range(30))], num_slices=4
+    )
+    # aggregateByKey with an asymmetric MUTABLE zero: a mutating
+    # seq_func detects any shared-zero regression (a shared list
+    # would accumulate other keys' values)
+    def seq(acc, v):
+        acc.append(v)
+        return acc
+
+    agg = dict(
+        kv.aggregate_by_key(
+            [], seq, lambda a, b: a + b, num_partitions=3,
+        ).collect()
+    )
+    for k in range(3):
+        assert sorted(agg[k]) == [
+            v for i, v in enumerate(range(30)) if i % 3 == k
+        ]
+    # the mutable zero must not be shared across keys
+    assert sum(len(v) for v in agg.values()) == 30
+    fold = dict(kv.fold_by_key(0, lambda a, b: a + b).collect())
+    for k in range(3):
+        assert fold[k] == sum(
+            v for i, v in enumerate(range(30)) if i % 3 == k
+        )
+    other = ctx.parallelize([(0, "zz"), (7, "yy")], num_slices=2)
+    sub = sorted(kv.subtract_by_key(other).collect())
+    assert sub == sorted(
+        (k % 3, v) for k, v in enumerate(range(30)) if k % 3 != 0
+    )
 
 
 def test_dataset_combine_by_key(ctx):
