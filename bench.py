@@ -156,12 +156,17 @@ def main():
 
     # single-chip: try the experimental Pallas sort engine
     # (ops/sort_kernel.py) — adopted ONLY if it verifies exact on this
-    # hardware AND beats the lax.sort step (it has never run on real
-    # silicon when slower/broken, the lax number above stands)
+    # hardware AND beats the lax.sort step.  OPT-IN
+    # (SPARKRDMA_TPU_ENABLE_SORT_KERNEL=1, exported by the sweep's
+    # risky phase after tools/profile_tpu_sort.py survives): the
+    # kernel has never Mosaic-compiled on silicon, a hung remote
+    # compile here would stall the driver's unattended end-of-round
+    # bench run with no watchdog, and killing a client mid-compile is
+    # exactly what wedges the grant for hours (tools/TPU_TODO.md)
     n_chips = len(list(mesh.devices.flat))
-    if n_chips == 1 and not os.environ.get(
-        "SPARKRDMA_TPU_DISABLE_SORT_KERNEL"
-    ):
+    if n_chips == 1 and os.environ.get(
+        "SPARKRDMA_TPU_ENABLE_SORT_KERNEL"
+    ) and not os.environ.get("SPARKRDMA_TPU_DISABLE_SORT_KERNEL"):
         try:
             dt_p = _try_pallas_engine(keys, vals, dt)
             if dt_p is not None and dt_p < dt:
