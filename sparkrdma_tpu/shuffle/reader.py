@@ -112,26 +112,21 @@ class ShuffleReader:
         self._callback_ids: List[int] = []
 
     # -- fetch machinery ----------------------------------------------------
-    def _start_remote_fetches(self) -> List[bytes]:
-        """Kick off async location fetches; returns local block payloads.
-        (startAsyncRemoteFetches, RdmaShuffleFetcherIterator.scala:174-311)."""
-        local_payloads: List[bytes] = []
+    def _start_remote_fetches(self) -> Iterator[bytes]:
+        """Kick off async location fetches; returns a LAZY iterator of
+        local block payloads (startAsyncRemoteFetches,
+        RdmaShuffleFetcherIterator.scala:174-311).  Locals must stream
+        one map output at a time: a local-heavy reduce of a GB-scale
+        partition would otherwise hold every pread copy resident
+        before the consumer sees byte one (observed as whole-partition
+        RSS on the 50 GB assembled run), while remote fetches overlap
+        the local consumption either way."""
+        local_map_ids: List[int] = []
         conf = self.manager.conf
         reduce_ids = range(self.start_partition, self.end_partition)
         for host, map_ids in self.maps_by_host.items():
             if host == self.manager.local_smid:
-                for mid in map_ids:
-                    # one batched backing-store read per map output
-                    # (device segments pay a host round-trip per
-                    # Segment read; read_many fetches the union span)
-                    blocks = self.manager.resolver.get_local_blocks(
-                        self.handle.shuffle_id, mid, reduce_ids
-                    )
-                    for data in blocks:
-                        self.metrics.local_blocks += 1
-                        self.metrics.local_bytes += len(data)
-                        if len(data):  # ndarray views: no bool()
-                            local_payloads.append(data)
+                local_map_ids.extend(map_ids)
                 continue
 
             pairs = [(mid, rid) for mid in map_ids for rid in reduce_ids]
@@ -186,7 +181,26 @@ class ShuffleReader:
             except Exception as e:
                 self._fail(MetadataFetchFailedError(
                     host.host, self.handle.shuffle_id, str(e)))
-        return local_payloads
+
+        def _iter_local() -> Iterator[bytes]:
+            # local_blocks/local_bytes count at CONSUMPTION: an
+            # abandoned iteration reports only what was actually
+            # read (remote counters behave the same — blocks left in
+            # the results queue at cleanup were never yielded)
+            for mid in local_map_ids:
+                # one batched backing-store read per map output
+                # (device segments pay a host round-trip per
+                # Segment read; read_many fetches the union span)
+                blocks = self.manager.resolver.get_local_blocks(
+                    self.handle.shuffle_id, mid, reduce_ids
+                )
+                for data in blocks:
+                    self.metrics.local_blocks += 1
+                    self.metrics.local_bytes += len(data)
+                    if len(data):  # ndarray views: no bool()
+                        yield data
+
+        return _iter_local()
 
     def _on_metadata_timeout(self, host: ShuffleManagerId) -> None:
         self._fail(
