@@ -295,3 +295,105 @@ def test_publish_from_tombstoned_executor_dropped(cluster):
     driver._handle_publish(msg)
     assert victim.local_smid not in driver.maps_by_host(77)
     net.heal(victim.node.address)
+
+
+def test_chaos_random_faults_exact_or_clean_failure(cluster):
+    """Randomized fault sweep over the reduce phase: whatever the
+    timing, a job must end in EXACTLY one of two states — bit-exact
+    results, or a stage-retriable fetch/metadata failure followed by
+    a successful retry on the survivors.  Wrong data or a hang is a
+    bug (the reference leans on the same contract:
+    RdmaShuffleFetcherIterator.scala:368-373 → Spark stage retry)."""
+    import random
+    import threading
+    from collections import defaultdict
+
+    from tests.test_shuffle_e2e import run_maps
+
+    net, conf, driver, executors = cluster
+    rng = random.Random(1234)
+    t_start = time.monotonic()
+    retries_proven = 0
+    for trial in range(8):
+        sid = 900 + trial * 2
+        P = rng.choice([2, 4])
+        n_maps = rng.choice([3, 6])
+        handle = driver.register_shuffle(sid, n_maps, HashPartitioner(P))
+        records_per_map = [
+            [(rng.randrange(30), rng.randrange(100))
+             for _ in range(rng.randrange(50, 200))]
+            for _ in range(n_maps)
+        ]
+        maps_by_host = run_maps(handle, executors, records_per_map)
+        oracle = defaultdict(list)
+        for recs in records_per_map:
+            for k, v in recs:
+                oracle[k].append(v)
+
+        fault = rng.choice(["none", "partition", "partition"])
+        victim = rng.choice(executors[1:])  # reader is executor 0
+        delay = rng.uniform(0.0, 0.008)
+        injected = threading.Event()
+
+        def inject(victim=victim, delay=delay, fault=fault):
+            time.sleep(delay)
+            if fault == "partition":
+                net.partition(victim.node.address)
+            injected.set()
+
+        th = threading.Thread(target=inject, daemon=True)
+        th.start()
+        got = defaultdict(list)
+        failed = None
+        try:
+            for pid in range(P):
+                reader = executors[0].get_reader(
+                    handle, pid, pid + 1, maps_by_host
+                )
+                for k, v in reader.read():
+                    got[k].append(v)
+        except (FetchFailedError, MetadataFetchFailedError) as e:
+            failed = e
+        th.join(timeout=5)
+        assert injected.is_set()
+        if failed is None:
+            # whatever the fault timing, completed results are EXACT
+            assert set(got) == set(oracle), (trial, fault)
+            for k in oracle:
+                assert sorted(got[k]) == sorted(oracle[k]), (trial, k)
+        else:
+            assert fault == "partition", f"spurious failure: {failed}"
+            # the lineage contract: heal, re-register, rerun on the
+            # survivors, and the retry must complete exactly
+            net.heal(victim.node.address)
+            survivors = [e for e in executors if e is not victim]
+            retry = driver.register_shuffle(
+                sid + 1, n_maps, HashPartitioner(P)
+            )
+            retry_maps = run_maps(retry, survivors, records_per_map)
+            regot = defaultdict(list)
+            for pid in range(P):
+                reader = executors[0].get_reader(
+                    retry, pid, pid + 1, retry_maps
+                )
+                for k, v in reader.read():
+                    regot[k].append(v)
+            assert set(regot) == set(oracle), trial
+            for k in oracle:
+                assert sorted(regot[k]) == sorted(oracle[k]), (trial, k)
+            retries_proven += 1
+        driver.unregister_shuffle(sid)
+        driver.unregister_shuffle(sid + 1)
+        # restore full membership for the next trial: a partition may
+        # have pruned the victim even when the read completed (the
+        # heartbeat monitor races the fault window), and a pruned
+        # executor stays tombstoned until it re-hellos
+        net.heal(victim.node.address)
+        if victim.local_smid not in driver.executors:
+            victim._hello_sent = False
+            victim._say_hello()
+            _await(lambda: victim.local_smid in driver.executors,
+                   msg=f"trial {trial} rejoin")
+    # the sweep must not stall: 8 trials incl. retries, well under the
+    # per-trial timers (a hang would blow this by minutes)
+    assert time.monotonic() - t_start < 120
