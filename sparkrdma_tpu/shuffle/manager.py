@@ -521,7 +521,15 @@ class TpuShuffleManager:
             if smid not in self._executors:
                 self._executors.append(smid)
             members = list(self._executors)
-        self._last_ack.setdefault(smid, _time.monotonic())
+            # a hello is liveness proof: REFRESH the ack clock
+            # (setdefault would keep a pre-partition timestamp, and the
+            # monitor's next sweep would re-prune a healed executor
+            # that re-helloed before its first fresh ack landed — found
+            # by the seeded chaos sweep).  Inside the membership lock
+            # so a concurrent sweep can't interleave its stale read
+            # between this handler's membership write and clock write
+            # (remove_executor prunes under the same lock).
+            self._last_ack[smid] = _time.monotonic()
         logger.info("driver: hello from %s (now %d executors)",
                     smid.block_manager_id.executor_id, len(members))
         announce = AnnounceShuffleManagersMsg(members)

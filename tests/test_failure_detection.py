@@ -410,3 +410,38 @@ def test_chaos_random_faults_exact_or_clean_failure(cluster):
     # the sweep must not stall: 8 trials incl. retries, well under the
     # per-trial timers (a hang would blow this by minutes)
     assert time.monotonic() - t_start < 120
+
+
+def test_rejoin_hello_refreshes_ack_clock(cluster):
+    """A re-hello from a healed executor must refresh the heartbeat
+    ack clock: with a stale pre-partition timestamp surviving the
+    hello (the setdefault bug the chaos sweep found), the monitor's
+    next sweep re-prunes the executor before its first fresh ack."""
+    net, conf, driver, executors = cluster
+    victim = executors[2]
+    for rep in range(5):
+        # stale clock + immediate re-hello, as after a short partition
+        # the monitor never noticed.  The artificial backdating holds
+        # the prune window open for the whole hello RPC (in production
+        # it is microseconds), so a sweep can prune mid-attempt; that
+        # benign ordering self-heals via rejoin — retry the attempt
+        for attempt in range(3):
+            t0 = time.monotonic()
+            driver._last_ack[victim.local_smid] = t0 - 10.0
+            victim._hello_sent = False
+            victim._say_hello()
+            # synchronize on the driver-side clock actually moving
+            # past the injected stale value (membership alone is
+            # already true and would not prove the hello landed)
+            _await(
+                lambda: driver._last_ack.get(victim.local_smid, 0.0)
+                >= t0 - 5.0,
+                msg=f"rep {rep} ack-clock refresh",
+            )
+            if victim.local_smid in driver.executors:
+                break
+        # outlive a few monitor sweeps (interval 100ms, timeout 400ms)
+        time.sleep(0.25)
+        assert victim.local_smid in driver.executors, (
+            f"rep {rep}: healed executor re-pruned off a stale ack clock"
+        )
