@@ -342,9 +342,13 @@ def test_chaos_random_faults_exact_or_clean_failure(cluster):
 
         # trial 0 is a guaranteed pre-read partition so the
         # failure->retry half of the contract is ALWAYS exercised;
-        # later trials race the injection against the reads
+        # later trials race the injection against the reads.
+        # "channel": flip ONE live channel toward the victim into
+        # sticky ERROR (a QP death without a network partition) —
+        # the transport must reconnect or fail cleanly, never corrupt
         fault = ("partition" if trial == 0
-                 else rng.choice(["none", "partition", "partition"]))
+                 else rng.choice(["none", "partition", "partition",
+                                  "channel"]))
         victim = rng.choice(executors[1:])  # reader is executor 0
         delay = 0.0 if trial == 0 else rng.uniform(0.0, 0.008)
         injected = threading.Event()
@@ -353,6 +357,11 @@ def test_chaos_random_faults_exact_or_clean_failure(cluster):
             time.sleep(delay)
             if fault == "partition":
                 net.partition(victim.node.address)
+            elif fault == "channel":
+                with victim.node._active_lock:
+                    chans = list(victim.node._active.values())
+                if chans:
+                    rng.choice(chans).inject_error()
             injected.set()
 
         th = threading.Thread(target=inject, daemon=True)
@@ -376,7 +385,12 @@ def test_chaos_random_faults_exact_or_clean_failure(cluster):
             for k in oracle:
                 assert sorted(got[k]) == sorted(oracle[k]), (trial, k)
         else:
-            assert fault == "partition", f"spurious failure: {failed}"
+            # a channel error may fail the read (acceptable — it is a
+            # QP death) or be absorbed by a reconnect; a partition may
+            # fail it; fault=none must never fail
+            assert fault in ("partition", "channel"), (
+                f"spurious failure: {failed}"
+            )
             # the lineage contract: heal, re-register, rerun on the
             # survivors, and the retry must complete exactly
             net.heal(victim.node.address)
@@ -403,7 +417,7 @@ def test_chaos_random_faults_exact_or_clean_failure(cluster):
         # asynchronously after a membership check, so checking first
         # would race it and poison the next trial
         net.heal(victim.node.address)
-        if fault == "partition":
+        if fault in ("partition", "channel"):
             time.sleep(0.05)  # let any in-flight prune drain
             _rejoin(net, driver, victim, msg=f"trial {trial} rejoin")
     assert retries_proven >= 1  # trial 0 guarantees the retry path ran
