@@ -1179,3 +1179,125 @@ def test_windowed_plane_many_concurrent_shuffles_no_leak(devices):
             assert not session._keyed, (
                 f"keyed rounds leaked: {list(session._keyed)}"
             )
+
+
+def test_windowed_chaos_random_loss(devices):
+    """Seeded chaos over the windowed plane: an executor loss at a
+    RANDOM point in the map/window schedule must leave every reducer
+    in one of two states — exact results for its partition, or a
+    prompt FetchFailedError — never wrong data or a hang.  The
+    deterministic kill-and-retry scenario is covered above; this sweep
+    varies WHERE the loss lands relative to the window plans."""
+    import os
+    import random
+
+    from sparkrdma_tpu.shuffle.bulk import WindowedReadPlane
+    from sparkrdma_tpu.shuffle.reader import FetchFailedError
+
+    rng = random.Random(int(os.environ.get(
+        "SPARKRDMA_TEST_CHAOS_SEED", "4321"
+    )))
+    E, num_maps, num_parts = 3, 6, 6
+    n_trials = int(os.environ.get("SPARKRDMA_TEST_CHAOS_TRIALS", "2"))
+    for trial in range(n_trials):
+        net = LoopbackNetwork()
+        conf = TpuShuffleConf({
+            "spark.shuffle.tpu.driverPort": 46200,
+            "spark.shuffle.tpu.heartbeatInterval": "100ms",
+            "spark.shuffle.tpu.heartbeatTimeout": "3s",
+            "spark.shuffle.tpu.partitionLocationFetchTimeout": "8s",
+            "spark.shuffle.tpu.bulkWindowMaps": "2",
+            "spark.shuffle.tpu.readPlane": "windowed",
+        })
+        driver = TpuShuffleManager(conf, is_driver=True, network=net)
+        executors = [
+            TpuShuffleManager(
+                conf, is_driver=False, network=net,
+                port=46300 + i * 10, executor_id=str(i),
+                stage_to_device=False,
+            )
+            for i in range(E)
+        ]
+        victim = executors[rng.randrange(1, E)]
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if all(len(e._peers) == E for e in executors):
+                    break
+                time.sleep(0.01)
+            session = BulkShuffleSession(
+                TileExchange(make_mesh(E), tile_bytes=1 << 12), E,
+                timeout_s=conf.bulk_barrier_timeout_ms / 1000.0,
+            )
+            for e in executors:
+                e.windowed_plane = WindowedReadPlane(e, session=session)
+            part = HashPartitioner(num_parts)
+            records_per_map = [
+                [(f"m{m}k{j}", (m, j)) for j in range(25)]
+                for m in range(num_maps)
+            ]
+            sid = 800 + trial
+            handle = driver.register_shuffle(sid, num_maps, part)
+
+            fault = rng.choice(["none", "loss", "loss"])
+            kill_after = rng.randrange(2, num_maps + 1)
+
+            results, errors = {}, {}
+
+            def reduce_task(pid):
+                try:
+                    r = executors[pid % E].get_reader(
+                        handle, pid, pid + 1, {}
+                    )
+                    results[pid] = list(r.read())
+                except BaseException as e:
+                    errors[pid] = e
+
+            threads = [
+                threading.Thread(target=reduce_task, args=(p,),
+                                 daemon=True)
+                for p in range(num_parts)
+            ]
+            for t in threads:
+                t.start()
+            for m in range(num_maps):
+                if fault == "loss" and m == kill_after:
+                    net.partition(victim.node.address)
+                w = executors[m % E].get_writer(handle, m)
+                w.write(records_per_map[m])
+                try:
+                    w.stop(True)
+                except BaseException:
+                    # the victim's own publish may fail mid-kill;
+                    # readers then fail fast — acceptable
+                    pass
+                time.sleep(rng.uniform(0, 0.01))
+            if fault == "loss" and kill_after == num_maps:
+                net.partition(victim.node.address)
+            # generous join: a loss trial legitimately rides the
+            # location timer + barrier timeout, and a loaded box (the
+            # seed soaks run several of these concurrently) stretches
+            # that chain well past its nominal length
+            for t in threads:
+                t.join(timeout=120)
+            hung = [p for p in range(num_parts)
+                    if p not in results and p not in errors]
+            assert not hung, f"trial {trial}: readers hung: {hung}"
+            # completed partitions must be EXACT regardless of timing
+            all_records = [kv for recs in records_per_map for kv in recs]
+            for pid, got in results.items():
+                want = [(k, v) for k, v in all_records
+                        if part.partition(k) == pid]
+                assert sorted(map(repr, got)) == sorted(map(repr, want)), (
+                    f"trial {trial} partition {pid} inexact"
+                )
+            for pid, err in errors.items():
+                assert isinstance(err, FetchFailedError), (
+                    f"trial {trial} partition {pid}: {err!r}"
+                )
+            if fault == "none":
+                assert not errors, f"trial {trial}: {errors}"
+        finally:
+            net.heal(victim.node.address)
+            for m in executors + [driver]:
+                m.stop()
