@@ -74,6 +74,11 @@ def _load_native():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_void_p,
         ]
+    if hasattr(lib, "rank_compress_i64"):
+        lib.rank_compress_i64.restype = ctypes.c_int64
+        lib.rank_compress_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ]
     if hasattr(lib, "merge_runs_groups_i64"):
         lib.merge_runs_groups_i64.restype = ctypes.c_int64
         lib.merge_runs_groups_i64.argtypes = [
@@ -159,6 +164,29 @@ def native_kway_merge(keys: np.ndarray, run_offsets: np.ndarray):
     if rc != 0:
         return None
     return order
+
+
+def native_rank_compress(keys: np.ndarray):
+    """Dense sorted-rank compression of a wide-range, low-cardinality
+    int64 column (staging_allocator.cpp rank_compress_i64): returns a
+    uint16 rank array whose stable argsort equals the keys' stable
+    argsort, or None when unavailable/ineligible/cardinality > 65536
+    (the kernel aborts its scan at the 65537th distinct, so the failed
+    probe costs well under a millisecond on high-cardinality data)."""
+    if _NATIVE is None or not hasattr(_NATIVE, "rank_compress_i64"):
+        return None
+    if (
+        keys.ndim != 1 or keys.dtype != np.int64
+        or (len(keys) and keys.strides[0] != 8)
+    ):
+        return None
+    ranks = np.empty(len(keys), np.uint16)
+    g = _NATIVE.rank_compress_i64(
+        keys.ctypes.data, len(keys), ranks.ctypes.data
+    )
+    if g < 0:
+        return None
+    return ranks
 
 
 def native_merge_runs_groups(key_runs, val_runs):

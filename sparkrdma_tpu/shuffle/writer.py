@@ -196,6 +196,35 @@ class ShuffleWriter:
                     counts = np.diff(
                         np.concatenate(([0], bounds, [n]))
                     ).astype(np.int64)
+            if (order is None and is_hash
+                    and batch.keys.dtype == np.int64):
+                # wide-RANGE but low-CARDINALITY keys: compress to
+                # dense sorted uint16 ranks, then ONE composite uint32
+                # radix argsort replaces the two-sort-two-gather chain
+                # (pid-major, key-ascending, stable — same order)
+                from sparkrdma_tpu.memory.staging import (
+                    native_rank_compress,
+                )
+
+                ranks = native_rank_compress(batch.keys)
+                if ranks is not None:
+                    pids = self.handle.partitioner.partition_array(
+                        batch.keys
+                    )
+                    nr = int(ranks.max()) + 1 if n else 1
+                    # uint16 only: numpy's STABLE sort is radix for
+                    # <=16-bit ints but timsort at 32 bits (measured
+                    # 5ms vs 80ms per M) — past 65536 composites the
+                    # two-sort chain below is faster
+                    if int(P) * nr <= (1 << 16):
+                        comp = (
+                            pids.astype(np.uint16) * np.uint16(nr)
+                            + ranks
+                        )
+                        order = np.argsort(comp, kind="stable")
+                        counts = np.bincount(
+                            pids, minlength=P
+                        ).astype(np.int64)
             if order is None:
                 pids = self.handle.partitioner.partition_array(batch.keys)
                 korder = stable_key_order(batch.keys)

@@ -133,8 +133,18 @@ def stable_key_order(keys: np.ndarray) -> np.ndarray:
                 (keys - kmin).astype(np.uint16), kind="stable"
             )
         if keys.dtype == np.int64 and len(keys) >= (1 << 14):
-            from sparkrdma_tpu.memory.staging import native_radix_argsort
+            from sparkrdma_tpu.memory.staging import (
+                native_radix_argsort,
+                native_rank_compress,
+            )
 
+            # wide RANGE but low CARDINALITY (the groupByKey shape):
+            # compress keys to dense sorted uint16 ranks and ride the
+            # radix path above — ~3x the 4-pass 64-bit radix; the
+            # probe self-aborts in <1ms on high-cardinality columns
+            ranks = native_rank_compress(keys)
+            if ranks is not None:
+                return np.argsort(ranks, kind="stable")
             order = native_radix_argsort(keys)
             if order is not None:
                 return order

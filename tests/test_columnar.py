@@ -696,3 +696,51 @@ def test_sorted_read_uses_merge_path(devices, monkeypatch):
     assert calls and all(calls), (
         f"native merge path never ran / fell back: {calls}"
     )
+
+
+def test_wide_range_low_card_composite_order_matches_generic():
+    """The rank-compress composite path (wide-RANGE, low-CARDINALITY
+    hash keys → ONE uint16 radix argsort) must produce the exact
+    pid-major stable key order of the generic two-sort chain."""
+    import numpy as np
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.shuffle.manager import (
+        ShuffleHandle,
+        TpuShuffleManager,
+    )
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+    from sparkrdma_tpu.transport import LoopbackNetwork
+    from sparkrdma_tpu.utils.columns import ColumnBatch, stable_key_order
+
+    rng = np.random.default_rng(13)
+    conf = TpuShuffleConf({"spark.shuffle.tpu.serializer": "columnar"})
+    net = LoopbackNetwork()
+    mgr = TpuShuffleManager(conf, is_driver=True, network=net,
+                            stage_to_device=False)
+    try:
+        for trial, (card, P, n) in enumerate(
+            [(512, 8, 50_000), (1, 4, 1_000), (65536 // 8, 8, 30_000),
+             (70_000, 8, 100_000)]  # last: cardinality too high → generic
+        ):
+            pool = rng.integers(-(1 << 62), 1 << 62, card, dtype=np.int64)
+            keys = pool[rng.integers(0, card, n)]
+            vals = np.arange(n, dtype=np.int64)
+            part = HashPartitioner(P)
+            sid = 120 + trial
+            handle = ShuffleHandle(sid, 1, part)
+            mgr.register_shuffle(sid, 1, part)
+            w = mgr.get_writer(handle, 0)
+            w.write_columns(ColumnBatch(keys, vals))
+            _b, order, counts = w._col_pending[-1]
+            pids = part.partition_array(keys)
+            korder = stable_key_order(keys)
+            porder = np.argsort(
+                pids[korder].astype(np.uint16), kind="stable"
+            )
+            ref_order = korder[porder]
+            ref_counts = np.bincount(pids, minlength=P).astype(np.int64)
+            assert np.array_equal(counts, ref_counts), trial
+            assert np.array_equal(order, ref_order), trial
+    finally:
+        mgr.stop()
