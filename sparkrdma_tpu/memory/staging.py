@@ -168,11 +168,13 @@ def native_kway_merge(keys: np.ndarray, run_offsets: np.ndarray):
 
 def native_rank_compress(keys: np.ndarray):
     """Dense sorted-rank compression of a wide-range, low-cardinality
-    int64 column (staging_allocator.cpp rank_compress_i64): returns a
-    uint16 rank array whose stable argsort equals the keys' stable
-    argsort, or None when unavailable/ineligible/cardinality > 65536
-    (the kernel aborts its scan at the 65537th distinct, so the failed
-    probe costs well under a millisecond on high-cardinality data)."""
+    int64 column (staging_allocator.cpp rank_compress_i64): returns
+    ``(ranks, n_distinct)`` — a uint16 rank array whose stable argsort
+    equals the keys' stable argsort, plus the exact distinct count the
+    kernel already knows (so callers never rescan for it) — or None
+    when unavailable/ineligible/cardinality > 65536 (the kernel aborts
+    its scan at the 65537th distinct, so the failed probe costs well
+    under a millisecond on high-cardinality data)."""
     if _NATIVE is None or not hasattr(_NATIVE, "rank_compress_i64"):
         return None
     if (
@@ -186,7 +188,7 @@ def native_rank_compress(keys: np.ndarray):
     )
     if g < 0:
         return None
-    return ranks
+    return ranks, int(g)
 
 
 def native_merge_runs_groups(key_runs, val_runs):

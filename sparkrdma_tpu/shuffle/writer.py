@@ -197,34 +197,42 @@ class ShuffleWriter:
                         np.concatenate(([0], bounds, [n]))
                     ).astype(np.int64)
             if (order is None and is_hash
-                    and batch.keys.dtype == np.int64):
+                    and batch.keys.dtype == np.int64
+                    and n >= (1 << 14)):
                 # wide-RANGE but low-CARDINALITY keys: compress to
-                # dense sorted uint16 ranks, then ONE composite uint32
-                # radix argsort replaces the two-sort-two-gather chain
-                # (pid-major, key-ascending, stable — same order)
+                # dense sorted uint16 ranks (size gate matches
+                # stable_key_order's — the kernel's 2MB table isn't
+                # worth filling for small batches), then ONE composite
+                # uint16 radix argsort replaces the two-sort-two-
+                # gather chain (pid-major, key-ascending, stable —
+                # same order).  uint16 only: numpy's STABLE sort is
+                # radix for <=16-bit ints but timsort at 32 bits
+                # (measured 5ms vs 80ms per M); past 65536 composites
+                # the ranks still replace the key sort in the two-sort
+                # chain below
                 from sparkrdma_tpu.memory.staging import (
                     native_rank_compress,
                 )
 
-                ranks = native_rank_compress(batch.keys)
-                if ranks is not None:
+                res = native_rank_compress(batch.keys)
+                if res is not None:
+                    ranks, nr = res
                     pids = self.handle.partitioner.partition_array(
                         batch.keys
                     )
-                    nr = int(ranks.max()) + 1 if n else 1
-                    # uint16 only: numpy's STABLE sort is radix for
-                    # <=16-bit ints but timsort at 32 bits (measured
-                    # 5ms vs 80ms per M) — past 65536 composites the
-                    # two-sort chain below is faster
                     if int(P) * nr <= (1 << 16):
                         comp = (
                             pids.astype(np.uint16) * np.uint16(nr)
                             + ranks
                         )
                         order = np.argsort(comp, kind="stable")
-                        counts = np.bincount(
-                            pids, minlength=P
-                        ).astype(np.int64)
+                    else:
+                        korder = np.argsort(ranks, kind="stable")
+                        porder = stable_key_order(pids[korder])
+                        order = korder[porder]
+                    counts = np.bincount(
+                        pids, minlength=P
+                    ).astype(np.int64)
             if order is None:
                 pids = self.handle.partitioner.partition_array(batch.keys)
                 korder = stable_key_order(batch.keys)

@@ -142,9 +142,9 @@ def stable_key_order(keys: np.ndarray) -> np.ndarray:
             # compress keys to dense sorted uint16 ranks and ride the
             # radix path above — ~3x the 4-pass 64-bit radix; the
             # probe self-aborts in <1ms on high-cardinality columns
-            ranks = native_rank_compress(keys)
-            if ranks is not None:
-                return np.argsort(ranks, kind="stable")
+            res = native_rank_compress(keys)
+            if res is not None:
+                return np.argsort(res[0], kind="stable")
             order = native_radix_argsort(keys)
             if order is not None:
                 return order

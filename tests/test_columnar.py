@@ -698,12 +698,16 @@ def test_sorted_read_uses_merge_path(devices, monkeypatch):
     )
 
 
-def test_wide_range_low_card_composite_order_matches_generic():
+def test_wide_range_low_card_composite_order_matches_generic(monkeypatch):
     """The rank-compress composite path (wide-RANGE, low-CARDINALITY
     hash keys → ONE uint16 radix argsort) must produce the exact
-    pid-major stable key order of the generic two-sort chain."""
+    pid-major stable key order of the generic two-sort chain; the
+    kernel's cardinality abort (>65536 distinct) must route to the
+    generic path, and the composite must actually RUN for the shapes
+    that advertise it."""
     import numpy as np
 
+    import sparkrdma_tpu.memory.staging as staging
     from sparkrdma_tpu.conf import TpuShuffleConf
     from sparkrdma_tpu.shuffle.manager import (
         ShuffleHandle,
@@ -713,18 +717,39 @@ def test_wide_range_low_card_composite_order_matches_generic():
     from sparkrdma_tpu.transport import LoopbackNetwork
     from sparkrdma_tpu.utils.columns import ColumnBatch, stable_key_order
 
+    calls = {"ok": 0, "abort": 0}
+    real = staging.native_rank_compress
+
+    def counting(keys):
+        res = real(keys)
+        calls["ok" if res is not None else "abort"] += 1
+        return res
+
+    monkeypatch.setattr(staging, "native_rank_compress", counting)
+
     rng = np.random.default_rng(13)
     conf = TpuShuffleConf({"spark.shuffle.tpu.serializer": "columnar"})
     net = LoopbackNetwork()
     mgr = TpuShuffleManager(conf, is_driver=True, network=net,
                             stage_to_device=False)
     try:
-        for trial, (card, P, n) in enumerate(
-            [(512, 8, 50_000), (1, 4, 1_000), (65536 // 8, 8, 30_000),
-             (70_000, 8, 100_000)]  # last: cardinality too high → generic
+        # (cardinality, P, rows, all_unique): the last trial's keys are
+        # ALL distinct (100k > 65536) so the kernel's abort path — not
+        # just the P*nr guard — routes to the generic chain
+        for trial, (card, P, n, uniq) in enumerate(
+            [(512, 8, 50_000, False), (65536 // 8, 8, 30_000, False),
+             (60_000, 2, 80_000, False), (0, 8, 100_000, True)]
         ):
-            pool = rng.integers(-(1 << 62), 1 << 62, card, dtype=np.int64)
-            keys = pool[rng.integers(0, card, n)]
+            if uniq:
+                keys = rng.permutation(
+                    np.arange(-(n // 2), n - n // 2, dtype=np.int64)
+                    * np.int64(1 << 40)
+                )
+            else:
+                pool = rng.integers(
+                    -(1 << 62), 1 << 62, card, dtype=np.int64
+                )
+                keys = pool[rng.integers(0, card, n)]
             vals = np.arange(n, dtype=np.int64)
             part = HashPartitioner(P)
             sid = 120 + trial
@@ -744,3 +769,7 @@ def test_wide_range_low_card_composite_order_matches_generic():
             assert np.array_equal(order, ref_order), trial
     finally:
         mgr.stop()
+    # the composite/rank path ran for the low-card trials and the
+    # all-unique trial hit the kernel's abort
+    assert calls["ok"] >= 3, calls
+    assert calls["abort"] >= 1, calls
