@@ -108,6 +108,20 @@ def _executor_main(idx, driver_port, my_port, done: multiprocessing.Event,
         raise
 
 
+def _wait_published(driver, shuffle_id, n, failed, timeout=30):
+    """Poll the driver until n map outputs are published (breaking
+    early on a child-process failure flag)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if failed.is_set():
+            break
+        mbh = driver.maps_by_host(shuffle_id)
+        if sum(len(v) for v in mbh.values()) == n:
+            break
+        time.sleep(0.05)
+    return driver.maps_by_host(shuffle_id)
+
+
 def test_tcp_multiprocess_shuffle():
     """Two executor PROCESSES write+publish over sockets; the driver
     process resolves locations and pulls every block."""
@@ -133,15 +147,8 @@ def test_tcp_multiprocess_shuffle():
     try:
         for p in procs:
             p.start()
-        # wait until both map outputs are published to the driver
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
-            mbh = driver.maps_by_host(7)
-            if sum(len(v) for v in mbh.values()) == 2 and not failed.is_set():
-                break
-            time.sleep(0.05)
+        mbh = _wait_published(driver, 7, 2, failed)
         assert not failed.is_set(), "executor subprocess crashed"
-        mbh = driver.maps_by_host(7)
         assert sum(len(v) for v in mbh.values()) == 2
 
         reader = driver.get_reader(handle, 0, 4, mbh)
@@ -281,3 +288,78 @@ def test_tcp_concurrent_reads_one_channel():
         b.stop()
         net.unregister(a)
         net.unregister(b)
+
+
+def test_tcp_executor_sigkill_mid_shuffle_fails_promptly():
+    """A SIGKILLed executor PROCESS (no goodbye, sockets die) must
+    surface as a prompt stage-retriable failure on the data plane —
+    never a hang — while the survivor's blocks stay readable.  The
+    loopback chaos sweeps cannot exercise real socket death."""
+    from sparkrdma_tpu.shuffle.reader import (
+        FetchFailedError,
+        MetadataFetchFailedError,
+    )
+
+    ctx = multiprocessing.get_context("spawn")
+    driver_port = BASE_PORT + 700
+    conf = make_conf(driver_port)
+    driver = TpuShuffleManager(
+        conf, is_driver=True, network=TcpNetwork(),
+        port=driver_port, stage_to_device=False,
+    )
+    part = HashPartitioner(4)
+    handle = driver.register_shuffle(7, 2, part)
+    # per-process done events: a SIGKILLed child can die holding the
+    # shared Event's lock, deadlocking the parent's done.set() in
+    # teardown (observed: 90s hang in synchronize.notify) — the
+    # victim's event is never touched after the kill
+    dones = [ctx.Event(), ctx.Event()]
+    failed = ctx.Event()
+    killed = False  # whether the SIGKILL landed (victim event unsafe after)
+    ports = [BASE_PORT + 1300, BASE_PORT + 1310]
+    procs = [
+        ctx.Process(
+            target=_executor_main,
+            args=(i, driver_port, ports[i], dones[i], failed),
+            daemon=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for p in procs:
+            p.start()
+        mbh = _wait_published(driver, 7, 2, failed)
+        assert not failed.is_set(), "executor subprocess crashed"
+        assert sum(len(v) for v in mbh.values()) == 2
+
+        killed = True
+        procs[1].kill()  # SIGKILL: no teardown, no goodbye
+        procs[1].join(timeout=10)
+
+        t0 = time.monotonic()
+        reader = driver.get_reader(handle, 0, 4, mbh)
+        with pytest.raises((FetchFailedError, MetadataFetchFailedError)):
+            dict(reader.read())
+        assert time.monotonic() - t0 < 15, "dead-socket fetch not prompt"
+
+        # the survivor's map output remains fully readable
+        mbh0 = {
+            smid: mids for smid, mids in mbh.items()
+            if smid.block_manager_id.executor_id == "0"
+        }
+        assert mbh0, mbh
+        reader2 = driver.get_reader(handle, 0, 4, mbh0)
+        got = dict(reader2.read())
+        assert got == {f"w0-{j}": j for j in range(30)}
+    finally:
+        dones[0].set()
+        if not killed:
+            # early failure before the kill: release the healthy
+            # child instead of stalling 10s and SIGTERMing it
+            dones[1].set()
+        # after a SIGKILL the victim's event stays untouched (see above)
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+        driver.stop()
