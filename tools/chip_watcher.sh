@@ -18,6 +18,7 @@ cd "$(dirname "$0")/.."
 log=tools/chip_watcher.log
 # round started ~03:47 UTC with a ~12h budget
 FULL_SWEEP_UNTIL=$(date -d "2026-07-31 13:15 UTC" +%s)
+SAFE_SWEEP_UNTIL=$(date -d "2026-07-31 14:00 UTC" +%s)
 HEADLINE_UNTIL=$(date -d "2026-07-31 14:45 UTC" +%s)
 echo "$(date +%F_%T) watcher start" >> "$log"
 while true; do
@@ -35,6 +36,11 @@ while true; do
       bash tools/run_all_benches.sh >> "$log" 2>&1
       rc=$?
       echo "$(date +%F_%T) sweep finished (rc=$rc)" >> "$log"
+    elif [ "$now" -lt "$SAFE_SWEEP_UNTIL" ]; then
+      echo "$(date +%F_%T) chip ALIVE — safe-phase sweep only (late window)" >> "$log"
+      SPARKRDMA_SWEEP_SAFE_ONLY=1 bash tools/run_all_benches.sh >> "$log" 2>&1
+      rc=$?
+      echo "$(date +%F_%T) safe sweep finished (rc=$rc)" >> "$log"
     else
       echo "$(date +%F_%T) chip ALIVE late — headline bench only" >> "$log"
       # NO external timeout: killing bench.py mid-RPC would wedge the
