@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import threading
 from typing import Optional
 
 import numpy as np
@@ -27,6 +28,7 @@ logger = logging.getLogger(__name__)
 
 
 _COMMIT_IO = None
+_COMMIT_IO_LOCK = threading.Lock()
 
 
 def _commit_io_executor():
@@ -34,14 +36,18 @@ def _commit_io_executor():
     overlaps the chunk producer (often a spill read-back) with the
     O_DIRECT pwrites, like the writer's spill appenders do.  Module-
     level and never shut down, so commits issued during manager
-    teardown can't hit 'cannot schedule new futures'."""
+    teardown can't hit 'cannot schedule new futures'.  Double-checked
+    lock: two first-commit threads racing here must share ONE flush
+    thread (the single-flush-thread property the writers rely on)."""
     global _COMMIT_IO
     if _COMMIT_IO is None:
-        from concurrent.futures import ThreadPoolExecutor
+        with _COMMIT_IO_LOCK:
+            if _COMMIT_IO is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-        _COMMIT_IO = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="commit-io"
-        )
+                _COMMIT_IO = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="commit-io"
+                )
     return _COMMIT_IO
 
 

@@ -220,7 +220,10 @@ class ShuffleWriter:
                     pids = self.handle.partitioner.partition_array(
                         batch.keys
                     )
-                    if int(P) * nr <= (1 << 16):
+                    # nr < 2**16 is defensive: unreachable today (P==1
+                    # short-circuits above, so P>=2 bounds nr<=32768)
+                    # but np.uint16(nr) needs it if that ever changes
+                    if nr < (1 << 16) and int(P) * nr <= (1 << 16):
                         comp = (
                             pids.astype(np.uint16) * np.uint16(nr)
                             + ranks

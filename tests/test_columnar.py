@@ -773,3 +773,68 @@ def test_wide_range_low_card_composite_order_matches_generic(monkeypatch):
     # all-unique trial hit the kernel's abort
     assert calls["ok"] >= 3, calls
     assert calls["abort"] >= 1, calls
+
+
+def test_rank_compress_exactly_65536_distinct_single_partition():
+    """Boundary case: rank_compress_i64 returns nr==65536 for exactly
+    2**16 distinct keys (its abort gate is strictly-greater).  No
+    reachable writer path feeds that into ``np.uint16`` today — P==1
+    short-circuits before the rank-compress branch, and P>=2 bounds
+    nr<=32768 via the P*nr guard — but the composite gate carries a
+    defensive ``nr < 2**16`` so a future P==1 path can't overflow
+    under numpy>=2.  This pins the kernel's boundary behavior and the
+    writer's P==1 semantics."""
+    import numpy as np
+
+    import sparkrdma_tpu.memory.staging as staging
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.shuffle.manager import (
+        ShuffleHandle,
+        TpuShuffleManager,
+    )
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+    from sparkrdma_tpu.transport import LoopbackNetwork
+    from sparkrdma_tpu.utils.columns import ColumnBatch, stable_key_order
+
+    if staging.native_rank_compress(
+        np.arange(4, dtype=np.int64)
+    ) is None:
+        pytest.skip("native lib unavailable")
+
+    rng = np.random.default_rng(65536)
+    pool = rng.permutation(
+        rng.integers(-(1 << 62), 1 << 62, 1 << 16, dtype=np.int64)
+    )
+    assert len(np.unique(pool)) == 1 << 16
+    # every pool key appears at least once => exactly 65536 distinct
+    keys = np.concatenate(
+        [pool, pool[rng.integers(0, 1 << 16, 20_000)]]
+    )
+    keys = keys[rng.permutation(len(keys))]
+    vals = np.arange(len(keys), dtype=np.int64)
+    conf = TpuShuffleConf({"spark.shuffle.tpu.serializer": "columnar"})
+    net = LoopbackNetwork()
+    mgr = TpuShuffleManager(conf, is_driver=True, network=net,
+                            stage_to_device=False)
+    try:
+        part = HashPartitioner(1)
+        handle = ShuffleHandle(140, 1, part)
+        mgr.register_shuffle(140, 1, part)
+        w = mgr.get_writer(handle, 0)
+        w.write_columns(ColumnBatch(keys, vals))  # must not raise
+        _b, order, counts = w._col_pending[-1]
+        assert counts.sum() == len(keys)
+        # P==1 short-circuits to (order=None, original order); any
+        # non-None order must equal the stable key order
+        assert order is None or np.array_equal(
+            order, stable_key_order(keys)
+        )
+        # the composite gate itself must reject nr==65536 even at P==1
+        # (np.uint16(65536) overflows under numpy>=2)
+        res = staging.native_rank_compress(keys)
+        assert res is not None
+        _ranks, nr = res
+        assert nr == 1 << 16
+        assert not (nr < (1 << 16) and 1 * nr <= (1 << 16))
+    finally:
+        mgr.stop()

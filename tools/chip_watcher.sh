@@ -16,10 +16,10 @@
 set -u
 cd "$(dirname "$0")/.."
 log=tools/chip_watcher.log
-# round started ~03:47 UTC with a ~12h budget
-FULL_SWEEP_UNTIL=$(date -d "2026-07-31 13:15 UTC" +%s)
-SAFE_SWEEP_UNTIL=$(date -d "2026-07-31 14:00 UTC" +%s)
-HEADLINE_UNTIL=$(date -d "2026-07-31 14:45 UTC" +%s)
+# round 5 started ~15:45 UTC Jul 31 with a ~12h budget
+FULL_SWEEP_UNTIL=$(date -d "2026-08-01 01:15 UTC" +%s)
+SAFE_SWEEP_UNTIL=$(date -d "2026-08-01 02:00 UTC" +%s)
+HEADLINE_UNTIL=$(date -d "2026-08-01 02:45 UTC" +%s)
 echo "$(date +%F_%T) watcher start" >> "$log"
 while true; do
   now=$(date +%s)

@@ -74,6 +74,15 @@ run python benchmarks/bench_sort_wordcount.py
 run python benchmarks/bench_tpcds.py
 run env SPARKRDMA_BENCH_DEVICE=1 python benchmarks/bench_assembled_10gb.py
 
+# Late-window recoveries (chip_watcher.sh safe tier) must stop here:
+# the risky Mosaic-compile phase is the documented grant-wedging hazard
+# right before the driver's official end-of-round run.
+if [ -n "${SPARKRDMA_SWEEP_SAFE_ONLY:-}" ]; then
+  echo "SPARKRDMA_SWEEP_SAFE_ONLY set — skipping the risky Mosaic phase" | tee -a "$out"
+  echo "results in $out"
+  exit 0
+fi
+
 # ---- RISKY PHASE: first-ever Mosaic compiles.  Each step re-probes on
 # timeout; a hang here costs only the remaining (optional) steps.
 if run env -u SPARKRDMA_TPU_DISABLE_SCAN_KERNELS python tools/profile_tpu_scans.py 22; then
