@@ -9,7 +9,7 @@ publish → plan windows → TileExchange → reducer reads integration
 standing in for the reference's commit → publish → FetchMapStatus →
 scatter RDMA READ pipeline (RdmaShuffleFetcherIterator.scala:162-171,
 RdmaChannel.java:441-474).  Supersedes the round-2/3 coordinator
-variant (parallel/collective_read.py, now a test fixture).
+variant (tests/collective_read_fixture.py, now a test fixture).
 
 Needs ≥4 mesh devices; on the single-chip bench host it re-execs onto
 a spoofed 8-device CPU mesh, so the number gauges the integrated
@@ -45,8 +45,10 @@ def main():
     # window is one collective (its own dispatch + tile padding).  The
     # throughput configuration is a single window (0); measured on the
     # 8-device CPU mesh: wm=0 0.122 GB/s, wm=4 0.060, wm=2 0.035 —
-    # overlap-hungry jobs pick fine windows, throughput jobs coarse
-    conf.set("bulkWindowMaps", "0")
+    # overlap-hungry jobs pick fine windows, throughput jobs coarse.
+    # SPARKRDMA_BENCH_WINDOW_MAPS gauges the fine-window settings.
+    conf.set("bulkWindowMaps",
+             os.environ.get("SPARKRDMA_BENCH_WINDOW_MAPS", "0"))
     conf.set("exchangeTileBytes", "16m")
 
     # staging pinned False to match bench_bulk_shuffle (like-for-like)

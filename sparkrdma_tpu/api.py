@@ -72,7 +72,7 @@ class TpuShuffleContext:
         else:
             if self.conf.read_plane == "collective":
                 # the opportunistic in-process coordinator is a test
-                # fixture now (parallel/collective_read.py): the
+                # fixture now (tests/collective_read_fixture.py): the
                 # windowed plane is reactive AND multi-process, so
                 # production configs route there (pass an explicit
                 # CollectiveNetwork as ``network=`` to use the fixture)
@@ -146,6 +146,22 @@ class TpuShuffleContext:
             )
             for ex in self.executors:
                 ex.windowed_plane = WindowedReadPlane(ex, session=session)
+            if self.conf.lazy_staging:
+                # the ODP analog on the production plane: host-lazy
+                # commits, with ensure_staged/prefetch_shuffle faulting
+                # them into a per-executor HBM arena under the original
+                # mkey (reference useOdp + prefetch advise,
+                # RdmaShuffleConf.scala:68-83,
+                # RdmaMappedFile.java:158-168)
+                from sparkrdma_tpu.memory.device_arena import DeviceArena
+
+                arena_devices = list(sess_mesh.devices.flat)
+                for i, ex in enumerate(self.executors):
+                    arena = DeviceArena(
+                        self.conf.device_arena_bytes, arena_devices[i]
+                    )
+                    ex.device_arena = arena
+                    ex.resolver.device_arena = arena
         self._pools = [
             ThreadPoolExecutor(
                 max_workers=tasks_per_executor,

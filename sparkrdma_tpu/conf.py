@@ -246,7 +246,7 @@ class TpuShuffleConf:
         "one-sided READ pull model" inversion), or ``bulk``
         (bulk-synchronous whole-shuffle exchange via BulkExchangeReader
         — shuffle/bulk.py).  ``collective`` (the in-process
-        opportunistic coordinator, parallel/collective_read.py) is a
+        opportunistic coordinator, tests/collective_read_fixture.py) is a
         test fixture superseded by ``windowed``."""
         return str(self.get("readPlane", "host")).lower()
 

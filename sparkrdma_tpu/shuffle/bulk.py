@@ -1,6 +1,6 @@
 """Bulk-synchronous collective shuffle: the multi-host data plane.
 
-The in-process collective plane (parallel/collective_read.py) batches
+The in-process collective plane (tests/collective_read_fixture.py) batches
 reader fetches into all_to_all rounds opportunistically; across HOSTS
 that requires every process to launch identical collectives, so this
 module runs the exchange bulk-synchronously instead — the natural mode
@@ -298,7 +298,7 @@ class WindowedReadPlane:
     window-by-window while straggler maps still write.
 
     This supersedes the in-process-only opportunistic coordinator
-    (parallel/collective_read.py, now a test fixture): cross-process
+    (tests/collective_read_fixture.py, now a test fixture): cross-process
     agreement on collective launches comes from the driver's window
     plans instead of per-process batching heuristics."""
 
@@ -357,14 +357,19 @@ class WindowedReadPlane:
 
     def _pump(self, shuffle_id: int, st: _ShuffleWindows) -> None:
         """One thread per (executor, shuffle): runs the windowed
-        exchanges in order and feeds received blocks to the readers."""
+        exchanges in order (next window's plan fetch overlapping the
+        current collective) and feeds received blocks to the readers."""
         try:
-            legacy = self.manager.conf.bulk_window_maps <= 0
-            w = 0
-            while True:
-                plan, E, row = self._bulk._exchange_rows(
-                    shuffle_id, window=(-1 if legacy else w)
+            if self.manager.conf.bulk_window_maps <= 0:
+                exchanges = iter(
+                    [self._bulk._exchange_rows(shuffle_id, window=-1)]
                 )
+            else:
+                exchanges = self._bulk._iter_windowed_exchanges(
+                    shuffle_id
+                )
+            legacy = self.manager.conf.bulk_window_maps <= 0
+            for plan, E, row in exchanges:
                 me = list(plan.hosts).index(self.manager.local_smid)
                 blocks = list(iter_plan_blocks(plan, E, row))
                 payload = sum(len(b) for _s, _m, _r, b in blocks)
@@ -372,7 +377,6 @@ class WindowedReadPlane:
                 st.deliver(blocks, final, plan.hosts, me, payload)
                 if final:
                     return
-                w += 1
         except BaseException as e:
             st.fail(e)
 
@@ -504,7 +508,13 @@ class BulkExchangeReader:
         self.window_events: List[tuple] = []
 
     # -- step 2: the plan barrier -------------------------------------------
-    def _fetch_plan(self, shuffle_id: int, window: int = -1):
+    def _fetch_plan_async(self, shuffle_id: int, window: int = -1):
+        """Issue the plan RPC WITHOUT blocking and return a one-shot
+        waiter object.  The windowed loops use this to overlap the
+        NEXT window's plan barrier (driver-side wait for its maps to
+        publish) with the CURRENT window's collective — the
+        maxBytesInFlight spirit applied to plans
+        (RdmaShuffleFetcherIterator.scala:241-251)."""
         mgr = self.manager
         event = threading.Event()
         box = {}
@@ -528,19 +538,42 @@ class BulkExchangeReader:
                     box.setdefault("error", str(e)), event.set()
                 ),
             )
-            timeout = mgr.conf.partition_location_fetch_timeout_ms / 1000.0
-            if not event.wait(timeout):
-                raise MetadataFetchFailedError(
-                    mgr.local_smid.host, shuffle_id,
-                    f"no exchange plan within {timeout:.0f}s",
-                )
-        finally:
+        except BaseException:
             mgr.unregister_plan_callback(cb_id)
-        if "error" in box:
-            raise MetadataFetchFailedError(
-                mgr.local_smid.host, shuffle_id, str(box["error"])
-            )
-        return box["plan"]
+            raise
+
+        class _PlanWaiter:
+            def wait(self):
+                timeout = (
+                    mgr.conf.partition_location_fetch_timeout_ms / 1000.0
+                )
+                try:
+                    if not event.wait(timeout):
+                        raise MetadataFetchFailedError(
+                            mgr.local_smid.host, shuffle_id,
+                            f"no exchange plan within {timeout:.0f}s",
+                        )
+                finally:
+                    mgr.unregister_plan_callback(cb_id)
+                if "error" in box:
+                    raise MetadataFetchFailedError(
+                        mgr.local_smid.host, shuffle_id, str(box["error"])
+                    )
+                return box["plan"]
+
+            def cancel(self):
+                mgr.unregister_plan_callback(cb_id)
+
+        return _PlanWaiter()
+
+    def _fetch_plan(self, shuffle_id: int, window: int = -1):
+        from sparkrdma_tpu.utils.trace import get_tracer
+
+        with get_tracer().span(
+            "shuffle.windowed.plan_wait", shuffle=shuffle_id,
+            window=window,
+        ):
+            return self._fetch_plan_async(shuffle_id, window).wait()
 
     def _run_exchange(self, shuffle_id: int, me: int, streams, lengths,
                       window: int = -1):
@@ -580,21 +613,56 @@ class BulkExchangeReader:
         if self.manager.conf.bulk_window_maps <= 0:
             return [self._exchange_rows(shuffle_id, window=-1)]
         out = []
-        w = 0
-        while True:
-            plan, E, row = self._exchange_rows(shuffle_id, window=w)
+        for plan, E, row in self._iter_windowed_exchanges(shuffle_id):
             out.append((plan, E, row))
+        return out
+
+    def _iter_windowed_exchanges(self, shuffle_id: int):
+        """Run each plan window's exchange in order, with the NEXT
+        window's plan fetch overlapping the current collective (the
+        plan barrier includes waiting for that window's maps to
+        publish — serializing it behind the exchange doubled the
+        per-window latency at fine window settings)."""
+        from sparkrdma_tpu.utils.trace import get_tracer
+
+        w = 0
+        waiter = self._fetch_plan_async(shuffle_id, window=0)
+        while True:
+            nxt = None
+            try:
+                with get_tracer().span(
+                    "shuffle.windowed.plan_wait", shuffle=shuffle_id,
+                    window=w,
+                ):
+                    plan = waiter.wait()
+                waiter = None
+                if not plan.final:
+                    nxt = self._fetch_plan_async(
+                        shuffle_id, window=w + 1
+                    )
+                result = self._exchange_rows(
+                    shuffle_id, window=w, plan=plan
+                )
+            except BaseException:
+                for pending in (waiter, nxt):
+                    if pending is not None:
+                        pending.cancel()
+                raise
+            yield result
             if plan.final:
-                return out
+                return
+            waiter = nxt
             w += 1
 
-    def _exchange_rows(self, shuffle_id: int, window: int = -1):
+    def _exchange_rows(self, shuffle_id: int, window: int = -1,
+                       plan=None):
         """Plan barrier + stream build + ONE collective exchange; all
         EAGER (a lazily-deferred exchange would leave every other
         participant blocked in the collective).  Returns (plan, E,
         row) where row[s] is the received stream from source s."""
         mgr = self.manager
-        plan = self._fetch_plan(shuffle_id, window=window)
+        if plan is None:
+            plan = self._fetch_plan(shuffle_id, window=window)
         hosts = list(plan.hosts)
         E = len(hosts)
         try:
@@ -614,32 +682,38 @@ class BulkExchangeReader:
         # needs every member) with all-empty source streams.  A
         # windowed plan names exactly which of my maps belong to THIS
         # window (the driver assigns maps to windows as fills land).
+        from sparkrdma_tpu.utils.trace import get_tracer
+
         if window >= 0:
             my_maps = sorted(plan.my_maps)
         else:
             my_maps = mgr.resolver.map_ids(shuffle_id)
         streams: List[List[bytes]] = [[b""] * E for _ in range(E)]
-        if my_maps:
-            num_parts = mgr.resolver.num_partitions(shuffle_id)
-            # one batched backing-store read per map output (every
-            # partition ships somewhere, so fetch each segment ONCE
-            # instead of a device round-trip per block), then deal the
-            # blocks out to their destination streams
-            parts_by_dst: List[List[bytes]] = [[] for _ in range(E)]
-            for map_id in my_maps:
-                blocks = mgr.resolver.get_local_blocks(
-                    shuffle_id, map_id, range(num_parts)
-                )
+        with get_tracer().span(
+            "shuffle.windowed.stream_build", shuffle=shuffle_id,
+            window=window, maps=len(my_maps),
+        ):
+            if my_maps:
+                num_parts = mgr.resolver.num_partitions(shuffle_id)
+                # one batched backing-store read per map output (every
+                # partition ships somewhere, so fetch each segment
+                # ONCE instead of a device round-trip per block), then
+                # deal the blocks out to their destination streams
+                parts_by_dst: List[List[bytes]] = [[] for _ in range(E)]
+                for map_id in my_maps:
+                    blocks = mgr.resolver.get_local_blocks(
+                        shuffle_id, map_id, range(num_parts)
+                    )
+                    for d in range(E):
+                        for r in range(d, num_parts, E):
+                            blk = blocks[r]
+                            if len(blk):
+                                parts_by_dst[d].append(
+                                    blk if isinstance(blk, bytes)
+                                    else bytes(blk)
+                                )
                 for d in range(E):
-                    for r in range(d, num_parts, E):
-                        blk = blocks[r]
-                        if len(blk):
-                            parts_by_dst[d].append(
-                                blk if isinstance(blk, bytes)
-                                else bytes(blk)
-                            )
-            for d in range(E):
-                streams[me][d] = b"".join(parts_by_dst[d])
+                    streams[me][d] = b"".join(parts_by_dst[d])
         for d in range(E):
             if len(streams[me][d]) != int(lengths[me, d]):
                 raise MetadataFetchFailedError(
@@ -648,8 +722,6 @@ class BulkExchangeReader:
                     f"{len(streams[me][d])}B, plan says "
                     f"{int(lengths[me, d])}B",
                 )
-
-        from sparkrdma_tpu.utils.trace import get_tracer
 
         with get_tracer().span(
             "shuffle.bulk.exchange", shuffle=shuffle_id, hosts=E,

@@ -8,6 +8,18 @@ process), a TCP control plane, uneven plan windows (8 maps, window of
 3 → 3/3/2), reducer-issued per-partition reads, and the straggler
 overlap — window 0's collective completes on every host while each
 process's second map is still unwritten.
+
+Phase 2 (induced executor loss, VERDICT r4 item 3): a second windowed
+shuffle is registered, process 3 SIGKILLs itself at a seeded random
+moment before any map is written, and every survivor's pending
+windowed reader must fail PROMPTLY with a stage-retriable error — the
+driver's heartbeat monitor prunes the dead executor over real TCP and
+the membership-epoch bump dooms the pending window-plan waiters
+(manager.py _membership_epoch).  On a real pod a dead host kills the
+mesh's collectives, so prompt stage failure (world relaunch, lineage
+retry) IS the contract — matching the reference, where a torn-down QP
+fails the fetch iterator into Spark's stage retry
+(RdmaShuffleFetcherIterator.scala:368-373).
 """
 import os
 import sys
@@ -25,6 +37,8 @@ N_PROCS = 4
 NUM_PARTS = 8
 NUM_MAPS = 8
 SHUFFLE = 73
+LOSS_SHUFFLE = 91
+VICTIM = 3
 
 
 def main() -> None:
@@ -53,6 +67,13 @@ def main() -> None:
         "spark.shuffle.tpu.connectTimeout": "10s",
         "spark.shuffle.tpu.bulkWindowMaps": "3",
         "spark.shuffle.tpu.readPlane": "windowed",
+        # phase 2 relies on the monitor pruning the SIGKILLed executor
+        # fast enough that "prompt stage failure" means seconds —
+        # but the timeout must ride out multi-second ack starvation
+        # while 4 processes share one core through XLA compiles and
+        # the Gloo rendezvous (200ms/1s falsely pruned ALL executors)
+        "spark.shuffle.tpu.heartbeatInterval": "500ms",
+        "spark.shuffle.tpu.heartbeatTimeout": "8s",
     })
     part = HashPartitioner(NUM_PARTS)
     driver = None
@@ -61,6 +82,7 @@ def main() -> None:
             conf, is_driver=True, network=TcpNetwork(), port=driver_port,
         )
         driver.register_shuffle(SHUFFLE, NUM_MAPS, part)
+        driver.register_shuffle(LOSS_SHUFFLE, NUM_MAPS, part)
 
     multihost.initialize(
         coordinator_address=f"127.0.0.1:{port}",
@@ -147,11 +169,67 @@ def main() -> None:
             f"{len(results.get(p, []))} != {len(expect)}"
         )
 
-    ex_mgr.stop()
-    if driver is not None:
-        driver.stop()
-
     print(f"proc {pid}: 4-process windowed plane OK", flush=True)
+
+    # ---- phase 2: induced executor loss ---------------------------------
+    import random
+    import signal
+
+    from sparkrdma_tpu.shuffle.reader import (
+        FetchFailedError,
+        MetadataFetchFailedError,
+    )
+
+    rng = random.Random(
+        int(os.environ.get("SPARKRDMA_TEST_CHAOS_SEED", "4091")) + pid
+    )
+    handle2 = ShuffleHandle(LOSS_SHUFFLE, NUM_MAPS, part)
+    if pid == VICTIM:
+        # die without goodbye at a seeded random moment — before any
+        # map of LOSS_SHUFFLE is written, so no window plan can strand
+        # a survivor inside a collective missing this (dead) member
+        time.sleep(rng.uniform(0.0, 0.5))
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    loss_errors = {}
+    loss_done = {}
+
+    def loss_reduce(p):
+        try:
+            r = ex_mgr.get_reader(handle2, p, p + 1, {})
+            loss_done[p] = list(r.read())
+        except (FetchFailedError, MetadataFetchFailedError) as e:
+            loss_errors[p] = e
+
+    lthreads = [
+        threading.Thread(target=loss_reduce, args=(p,), daemon=True)
+        for p in my_parts
+    ]
+    t0 = time.time()
+    for t in lthreads:
+        t.start()
+    for t in lthreads:
+        t.join(timeout=45)
+    assert not any(t.is_alive() for t in lthreads), (
+        f"proc {pid}: windowed reader HUNG after executor loss"
+    )
+    assert not loss_done, (
+        f"proc {pid}: reader returned data for a shuffle whose maps "
+        f"never ran: {loss_done}"
+    )
+    assert set(loss_errors) == set(my_parts), (
+        f"proc {pid}: missing stage-retriable failures: {loss_errors}"
+    )
+    elapsed = time.time() - t0
+    assert elapsed < 40, (
+        f"proc {pid}: loss failure took {elapsed:.1f}s — not prompt"
+    )
+    print(f"proc {pid}: windowed executor-loss fails prompt OK",
+          flush=True)
+    # the mesh lost a member: the jax distributed runtime cannot
+    # barrier at interpreter exit, so leave without atexit teardown
+    sys.stdout.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -5,7 +5,7 @@ Covers the write → publish → resolve → exchange(a2a) → read path the
 reference realizes as commit → publish → FetchMapStatus → scatter RDMA
 READ (RdmaShuffleFetcherIterator.scala:162-171, RdmaChannel.java:441-474)
 — here the fetches between mesh-attached executors execute as collective
-pack+all_to_all rounds (parallel/collective_read.py) with zero per-block
+pack+all_to_all rounds (tests/collective_read_fixture.py) with zero per-block
 host round-trips.
 """
 
@@ -15,7 +15,7 @@ import pytest
 from sparkrdma_tpu.api import TpuShuffleContext
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.memory.device_arena import WRITE_ALIGN, DeviceArena
-from sparkrdma_tpu.parallel.collective_read import CollectiveNetwork
+from collective_read_fixture import CollectiveNetwork
 from sparkrdma_tpu.parallel.mesh import make_mesh
 
 
@@ -208,7 +208,7 @@ def test_unattached_executor_falls_back_to_host(devices):
 def test_coordinator_stop_fails_pending(devices):
     """Pending (unflushed) fetches are failed on stop, like channel
     teardown failing outstanding listeners (RdmaChannel.java:788-869)."""
-    from sparkrdma_tpu.parallel.collective_read import ExchangeCoordinator
+    from collective_read_fixture import ExchangeCoordinator
     from sparkrdma_tpu.transport.channel import (
         FnCompletionListener,
         TransportError,
@@ -221,7 +221,7 @@ def test_coordinator_stop_fails_pending(devices):
     ok = []
 
     # drive stop() with a manually queued request
-    from sparkrdma_tpu.parallel.collective_read import _Request
+    from collective_read_fixture import _Request
 
     req = _Request(0, 1, [(0, 128)], FnCompletionListener(
         lambda r: ok.append(r), lambda e: failures.append(e)

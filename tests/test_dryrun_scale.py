@@ -1,0 +1,36 @@
+"""Pod-shaped virtual-mesh scale for the full dryrun (VERDICT r4 item 3).
+
+``dryrun_multichip`` exercises every data-plane program — terasort
+narrow+wide, wordcount, ring/Ulysses attention, TileExchange rounds,
+joins, aggregation, external sort, the windowed record plane, and the
+bulk session — over an n-device mesh.  The driver runs it at 8; this
+test runs it at 16 in a subprocess (fresh backend, so the forced
+device count takes), covering the regime where the plan matrices (E²
+lengths), window cutter, and tile rounds grow beyond the default mesh
+(reference full-mesh warm-up analog, RdmaShuffleManager.scala:70-118).
+
+Set ``SPARKRDMA_DRYRUN_DEVICES`` to override (e.g. 32 — verified green
+2026-07-31, see MULTICHIP_SCALE.json; ~6 min on the 1-core builder, so
+the in-suite default stays 16).
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_dryrun_multichip_16_devices():
+    n = int(os.environ.get("SPARKRDMA_DRYRUN_DEVICES", "16"))
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n}); "
+         f"print('DRYRUN{n} OK')"],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env=env, capture_output=True, text=True, timeout=1800,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"DRYRUN{n} OK" in proc.stdout, proc.stdout

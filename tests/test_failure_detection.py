@@ -71,6 +71,42 @@ def test_heartbeat_keeps_live_executors(cluster):
     assert len(driver.executors) == 3
 
 
+def test_foreign_shutdown_shaped_error_still_prunes(cluster):
+    """Shutdown-vs-failure discrimination must use explicit state, not
+    error text: a dead peer channel's pool raises the SAME RuntimeError
+    text as our own teardown ("cannot schedule new futures after
+    interpreter shutdown").  While the driver is healthy, that error
+    must prune the peer — and must NOT quiesce the monitor."""
+    net, conf, driver, executors = cluster
+    victim = executors[2]
+    err = RuntimeError(
+        "cannot schedule new futures after interpreter shutdown"
+    )
+    driver._on_executor_send_failure(victim.local_smid, err)
+    assert victim.local_smid not in driver.executors
+    assert not driver._hb_stop.is_set(), "monitor wrongly quiesced"
+    # the other two executors stay probed and alive
+    time.sleep(0.5)
+    assert len(driver.executors) == 2
+
+
+def test_own_node_shutdown_quiesces_instead_of_pruning(cluster):
+    """Once OUR node is stopping, a send failure is quiescence: no
+    prune, monitor stops.  (Explicit-flag classification — works for
+    any error text.)"""
+    net, conf, driver, executors = cluster
+    driver.node._stopped.set()
+    try:
+        driver._on_executor_send_failure(
+            executors[0].local_smid, OSError("socket closed")
+        )
+        assert executors[0].local_smid in driver.executors
+        assert driver._hb_stop.is_set()
+    finally:
+        driver.node._stopped.clear()
+        driver._hb_stop.clear()
+
+
 def test_dead_executor_pruned_automatically(cluster):
     net, conf, driver, executors = cluster
     victim = executors[2]

@@ -19,7 +19,12 @@ def _free_port() -> int:
 
 
 def _run_workers(worker_file: str, n_procs: int, timeout: int,
-                 ok_msg: str) -> None:
+                 ok_msg: str, sigkilled: dict = {}) -> None:
+    """``sigkilled`` maps a process id that SIGKILLs itself mid-run to
+    the ok-message it must have printed BEFORE dying (its exit code is
+    then -SIGKILL, not 0)."""
+    import signal
+
     worker = os.path.join(os.path.dirname(__file__), worker_file)
     port = _free_port()
     env = {
@@ -44,6 +49,13 @@ def _run_workers(worker_file: str, n_procs: int, timeout: int,
             pytest.fail(f"{worker_file} hung")
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
+        if pid in sigkilled:
+            assert p.returncode == -signal.SIGKILL, (
+                f"victim proc {pid} exited {p.returncode}, "
+                f"expected SIGKILL:\n{out}"
+            )
+            assert f"proc {pid}: {sigkilled[pid]}" in out, out
+            continue
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"proc {pid}: {ok_msg}" in out, out
 
@@ -56,8 +68,12 @@ def test_two_process_collectives():
 
 def test_four_process_windowed_plane():
     """The unified plane at 4 OS processes: uneven plan windows,
-    reducer-issued reads, straggler overlap — the NCCL/MPI-style
-    multi-host scaling story beyond the 2-process proof."""
+    reducer-issued reads, straggler overlap — then an INDUCED EXECUTOR
+    LOSS (process 3 SIGKILLs itself) whose pending windowed readers
+    must fail promptly on every survivor via heartbeat prune +
+    membership-epoch plan dooming over real TCP."""
     _run_workers(
-        "multihost4_worker.py", 4, 240, "4-process windowed plane OK"
+        "multihost4_worker.py", 4, 240,
+        "windowed executor-loss fails prompt OK",
+        sigkilled={3: "4-process windowed plane OK"},
     )
