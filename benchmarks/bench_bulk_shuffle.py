@@ -21,8 +21,10 @@ def main():
         ROCE_LINE_RATE_GBPS,
         canonical_record_workload,
         emit,
+        enable_metrics,
         ensure_multidevice,
         time_group_by_key,
+        write_bench_json,
     )
 
     ensure_multidevice(__file__)
@@ -36,6 +38,7 @@ def main():
     conf.set("serializer", "columnar")
     conf.set("readPlane", "bulk")
     conf.set("exchangeTileBytes", "16m")
+    enable_metrics(conf)
 
     # stage_to_device pinned False on BOTH compared planes (it is now
     # the windowed/bulk default too): their exchanges read blocks
@@ -54,6 +57,7 @@ def main():
         f"symmetric collective)",
         gbps, "GB/s", gbps / ROCE_LINE_RATE_GBPS,
     )
+    write_bench_json("bulk_shuffle")
 
 
 if __name__ == "__main__":

@@ -44,6 +44,9 @@ def main():
         f"{N_KEYS} keys)",
         rps / 1e6, "Mrecords/s", rps / 1e6,
     )
+    from benchmarks.common import write_bench_json
+
+    write_bench_json("reduce_loopback")
 
 
 if __name__ == "__main__":

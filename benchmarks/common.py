@@ -9,19 +9,30 @@ repo-root ``bench.py``:
 ceiling — the 100 GbE RoCE line rate of 12.5 GB/s that bounds
 SparkRDMA's shuffle throughput (reference README.md:7-19) — unless a
 benchmark states its own baseline.
+
+Every emitted record is also collected in-process so
+:func:`write_bench_json` can write a ``BENCH_<name>.json`` embedding
+the results TOGETHER with a metrics-registry snapshot
+(sparkrdma_tpu/metrics/) — a bench run carries its own transport /
+shuffle / memory counters for later attribution
+(``tools/metrics_report.py`` renders the embedded snapshot).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 
 # 100 GbE RoCE line rate, the reference's per-node data-plane ceiling (GB/s)
 ROCE_LINE_RATE_GBPS = 12.5
+
+# every emit() record of this process, in order
+RESULTS: list = []
 
 
 def fence(x) -> None:
@@ -50,12 +61,52 @@ def time_iters(run: Callable[[], object], iters: int, warmup: int = 2) -> float:
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
-    print(json.dumps({
+    rec = {
         "metric": metric,
         "value": round(float(value), 3),
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 3),
-    }), flush=True)
+    }
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def enable_metrics(conf) -> None:
+    """Turn the metrics registry on for a bench's TpuShuffleConf (and
+    the process-wide registry, so transport/memory instruments created
+    before the manager exist too)."""
+    from sparkrdma_tpu.metrics import get_registry
+
+    conf.set("metrics", True)
+    get_registry().enabled = True
+
+
+def metrics_snapshot() -> dict:
+    """Point-in-time snapshot of the process-wide metrics registry."""
+    from sparkrdma_tpu.metrics import get_registry
+
+    return get_registry().snapshot()
+
+
+def write_bench_json(name: str, extra: Optional[dict] = None,
+                     out_dir: Optional[str] = None) -> str:
+    """Write ``BENCH_<name>.json`` embedding every emitted result plus
+    the current metrics snapshot; returns the path."""
+    doc = {
+        "bench": name,
+        "results": list(RESULTS),
+        "metrics": metrics_snapshot(),
+    }
+    if extra:
+        doc.update(extra)
+    base = out_dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    path = os.path.join(base, f"BENCH_{name}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# wrote {path}", flush=True)
+    return path
 
 
 # -- spoofed-mesh scaffolding for multi-device record-plane benches ---------

@@ -157,6 +157,13 @@ class TpuShuffleContext:
 
                 arena_devices = list(sess_mesh.devices.flat)
                 for i, ex in enumerate(self.executors):
+                    if ex.device_arena is not None:
+                        # a CollectiveNetwork.attach_executor already
+                        # installed this executor's arena (possibly on
+                        # a different mesh) — overwriting it would
+                        # strand the coordinator's entry and force its
+                        # _resolve onto the host fallback forever
+                        continue
                     arena = DeviceArena(
                         self.conf.device_arena_bytes, arena_devices[i]
                     )

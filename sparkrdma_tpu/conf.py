@@ -318,6 +318,34 @@ class TpuShuffleConf:
 
     # -- observability ------------------------------------------------------
     @property
+    def metrics_enabled(self) -> bool:
+        """Enable the process-wide metrics registry (metrics/registry.py):
+        labeled counters/gauges/histograms across transport, shuffle and
+        memory.  Off by default — instrumented call sites then hold
+        zero-overhead no-op handles."""
+        return self._bool("metrics", False)
+
+    @property
+    def metrics_json_path(self) -> str:
+        """When set, manager.stop() writes a JSON snapshot of the
+        registry here (executors suffix ``.<executor_id>`` so
+        multi-process runs don't clobber each other)."""
+        return str(self.get("metricsJsonPath", ""))
+
+    @property
+    def metrics_prom_path(self) -> str:
+        """When set, manager.stop() writes a Prometheus text-exposition
+        dump here (same executor suffix rule as metricsJsonPath)."""
+        return str(self.get("metricsPromPath", ""))
+
+    @property
+    def metrics_trace_bridge(self) -> bool:
+        """When metrics AND tracing are both enabled, publish registry
+        counters into the Tracer.counter() stream (Perfetto counter
+        tracks) at shuffle unregister and manager stop."""
+        return self._bool("metricsTraceBridge", True)
+
+    @property
     def collect_shuffle_reader_stats(self) -> bool:
         return self._bool("collectShuffleReaderStats", False)
 

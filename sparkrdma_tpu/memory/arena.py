@@ -22,6 +22,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.transport.channel import BlockStore, TransportError
 from sparkrdma_tpu.utils.types import BlockLocation
 
@@ -211,6 +212,12 @@ class ArenaManager(BlockStore):
         # stats
         self._registered_ever = 0
         self._released_ever = 0
+        self._m_registered = counter("arena_segments_registered_total")
+        self._m_released = counter("arena_segments_released_total")
+        self._m_alloc_failed = counter("arena_alloc_failures_total")
+        # process-wide gauge shared by every ArenaManager: mutate by
+        # DELTA so in-process driver+executor arenas aggregate
+        self._m_bytes = gauge("arena_registered_bytes")
 
     def register(self, array, shuffle_id: Optional[int] = None,
                  keepalive=None, budgeted: bool = True,
@@ -234,6 +241,7 @@ class ArenaManager(BlockStore):
         with self._lock:
             if (budgeted and self.max_bytes
                     and self._total_bytes + nbytes > self.max_bytes):
+                self._m_alloc_failed.inc()
                 raise MemoryError(
                     f"arena budget exhausted: {self._total_bytes + nbytes}B > "
                     f"{self.max_bytes}B"
@@ -248,6 +256,8 @@ class ArenaManager(BlockStore):
             else:
                 self._file_bytes += nbytes
             self._registered_ever += 1
+        self._m_registered.inc()
+        self._m_bytes.inc(nbytes)
         return seg
 
     def register_arena_span(self, span, shuffle_id: Optional[int] = None
@@ -258,6 +268,7 @@ class ArenaManager(BlockStore):
         with self._lock:
             if (self.max_bytes
                     and self._total_bytes + span.nbytes > self.max_bytes):
+                self._m_alloc_failed.inc()
                 raise MemoryError(
                     f"arena budget exhausted: "
                     f"{self._total_bytes + span.nbytes}B > {self.max_bytes}B"
@@ -268,6 +279,8 @@ class ArenaManager(BlockStore):
             self._segments[mkey] = seg
             self._total_bytes += seg.nbytes
             self._registered_ever += 1
+        self._m_registered.inc()
+        self._m_bytes.inc(seg.nbytes)
         return seg
 
     def replace_with_span(self, mkey: int, span
@@ -285,6 +298,7 @@ class ArenaManager(BlockStore):
                 freed = old.nbytes if old.budgeted else 0
                 if (self.max_bytes and self._total_bytes - freed
                         + span.nbytes > self.max_bytes):
+                    self._m_alloc_failed.inc()
                     raise MemoryError(
                         f"arena budget exhausted staging mkey={mkey}: "
                         f"{self._total_bytes - freed + span.nbytes}B > "
@@ -301,6 +315,7 @@ class ArenaManager(BlockStore):
         if released is None:
             span.free()
             return None
+        self._m_bytes.inc(seg.nbytes - released.nbytes)
         released._release_keepalive()
         return seg
 
@@ -318,6 +333,8 @@ class ArenaManager(BlockStore):
                     self._file_bytes -= seg.nbytes
                 self._released_ever += 1
         if seg is not None:
+            self._m_released.inc()
+            self._m_bytes.dec(seg.nbytes)
             seg._release_keepalive()
 
     def release_shuffle(self, shuffle_id: int) -> int:
@@ -333,6 +350,9 @@ class ArenaManager(BlockStore):
                 else:
                     self._file_bytes -= seg.nbytes
                 self._released_ever += 1
+        if segs:
+            self._m_released.inc(len(segs))
+            self._m_bytes.dec(sum(s.nbytes for s in segs))
         for seg in segs:
             seg._release_keepalive()
         return len(segs)
@@ -389,5 +409,8 @@ class ArenaManager(BlockStore):
             self._segments.clear()
             self._total_bytes = 0
             self._file_bytes = 0
+        if segs:
+            self._m_released.inc(len(segs))
+            self._m_bytes.dec(sum(s.nbytes for s in segs))
         for seg in segs:
             seg._release_keepalive()

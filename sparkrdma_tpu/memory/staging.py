@@ -20,6 +20,8 @@ from typing import Dict
 
 import numpy as np
 
+from sparkrdma_tpu.metrics import counter
+
 logger = logging.getLogger(__name__)
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "_staging.so")
@@ -308,6 +310,15 @@ class StagingPool:
     def __init__(self, max_bytes: int = 0, force_python: bool = False):
         self.max_bytes = max_bytes
         self.is_native = _NATIVE is not None and not force_python
+        kind = "native" if self.is_native else "python"
+        self._m_allocs = counter("staging_allocs_total", pool=kind)
+        self._m_alloc_bytes = counter("staging_alloc_bytes_total", pool=kind)
+        self._m_failed = counter("staging_failed_allocs_total", pool=kind)
+        # hit = pooled block reused, miss = fresh memory; only the
+        # python pool sees its free lists (the native pool recycles
+        # internally), so hits/misses stay zero under the native pool
+        self._m_hits = counter("staging_hits_total", pool=kind)
+        self._m_misses = counter("staging_misses_total", pool=kind)
         # RLock: a cyclic-GC pass triggered INSIDE a locked region can
         # run an alloc_gc finalizer on the same thread, which takes
         # this lock again — re-entrant entry is safe (counter updates;
@@ -340,9 +351,12 @@ class StagingPool:
             raise ValueError(f"alloc size must be > 0: {size}")
         if self._closed:
             raise MemoryError("pool closed")
+        self._m_allocs.inc()
+        self._m_alloc_bytes.inc(size)
         if self.is_native:
             ptr = _NATIVE.staging_alloc(self._handle, ctypes.c_uint64(size))
             if not ptr:
+                self._m_failed.inc()
                 raise MemoryError(
                     f"staging pool budget exhausted allocating {size}B "
                     f"(budget {self.max_bytes}B)"
@@ -367,6 +381,8 @@ class StagingPool:
         accounting is adjusted (numpy owns the pages)."""
         if size <= 0:
             raise ValueError(f"alloc size must be > 0: {size}")
+        self._m_allocs.inc()
+        self._m_alloc_bytes.inc(size)
         if self.is_native:
             # closed-check, alloc, and the live-count publication happen
             # under ONE lock hold: close() destroys the native pool when
@@ -379,6 +395,7 @@ class StagingPool:
                     self._handle, ctypes.c_uint64(size)
                 )
                 if not ptr:
+                    self._m_failed.inc()
                     raise MemoryError(
                         f"staging pool budget exhausted allocating {size}B "
                         f"(budget {self.max_bytes}B)"
@@ -418,6 +435,7 @@ class StagingPool:
         if self._closed:
             raise MemoryError("pool closed")
         cls = self._round_class(size)
+        self._m_misses.inc()
         with self._lock:
             self._py_reserve(size, cls)
             self._owned += cls
@@ -523,6 +541,7 @@ class StagingPool:
             self._py_trim(0)
             if self._owned + cls > self.max_bytes:
                 self._failed += 1
+                self._m_failed.inc()
                 raise MemoryError(
                     f"staging pool budget exhausted allocating {size}B"
                 )
@@ -536,11 +555,14 @@ class StagingPool:
                 self._total_allocs += 1
                 self._last_use[cls] = self._tick
                 view = lst.pop()
+                hit = True
             else:
                 self._py_reserve(size, cls)
                 view = np.zeros(cls, dtype=np.uint8)
                 self._owned += cls
+                hit = False
             self._in_use += cls
+        (self._m_hits if hit else self._m_misses).inc()
         return StagingBuffer(self, view.ctypes.data, cls, view)
 
     def _py_free(self, buf: StagingBuffer) -> None:

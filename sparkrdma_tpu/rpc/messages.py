@@ -32,6 +32,7 @@ type  class                                  direction
  7    HeartbeatMsg                           driver ↔ executor
  8    FetchExchangePlanMsg                   executor → driver
  9    ExchangePlanMsg                        driver → executor
+ 10   PublishShuffleMetricsMsg               executor → driver
 ====  =====================================  ===========================
 
 Types 8-9 carry the BULK-SYNCHRONOUS collective shuffle plan: after the
@@ -533,6 +534,44 @@ class FetchExchangePlanMsg(RpcMsg):
 
 
 @dataclass(frozen=True)
+class PublishShuffleMetricsMsg(RpcMsg):
+    """Executor publishes one shuffle's telemetry snapshot (a flat
+    ``{metric name: number}`` dict, JSON-encoded) to the driver at
+    unregister time — riding the same control plane the map-output
+    location publishes use, so the driver can aggregate per-shuffle
+    write/read/fetch totals across hosts (metrics/ tentpole; no
+    reference analog — RdmaShuffleReaderStats stays executor-local)."""
+
+    shuffle_manager_id: ShuffleManagerId
+    shuffle_id: int
+    payload: bytes  # JSON {metric: number}
+
+    MSG_TYPE = 10
+
+    def _payload(self) -> bytes:
+        buf = bytearray()
+        self.shuffle_manager_id.write(buf)
+        buf += struct.pack("<ii", self.shuffle_id, len(self.payload))
+        buf += self.payload
+        return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return (
+            self.shuffle_manager_id.serialized_length()
+            + 8 + len(self.payload)
+        )
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "PublishShuffleMetricsMsg":
+        smid, off = ShuffleManagerId.read(view, 0)
+        shuffle_id, n = struct.unpack_from("<ii", view, off)
+        off += 8
+        return PublishShuffleMetricsMsg(
+            smid, shuffle_id, bytes(view[off : off + n])
+        )
+
+
+@dataclass(frozen=True)
 class ExchangePlanMsg(RpcMsg):
     """The driver's bulk-exchange plan: the canonical host order, the
     full (src × dst) stream-length matrix every host must agree on, and
@@ -638,5 +677,6 @@ MSG_TYPES: Dict[int, Type[RpcMsg]] = {
         HeartbeatMsg,
         FetchExchangePlanMsg,
         ExchangePlanMsg,
+        PublishShuffleMetricsMsg,
     )
 }

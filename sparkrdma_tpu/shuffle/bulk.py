@@ -38,11 +38,13 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.parallel.exchange import TileExchange
 from sparkrdma_tpu.rpc.messages import FetchExchangePlanMsg
 from sparkrdma_tpu.shuffle.reader import (
     FetchFailedError,
     MetadataFetchFailedError,
+    flush_read_metrics,
 )
 
 
@@ -254,6 +256,11 @@ class _ShuffleWindows:
             if final:
                 self._done = True
             self._cv.notify_all()
+        counter("shuffle_windows_total").inc()
+        counter("shuffle_window_payload_bytes_total").inc(payload_bytes)
+        # resident until the plane forgets the shuffle — the occupancy
+        # gauge tracks buffered windows across every active pump
+        gauge("shuffle_window_occupancy").inc()
 
     def fail(self, err: BaseException) -> None:
         with self._cv:
@@ -325,7 +332,11 @@ class WindowedReadPlane:
 
     def forget(self, shuffle_id: int) -> None:
         with self._lock:
-            self._shuffles.pop(shuffle_id, None)
+            st = self._shuffles.pop(shuffle_id, None)
+        if st is not None:
+            resident = len(st.window_events)
+            if resident:
+                gauge("shuffle_window_occupancy").dec(resident)
 
     def window_events(self, shuffle_id: int) -> List[tuple]:
         """(window, completion time, payload bytes) per landed window —
@@ -400,6 +411,17 @@ class WindowedShuffleReader:
         self.metrics = ReadMetrics()
 
     def _iter_block_bytes(self):
+        try:
+            yield from self._iter_block_bytes_inner()
+        finally:
+            # normal exhaustion, fetch failure AND abandoned iteration
+            # all flush exactly once
+            flush_read_metrics(
+                self.plane.manager, self.handle.shuffle_id,
+                self.metrics, self,
+            )
+
+    def _iter_block_bytes_inner(self):
         mgr = self.plane.manager
         st = self.plane._state(self.handle.shuffle_id)
         timeout_s = max(
@@ -622,14 +644,20 @@ class BulkExchangeReader:
         window's plan fetch overlapping the current collective (the
         plan barrier includes waiting for that window's maps to
         publish — serializing it behind the exchange doubled the
-        per-window latency at fine window settings)."""
+        per-window latency at fine window settings).
+
+        The whole loop — INCLUDING the yields — runs under one
+        try/finally: when the consumer abandons the generator
+        mid-iteration (GeneratorExit), or any step raises, the
+        prefetched next-window waiter is cancelled instead of leaking
+        its registered plan callback on the manager."""
         from sparkrdma_tpu.utils.trace import get_tracer
 
         w = 0
         waiter = self._fetch_plan_async(shuffle_id, window=0)
-        while True:
-            nxt = None
-            try:
+        nxt = None
+        try:
+            while True:
                 with get_tracer().span(
                     "shuffle.windowed.plan_wait", shuffle=shuffle_id,
                     window=w,
@@ -643,16 +671,21 @@ class BulkExchangeReader:
                 result = self._exchange_rows(
                     shuffle_id, window=w, plan=plan
                 )
-            except BaseException:
-                for pending in (waiter, nxt):
-                    if pending is not None:
-                        pending.cancel()
-                raise
-            yield result
-            if plan.final:
-                return
-            waiter = nxt
-            w += 1
+                waiter, nxt = nxt, None
+                yield result
+                if plan.final:
+                    return
+                w += 1
+        finally:
+            cancelled = 0
+            for pending in (waiter, nxt):
+                if pending is not None:
+                    pending.cancel()
+                    cancelled += 1
+            if cancelled:
+                counter(
+                    "shuffle_plan_waiters_cancelled_total"
+                ).inc(cancelled)
 
     def _exchange_rows(self, shuffle_id: int, window: int = -1,
                        plan=None):

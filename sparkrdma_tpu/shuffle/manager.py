@@ -30,6 +30,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.memory.arena import ArenaManager
 from sparkrdma_tpu.memory.staging import StagingPool
+from sparkrdma_tpu.metrics import (
+    get_registry,
+    write_json_snapshot,
+    write_prometheus,
+)
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
@@ -41,6 +46,7 @@ from sparkrdma_tpu.rpc.messages import (
     HeartbeatMsg,
     HelloMsg,
     PublishMapTaskOutputMsg,
+    PublishShuffleMetricsMsg,
     RpcMsg,
     decode_msg,
 )
@@ -68,6 +74,9 @@ logger = logging.getLogger(__name__)
 # sentinel: the exchange-plan barrier is not failed, just not ready
 # (e.g. a publisher's hello has not landed yet) — keep waiters queued
 _PLAN_WAIT = object()
+
+# driver keeps per-shuffle telemetry for this many recent shuffles
+_TELEMETRY_KEEP = 64
 
 
 @dataclass
@@ -213,6 +222,10 @@ class TpuShuffleManager:
         self.is_driver = is_driver
         self.network = network
         self.executor_id = executor_id
+        if conf.metrics_enabled:
+            # flip the process-wide registry on BEFORE any instrumented
+            # object (node, arena, pool, writer) fetches its handles
+            get_registry().enabled = True
         if serializer is not None:
             self.serializer = serializer
         else:
@@ -330,6 +343,13 @@ class TpuShuffleManager:
         self._next_callback_id = 1
         self._hello_sent = False
         self._stopped = False
+        # per-shuffle telemetry: local accumulators (writers/readers
+        # record in), published to the driver at unregister time the
+        # same way map-output locations flow; the driver keeps the last
+        # _TELEMETRY_KEEP shuffles' per-host snapshots
+        self._telemetry: Dict[int, Dict[str, float]] = {}
+        self._telemetry_lock = threading.Lock()
+        self._shuffle_telemetry: Dict[int, Dict[str, Dict[str, float]]] = {}
         # unified reactive device plane (readPlane=windowed): attached
         # by the job layer (shared in-process session) or lazily built
         # by get_reader (one exchange per process on a multi-host mesh)
@@ -417,6 +437,8 @@ class TpuShuffleManager:
             self._handle_fetch_plan(msg, channel)
         elif isinstance(msg, ExchangePlanMsg):
             self._handle_exchange_plan(msg)
+        elif isinstance(msg, PublishShuffleMetricsMsg):
+            self._handle_shuffle_metrics(msg)
 
     # -- heartbeat / failure detection ---------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -1288,7 +1310,115 @@ class TpuShuffleManager:
         else:
             self._send_msg(self._driver_channel(), msg)
 
+    # -- per-shuffle telemetry (metrics/ tentpole) ---------------------------
+    def record_shuffle_write(self, shuffle_id: int, wm) -> None:
+        """Writer commit hook: fold one map task's WriteMetrics into
+        the shuffle's telemetry accumulator (no-op unless conf
+        ``metrics`` is on — the default path stays untouched)."""
+        if not self.conf.metrics_enabled:
+            return
+        self._telemetry_add(
+            shuffle_id,
+            map_tasks=1,
+            write_bytes=wm.bytes_written,
+            write_records=wm.records_written,
+            spills=wm.spills,
+            spill_bytes=wm.bytes_spilled,
+            write_time_ms=wm.write_time_ms,
+        )
+
+    def record_shuffle_read(self, shuffle_id: int, rm) -> None:
+        """Reader completion hook: fold one reduce task's ReadMetrics
+        into the shuffle's telemetry accumulator."""
+        if not self.conf.metrics_enabled:
+            return
+        self._telemetry_add(
+            shuffle_id,
+            reduce_tasks=1,
+            local_blocks=rm.local_blocks,
+            remote_blocks=rm.remote_blocks,
+            local_bytes=rm.local_bytes,
+            remote_bytes=rm.remote_bytes,
+            records_read=rm.records_read,
+            fetch_wait_ms=rm.fetch_wait_ms,
+        )
+
+    def _telemetry_add(self, shuffle_id: int, **kv) -> None:
+        with self._telemetry_lock:
+            d = self._telemetry.setdefault(shuffle_id, {})
+            for k, v in kv.items():
+                d[k] = d.get(k, 0) + v
+
+    def _publish_shuffle_telemetry(self, shuffle_id: int) -> None:
+        """Ship this manager's accumulated per-shuffle telemetry to the
+        driver over the control plane — the same executor → driver flow
+        the map-output location publishes ride."""
+        with self._telemetry_lock:
+            snap = self._telemetry.pop(shuffle_id, None)
+        if not snap:
+            return
+        import json as _json
+
+        msg = PublishShuffleMetricsMsg(
+            self.local_smid, shuffle_id,
+            _json.dumps(snap).encode("utf-8"),
+        )
+        if self.is_driver:
+            self._handle_shuffle_metrics(msg)
+        else:
+            try:
+                self._send_msg(self._driver_channel(), msg)
+            except Exception:
+                logger.warning(
+                    "shuffle %d telemetry publish failed", shuffle_id,
+                    exc_info=True,
+                )
+
+    def _handle_shuffle_metrics(self, msg: PublishShuffleMetricsMsg) -> None:
+        import json as _json
+
+        try:
+            snap = _json.loads(bytes(msg.payload).decode("utf-8"))
+        except ValueError:
+            logger.warning("dropping malformed shuffle telemetry")
+            return
+        exec_id = msg.shuffle_manager_id.block_manager_id.executor_id
+        with self._telemetry_lock:
+            per_host = self._shuffle_telemetry.setdefault(
+                msg.shuffle_id, {}
+            )
+            mine = per_host.setdefault(exec_id, {})
+            for k, v in snap.items():
+                mine[k] = mine.get(k, 0) + v
+            while len(self._shuffle_telemetry) > _TELEMETRY_KEEP:
+                oldest = min(self._shuffle_telemetry)
+                del self._shuffle_telemetry[oldest]
+
+    def shuffle_telemetry(self, shuffle_id: int) -> Dict:
+        """Driver-side aggregated view of one shuffle's telemetry:
+        ``{"per_host": {executor_id: {...}}, "total": {...}}`` — the
+        per-shuffle snapshot the issue's observability layer exposes
+        next to the registry dump."""
+        with self._telemetry_lock:
+            per_host = {
+                h: dict(m)
+                for h, m in self._shuffle_telemetry.get(
+                    shuffle_id, {}
+                ).items()
+            }
+        total: Dict[str, float] = {}
+        for m in per_host.values():
+            for k, v in m.items():
+                total[k] = total.get(k, 0) + v
+        return {"per_host": per_host, "total": total}
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
+        self._publish_shuffle_telemetry(shuffle_id)
+        if (self.conf.metrics_enabled and self.conf.trace
+                and self.conf.metrics_trace_bridge):
+            # sample registry counters onto the Perfetto timeline at
+            # every shuffle boundary (counter tracks)
+            get_registry().publish_to_tracer(get_tracer())
         self.resolver.remove_shuffle(shuffle_id)
         if self.windowed_plane is not None:
             self.windowed_plane.forget(shuffle_id)
@@ -1393,6 +1523,27 @@ class TpuShuffleManager:
             if not t.is_alive():
                 self._hb_thread = None
 
+    def _dump_metrics(self) -> None:
+        """Stop-time registry exports: JSON snapshot and/or Prometheus
+        text when the conf paths are set (executors suffix their id so
+        multi-process runs don't clobber the driver's file), plus a
+        final bridge of counters into the trace stream."""
+        suffix = "" if self.is_driver else f".{self.executor_id}"
+        if self.conf.trace and self.conf.metrics_trace_bridge:
+            get_registry().publish_to_tracer(get_tracer())
+        path = self.conf.metrics_json_path
+        if path:
+            try:
+                write_json_snapshot(path + suffix)
+            except OSError:
+                logger.exception("metrics JSON dump to %s failed", path)
+        path = self.conf.metrics_prom_path
+        if path:
+            try:
+                write_prometheus(path + suffix)
+            except OSError:
+                logger.exception("metrics prom dump to %s failed", path)
+
     def stop(self) -> None:
         """Teardown (reference: RdmaShuffleManager.scala:348-357)."""
         if self._stopped:
@@ -1401,14 +1552,24 @@ class TpuShuffleManager:
         self.quiesce()
         if self.stats is not None:
             self.stats.print_stats()
+        if self.conf.metrics_enabled:
+            self._dump_metrics()
         if self.conf.trace:
             tracer = get_tracer()
-            try:
-                tracer.dump(self.conf.trace_path)
-            except OSError:
-                logger.exception("trace dump to %s failed", self.conf.trace_path)
-            tracer.enabled = False
-            tracer.clear()
+            # only the FIRST manager to stop dumps and clears: the
+            # tracer is process-global, so in-process clusters (driver
+            # + executors sharing one conf) would otherwise overwrite
+            # the dump with the cleared tracer's empty event list,
+            # losing every span and bridged counter
+            if tracer.enabled:
+                try:
+                    tracer.dump(self.conf.trace_path)
+                except OSError:
+                    logger.exception(
+                        "trace dump to %s failed", self.conf.trace_path
+                    )
+                tracer.enabled = False
+                tracer.clear()
         logger.info("staging pool at stop: %s", self.staging_pool.stats())
         if self._fetch_pool is not None:
             self._fetch_pool.shutdown(wait=False)

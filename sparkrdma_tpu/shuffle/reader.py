@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
+from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.transport.channel import ChannelType, FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
@@ -62,6 +63,25 @@ class ReadMetrics:
     remote_bytes: int = 0
     records_read: int = 0
     fetch_wait_ms: float = 0.0
+
+
+def flush_read_metrics(manager, shuffle_id: int, m: ReadMetrics,
+                       owner) -> None:
+    """Flush one reduce task's read metrics into the registry and the
+    manager's per-shuffle telemetry — at most once per reader (shared
+    by the pull and windowed readers; ``owner`` carries the guard)."""
+    if getattr(owner, "_metrics_flushed", False):
+        return
+    owner._metrics_flushed = True
+    counter("shuffle_read_bytes_total", source="local").inc(m.local_bytes)
+    counter("shuffle_read_bytes_total", source="remote").inc(m.remote_bytes)
+    counter("shuffle_blocks_read_total", source="local").inc(m.local_blocks)
+    counter("shuffle_blocks_read_total", source="remote").inc(
+        m.remote_blocks)
+    counter("shuffle_records_read_total").inc(m.records_read)
+    counter("shuffle_fetch_wait_ms_total").inc(int(m.fetch_wait_ms))
+    counter("shuffle_reduce_tasks_total").inc()
+    manager.record_shuffle_read(shuffle_id, m)
 
 
 @dataclass
@@ -110,6 +130,9 @@ class ShuffleReader:
         self._failed: Optional[FetchFailedError] = None
         self._timers: List[threading.Timer] = []
         self._callback_ids: List[int] = []
+        self._metrics_flushed = False
+        self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
+        self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
 
     # -- fetch machinery ----------------------------------------------------
     def _start_remote_fetches(self) -> Iterator[bytes]:
@@ -145,9 +168,11 @@ class ShuffleReader:
 
             def on_locations(locs, host=host, timer=timer, t0=t0):
                 timer.cancel()
+                rtt_ms = (time.monotonic() - t0) * 1000
+                self._m_rpc_rtt.observe(rtt_ms)
                 logger.debug(
                     "locations for %s resolved in %.1fms",
-                    host.host, (time.monotonic() - t0) * 1000,
+                    host.host, rtt_ms,
                 )
                 self._enqueue_fetches(host, locs)
 
@@ -270,6 +295,7 @@ class ShuffleReader:
                 self._bytes_in_flight -= fetch.total_bytes
             if self.manager.stats is not None:
                 self.manager.stats.update(fetch.host.host, latency)
+            self._m_fetch_latency.observe(latency)
             get_tracer().instant(
                 "shuffle.fetch.complete", host=fetch.host.host,
                 bytes=fetch.total_bytes, latency_ms=round(latency, 2),
@@ -350,6 +376,8 @@ class ShuffleReader:
             t.cancel()
         for cb_id in self._callback_ids:
             self.manager.unregister_fetch_callback(cb_id)
+        flush_read_metrics(self.manager, self.handle.shuffle_id,
+                           self.metrics, self)
 
     def _read_columnar(self) -> Iterator[Record]:
         """Columnar read: blocks deserialize to column batches and the

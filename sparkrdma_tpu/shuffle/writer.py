@@ -20,6 +20,7 @@ from typing import Any, Iterable, List, Optional, Tuple
 import numpy as np
 
 from sparkrdma_tpu.memory.staging import native_hash_partition_order
+from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.shuffle.partitioner import (
     HashPartitioner,
@@ -490,9 +491,24 @@ class ShuffleWriter:
                 "shuffle.write.commit",
                 shuffle=self.handle.shuffle_id, map=self.map_id,
             ):
-                return self._commit()
+                mto = self._commit()
+            self._record_metrics()
+            return mto
         finally:
             self._close_spill()
+
+    def _record_metrics(self) -> None:
+        """Flush this map task's write metrics into the registry and
+        the manager's per-shuffle telemetry (aggregated at the driver
+        alongside the map-output locations)."""
+        m = self.metrics
+        counter("shuffle_map_tasks_total").inc()
+        counter("shuffle_write_bytes_total").inc(m.bytes_written)
+        counter("shuffle_write_records_total").inc(m.records_written)
+        if m.spills:
+            counter("shuffle_spills_total").inc(m.spills)
+            counter("shuffle_spill_bytes_total").inc(m.bytes_spilled)
+        self.manager.record_shuffle_write(self.handle.shuffle_id, m)
 
     def _commit(self) -> MapTaskOutput:
         t0 = time.monotonic()

@@ -5,39 +5,59 @@ per-remote-host and global fixed-bucket latency histograms, printed at
 manager stop.  Bucket geometry from conf
 (fetchTimeBucketSizeInMs × fetchTimeNumBuckets; last bucket is
 open-ended).
+
+The bespoke histogram storage is retired onto the metrics registry
+(metrics/registry.py): each per-host histogram IS a registry
+``shuffle_fetch_latency_ms`` instrument (created with ``force=True``,
+since these stats have their own conf gate), so fetch latencies appear
+in Prometheus/JSON snapshots; this module keeps only the
+print-at-stop FORMAT as a view over those instruments.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
 from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import Histogram, get_registry
 
 logger = logging.getLogger(__name__)
 
 
 class FetchHistogram:
-    def __init__(self, bucket_ms: int, num_buckets: int):
+    """Fixed linear-bucket view over a registry histogram.
+
+    Bucket ``i`` covers ``[i*bucket_ms, (i+1)*bucket_ms)`` — a sample
+    exactly on an edge lands in the upper bucket (the reference's
+    ``latency // bucket_ms`` placement) — with the last bucket
+    open-ended.  ``hist`` may be a shared registry instrument; when
+    omitted a standalone one is created (tests)."""
+
+    def __init__(self, bucket_ms: int, num_buckets: int,
+                 hist: Optional[Histogram] = None):
         self.bucket_ms = bucket_ms
         self.num_buckets = num_buckets
-        self._counts = [0] * num_buckets
-        self._lock = threading.Lock()
+        edges = [float(bucket_ms * (i + 1)) for i in range(num_buckets - 1)]
+        if hist is None:
+            hist = Histogram("shuffle_fetch_latency_ms", edges=edges)
+        elif list(hist.edges) != edges:
+            raise ValueError(
+                f"histogram edges {hist.edges} do not match bucket "
+                f"geometry {bucket_ms}ms x {num_buckets}"
+            )
+        self._hist = hist
 
     def add_sample(self, latency_ms: float) -> None:
-        idx = min(int(latency_ms // self.bucket_ms), self.num_buckets - 1)
-        with self._lock:
-            self._counts[idx] += 1
+        self._hist.observe(latency_ms)
 
     @property
     def total(self) -> int:
-        with self._lock:
-            return sum(self._counts)
+        return self._hist.count
 
     def to_string(self) -> str:
-        with self._lock:
-            counts = list(self._counts)
+        counts = self._hist.counts
         parts = []
         for i, c in enumerate(counts):
             lo = i * self.bucket_ms
@@ -55,17 +75,32 @@ class ShuffleReaderStats:
         self.conf = conf
         self._bucket_ms = conf.fetch_time_bucket_size_ms
         self._num_buckets = conf.fetch_time_num_buckets
-        self._global = FetchHistogram(self._bucket_ms, self._num_buckets)
+        self._global = self._make("all")
         self._per_host: Dict[str, FetchHistogram] = {}
         self._lock = threading.Lock()
+
+    def _make(self, host: str) -> FetchHistogram:
+        edges = [
+            float(self._bucket_ms * (i + 1))
+            for i in range(self._num_buckets - 1)
+        ]
+        # geometry rides in the labels: instruments are process-global,
+        # and a registry lookup only applies ``edges`` on FIRST
+        # creation — without the geometry key, a second manager with a
+        # different fetchTime bucket conf in the same process would get
+        # the old instrument back and fail FetchHistogram's edge check
+        inst = get_registry().histogram(
+            "shuffle_fetch_latency_ms", edges=edges, force=True,
+            host=host, bucket_ms=self._bucket_ms,
+            buckets=self._num_buckets,
+        )
+        return FetchHistogram(self._bucket_ms, self._num_buckets, hist=inst)
 
     def update(self, host: str, latency_ms: float) -> None:
         with self._lock:
             hist = self._per_host.get(host)
             if hist is None:
-                hist = self._per_host.setdefault(
-                    host, FetchHistogram(self._bucket_ms, self._num_buckets)
-                )
+                hist = self._per_host.setdefault(host, self._make(host))
         hist.add_sample(latency_ms)
         self._global.add_sample(latency_ms)
 

@@ -28,6 +28,7 @@ import threading
 from collections import deque
 from typing import Callable, List, Sequence
 
+from sparkrdma_tpu.metrics import gauge
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -96,6 +97,8 @@ class Channel:
         self._pending_lock = threading.Lock()
         self._outstanding: set = set()  # listeners awaiting completion
         self._outstanding_lock = threading.Lock()
+        # active-channel gauge handle, held between CONNECTED and stop()
+        self._m_active_gauge = None
 
     # -- state machine ------------------------------------------------------
     @property
@@ -109,7 +112,13 @@ class Channel:
         with self._state_lock:
             if self._state in (ChannelState.ERROR, ChannelState.STOPPED):
                 return  # sticky terminal states
-            self._state = new
+            prev, self._state = self._state, new
+        if (new == ChannelState.CONNECTED
+                and prev != ChannelState.CONNECTED
+                and self._m_active_gauge is None):
+            g = gauge("transport_active_channels")
+            g.inc()
+            self._m_active_gauge = g
 
     def _check_usable(self) -> None:
         if self._state != ChannelState.CONNECTED:
@@ -141,6 +150,9 @@ class Channel:
             if self._state == ChannelState.STOPPED:
                 return
             self._state = ChannelState.STOPPED
+        g, self._m_active_gauge = self._m_active_gauge, None
+        if g is not None:
+            g.dec()
         err = TransportError("channel stopped")
         with self._pending_lock:
             pending = list(self._pending)

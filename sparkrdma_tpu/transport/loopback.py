@@ -20,8 +20,10 @@ same failure semantics the reference gets from CQ error completions.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
+from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
     ChannelState,
@@ -69,6 +71,14 @@ class LoopbackChannel(Channel):
         self._credit_waiting: List = []  # (frames, listener) blocked on credits
         self._consumed_since_report = 0
         self._report_threshold = max(1, conf.recv_queue_depth // 2)
+        self._m_bytes_sent = counter(
+            "transport_bytes_sent_total", transport="loopback")
+        self._m_bytes_recv = counter(
+            "transport_bytes_received_total", transport="loopback")
+        self._m_msgs_sent = counter(
+            "transport_msgs_sent_total", transport="loopback")
+        self._m_read_rtt = histogram(
+            "transport_read_rtt_ms", transport="loopback")
 
     # -- credit machinery (transport-internal, like WRITE_WITH_IMM) ---------
     def _on_credit_report(self, n: int) -> None:
@@ -162,11 +172,18 @@ class LoopbackChannel(Channel):
             self._release_budget()
             return False
         else:
+            self._m_msgs_sent.inc(len(frames))
+            self._m_bytes_sent.inc(sum(len(f) for f in frames))
             self._complete(listener, None)
             self._release_budget()
             return True
 
     def _post_read(self, locations, listener: CompletionListener) -> None:
+        # clock starts at POST time (like TcpChannel stamping t0 in
+        # _post_read): the dispatcher-queue wait is part of the RTT, so
+        # the tcp/loopback series stay comparable under load
+        t0 = time.monotonic()
+
         def deliver():
             try:
                 if self.network.is_partitioned(self.local.address, self.remote.address):
@@ -182,6 +199,8 @@ class LoopbackChannel(Channel):
                 self._error(e)
                 self._fail(listener, e)
             else:
+                self._m_read_rtt.observe((time.monotonic() - t0) * 1000.0)
+                self._m_bytes_recv.inc(sum(len(b) for b in data))
                 self._complete(listener, data)
             finally:
                 self._release_budget()
@@ -256,10 +275,19 @@ class LoopbackNetwork:
         """CM-handshake analog: create the channel pair, register the
         passive side with the acceptor (RdmaNode CM listener accepting
         CONNECT_REQUEST, RdmaNode.java:114-214)."""
+        counter(
+            "transport_connect_attempts_total", transport="loopback"
+        ).inc()
         dst = self.lookup(peer)
         if dst is None:
+            counter(
+                "transport_connect_failures_total", transport="loopback"
+            ).inc()
             raise TransportError(f"connection refused: no node at {peer}")
         if self.is_partitioned(src.address, peer):
+            counter(
+                "transport_connect_failures_total", transport="loopback"
+            ).inc()
             raise TransportError(f"network partition to {peer}")
         depth = src.conf.send_queue_depth
         fwd = LoopbackChannel(channel_type, src, dst, self, depth)

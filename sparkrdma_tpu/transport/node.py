@@ -27,6 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.transport.channel import (
     BlockStore,
     Channel,
@@ -202,6 +203,8 @@ class Node:
         key = (peer, channel_type)
         while attempts < max_attempts and not self._stopped.is_set():
             attempts += 1
+            if attempts > 1:
+                counter("transport_connect_retries_total").inc()
             with self._active_lock:
                 ch = self._active.get(key)
             if ch is not None and ch.is_connected():
@@ -226,7 +229,12 @@ class Node:
             with self._active_lock:
                 if self._active.get(key) is winner:
                     del self._active[key]
+            # stop the dead winner: nothing else references it, and
+            # skipping teardown would leak its outstanding listeners
+            # and the active-channel gauge increment
+            winner.stop()
             last_err = TransportError("channel died immediately after connect")
+        counter("transport_connect_exhausted_total").inc()
         raise TransportError(
             f"{self}: could not connect to {peer} ({channel_type.name}) "
             f"after {attempts} attempts"

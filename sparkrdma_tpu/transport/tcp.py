@@ -34,8 +34,10 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
+from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
     ChannelState,
@@ -94,9 +96,22 @@ class TcpChannel(Channel):
         self._sock = sock
         self._send_lock = threading.Lock()
         self._next_req = 1
-        self._reads: Dict[int, Tuple[int, CompletionListener]] = {}
+        # req_id -> (location count, listener, post monotonic time)
+        self._reads: Dict[int, Tuple[int, CompletionListener, float]] = {}
         self._reads_lock = threading.Lock()
         self._reader: Optional[threading.Thread] = None
+        self._m_bytes_sent = counter(
+            "transport_bytes_sent_total", transport="tcp")
+        self._m_bytes_recv = counter(
+            "transport_bytes_received_total", transport="tcp")
+        self._m_msgs_sent = counter(
+            "transport_msgs_sent_total", transport="tcp")
+        self._m_msgs_recv = counter(
+            "transport_msgs_received_total", transport="tcp")
+        self._m_read_rtt = histogram(
+            "transport_read_rtt_ms", transport="tcp")
+        self._m_fail_outstanding = counter(
+            "transport_fail_outstanding_total", transport="tcp")
 
     # -- lifecycle ----------------------------------------------------------
     def start_reader(self) -> None:
@@ -119,7 +134,7 @@ class TcpChannel(Channel):
         with self._reads_lock:
             reads = list(self._reads.values())
             self._reads.clear()
-        for _, listener in reads:
+        for _, listener, _t0 in reads:
             self._safe_fail(listener, err)
         super().stop()
 
@@ -127,6 +142,8 @@ class TcpChannel(Channel):
     def _send_msg(self, opcode: int, payload: bytes) -> None:
         with self._send_lock:
             self._sock.sendall(_HDR.pack(opcode, len(payload)) + payload)
+        self._m_msgs_sent.inc()
+        self._m_bytes_sent.inc(_HDR.size + len(payload))
 
     def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:
         def run():
@@ -148,7 +165,7 @@ class TcpChannel(Channel):
         with self._reads_lock:
             req_id = self._next_req
             self._next_req += 1
-            self._reads[req_id] = (len(locations), listener)
+            self._reads[req_id] = (len(locations), listener, time.monotonic())
         payload = bytearray(_REQ_HDR.pack(req_id, len(locations)))
         for loc in locations:
             payload += _LOC.pack(loc.address, loc.length, loc.mkey)
@@ -173,6 +190,8 @@ class TcpChannel(Channel):
                 opcode, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
                 if length > _MAX_FRAME:
                     raise TransportError(f"oversized frame: {length}B")
+                self._m_msgs_recv.inc()
+                self._m_bytes_recv.inc(_HDR.size + length)
                 if opcode == OP_READ_RESP:
                     # bulk data lands in a POOLED buffer; blocks are
                     # zero-copy slices whose collection returns it
@@ -223,7 +242,8 @@ class TcpChannel(Channel):
         with self._reads_lock:
             reads = list(self._reads.values())
             self._reads.clear()
-        for _, listener in reads:
+        self._m_fail_outstanding.inc()
+        for _, listener, _t0 in reads:
             self._fail(listener, err)
             self._release_budget()
 
@@ -263,7 +283,8 @@ class TcpChannel(Channel):
             entry = self._reads.pop(req_id, None)
         if entry is None:
             return  # raced with teardown
-        count, listener = entry
+        count, listener, t0 = entry
+        self._m_read_rtt.observe((time.monotonic() - t0) * 1000.0)
         try:
             if status != 0:
                 raise TransportError(
@@ -359,6 +380,7 @@ class TcpNetwork:
     def connect(self, src: Node, peer: Address,
                 channel_type: ChannelType) -> Channel:
         timeout_s = src.conf.connect_timeout_ms / 1000.0
+        counter("transport_connect_attempts_total", transport="tcp").inc()
         try:
             sock = socket.create_connection(peer, timeout=timeout_s)
             sock.settimeout(timeout_s)
@@ -371,7 +393,15 @@ class TcpNetwork:
                 raise TransportError(f"handshake rejected by {peer}")
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except socket.timeout as e:
+            counter(
+                "transport_connect_timeouts_total", transport="tcp"
+            ).inc()
+            raise TransportError(f"connect to {peer} timed out: {e}") from e
         except OSError as e:
+            counter(
+                "transport_connect_failures_total", transport="tcp"
+            ).inc()
             raise TransportError(f"connect to {peer} failed: {e}") from e
         ch = TcpChannel(channel_type, src, peer, sock)
         ch._set_state(ChannelState.CONNECTED)

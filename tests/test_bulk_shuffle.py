@@ -1301,3 +1301,81 @@ def test_windowed_chaos_random_loss(devices):
             net.heal(victim.node.address)
             for m in executors + [driver]:
                 m.stop()
+
+
+def test_windowed_generator_close_cancels_prefetched_waiter():
+    """ADVICE round-5 fix: abandoning _iter_windowed_exchanges after a
+    yield (GeneratorExit) must cancel the PREFETCHED next-window plan
+    waiter instead of leaking its registered callback (and count the
+    cancellation when the registry is enabled)."""
+    from types import SimpleNamespace
+
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+    from sparkrdma_tpu.shuffle.bulk import BulkExchangeReader
+
+    cancelled = []
+
+    class Waiter:
+        def __init__(self, window):
+            self.window = window
+
+        def wait(self):
+            return SimpleNamespace(final=False, window=self.window)
+
+        def cancel(self):
+            cancelled.append(self.window)
+
+    reader = BulkExchangeReader.__new__(BulkExchangeReader)
+    reader._fetch_plan_async = lambda sid, window: Waiter(window)
+    reader._exchange_rows = (
+        lambda sid, window, plan: (plan, 2, [b"", b""])
+    )
+
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.reset()
+    GLOBAL_REGISTRY.enabled = True
+    try:
+        gen = reader._iter_windowed_exchanges(0)
+        plan, _e, _row = next(gen)
+        assert plan.window == 0
+        # window 1's waiter is in flight; abandoning the generator
+        # here must cancel it
+        gen.close()
+        assert cancelled == [1]
+        snap = GLOBAL_REGISTRY.snapshot()
+        vals = {c["name"]: c["value"] for c in snap["counters"]}
+        assert vals.get(
+            "shuffle_plan_waiters_cancelled_total") == 1
+    finally:
+        GLOBAL_REGISTRY.enabled = prev
+        GLOBAL_REGISTRY.reset()
+
+
+def test_windowed_generator_wait_failure_cancels_inflight_waiter():
+    """An error inside the plan wait must also cancel whatever waiter
+    is still in flight before the generator frame unwinds."""
+    from sparkrdma_tpu.shuffle.bulk import BulkExchangeReader
+
+    cancelled = []
+
+    class FailingWaiter:
+        def __init__(self, window):
+            self.window = window
+
+        def wait(self):
+            raise RuntimeError("driver gone")
+
+        def cancel(self):
+            cancelled.append(self.window)
+
+    reader = BulkExchangeReader.__new__(BulkExchangeReader)
+    reader._fetch_plan_async = (
+        lambda sid, window: FailingWaiter(window)
+    )
+    gen = reader._iter_windowed_exchanges(0)
+    try:
+        next(gen)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+    assert cancelled == [0]

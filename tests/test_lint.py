@@ -1,0 +1,68 @@
+"""Tier-1 wrapper for the style gate (tools/lint.py) + unit coverage
+for the PY08 rule (no ``time.perf_counter()`` in library code outside
+metrics/ and utils/trace.py — metric timing flows through the
+registry)."""
+
+import importlib.util
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_lint", REPO / "tools" / "lint.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_is_lint_clean():
+    lint = _load_lint()
+    findings = []
+    for f in lint.py_files():
+        lint.lint_python(f, findings)
+    for f in lint.cc_files():
+        lint.lint_cpp(f, findings)
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {code} {msg}" for rel, line, code, msg in findings
+    )
+
+
+def test_py08_flags_perf_counter_in_library_code(tmp_path):
+    lint = _load_lint()
+    lib = tmp_path / "sparkrdma_tpu"
+    (lib / "metrics").mkdir(parents=True)
+    (lib / "utils").mkdir()
+
+    bad_attr = lib / "hot.py"
+    bad_attr.write_text("import time\nT0 = time.perf_counter()\n")
+    bad_name = lib / "hot2.py"
+    bad_name.write_text(
+        "from time import perf_counter\nT0 = perf_counter()\n"
+    )
+    ok_metrics = lib / "metrics" / "registry.py"
+    ok_metrics.write_text("import time\nT0 = time.perf_counter()\n")
+    ok_trace = lib / "utils" / "trace.py"
+    ok_trace.write_text("import time\nT0 = time.perf_counter()\n")
+
+    findings = []
+    for f in (bad_attr, bad_name, ok_metrics, ok_trace):
+        lint.lint_python(f, findings, root=tmp_path)
+    py08 = [str(rel) for rel, _l, code, _m in findings if code == "PY08"]
+    assert sorted(py08) == [
+        "sparkrdma_tpu/hot.py", "sparkrdma_tpu/hot2.py",
+    ], findings
+    # nothing else should fire on these files
+    assert all(code == "PY08" for _r, _l, code, _m in findings), findings
+
+
+def test_py08_ignores_non_library_code(tmp_path):
+    lint = _load_lint()
+    (tmp_path / "benchmarks").mkdir()
+    bench = tmp_path / "benchmarks" / "b.py"
+    bench.write_text("import time\nT0 = time.perf_counter()\n")
+    findings = []
+    lint.lint_python(bench, findings, root=tmp_path)
+    assert not [f for f in findings if f[2] == "PY08"], findings

@@ -31,8 +31,6 @@ import threading
 import time
 from collections import defaultdict
 
-import pytest
-
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
 from sparkrdma_tpu.shuffle.partitioner import HashPartitioner

@@ -1,0 +1,75 @@
+"""utils/trace.py bounded-append behavior + FetchHistogram bucket-edge
+sample placement (ISSUE 1 satellite coverage)."""
+
+import json
+
+from sparkrdma_tpu.stats import FetchHistogram
+from sparkrdma_tpu.utils.trace import Tracer
+
+
+def test_tracer_bounded_append_sets_dropped(tmp_path):
+    tr = Tracer(enabled=True, max_events=5)
+    for i in range(8):
+        tr.instant(f"e{i}")
+    assert len(tr.events) == 5
+    assert tr.dropped == 3
+    path = tmp_path / "trace.json"
+    tr.dump(str(path))
+    doc = json.loads(path.read_text())
+    assert doc["metadata"]["dropped_events"] == 3
+    assert len(doc["traceEvents"]) == 5
+    assert [e["name"] for e in doc["traceEvents"]] == [
+        f"e{i}" for i in range(5)
+    ]
+
+
+def test_tracer_bound_applies_to_every_event_kind(tmp_path):
+    tr = Tracer(enabled=True, max_events=2)
+    with tr.span("s0"):
+        pass
+    tr.counter("c0", value=1)
+    with tr.span("s1"):  # third event: dropped, counted
+        pass
+    tr.instant("i0")     # fourth: dropped, counted
+    assert len(tr.events) == 2
+    assert tr.dropped == 2
+    tr.dump(str(tmp_path / "t.json"))
+    doc = json.loads((tmp_path / "t.json").read_text())
+    assert doc["metadata"]["dropped_events"] == 2
+
+
+def test_tracer_disabled_records_nothing():
+    tr = Tracer(enabled=False, max_events=2)
+    with tr.span("s"):
+        pass
+    tr.instant("i")
+    tr.counter("c", value=3)
+    assert tr.events == []
+    assert tr.dropped == 0
+
+
+def test_fetch_histogram_bucket_edges():
+    """A sample exactly on a bucket edge lands in the UPPER bucket
+    (the reference's ``latency // bucket_ms`` placement); the last
+    bucket is open-ended."""
+    fh = FetchHistogram(bucket_ms=300, num_buckets=5)
+    fh.add_sample(0)         # [0-300)
+    fh.add_sample(299.999)   # [0-300)
+    fh.add_sample(300)       # edge -> [300-600)
+    fh.add_sample(599.999)   # [300-600)
+    fh.add_sample(600)       # edge -> [600-900)
+    fh.add_sample(1200)      # edge of the open-ended last bucket
+    fh.add_sample(10**9)     # far overflow -> last bucket
+    assert fh.total == 7
+    assert fh.to_string() == (
+        "[0-300ms]: 2, [300-600ms]: 2, [600-900ms]: 1, "
+        "[900-1200ms]: 0, [1200ms+]: 2"
+    )
+
+
+def test_fetch_histogram_single_bucket_ms():
+    fh = FetchHistogram(bucket_ms=1, num_buckets=3)
+    for v in (0.0, 0.5, 1.0, 1.5, 2.0, 99.0):
+        fh.add_sample(v)
+    # 0,0.5 -> [0-1); 1,1.5 -> [1-2); 2,99 -> [2ms+]
+    assert fh.to_string() == "[0-1ms]: 2, [1-2ms]: 2, [2ms+]: 2"

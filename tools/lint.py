@@ -17,6 +17,11 @@ Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
         mean everything)
   PY07  ``print(`` in library code (sparkrdma_tpu/ only; benches, tests
         and tools print by design)
+  PY08  ``time.perf_counter()`` in library code outside
+        sparkrdma_tpu/metrics/ and sparkrdma_tpu/utils/trace.py —
+        metric timing must flow through the registry/tracer (use
+        ``Histogram.time()`` or ``time.monotonic()`` for plain
+        interval math)
 
 C++ (native/):
   CC01  line longer than 100 characters
@@ -77,8 +82,28 @@ class _ImportUsage(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_python(path: pathlib.Path, findings: list) -> None:
-    rel = path.relative_to(ROOT)
+def _perf_counter_exempt(path: pathlib.Path, lib_dir: pathlib.Path) -> bool:
+    """PY08 applies to library code only; the registry (metrics/) and
+    the tracer (utils/trace.py) are the sanctioned timing sources."""
+    if lib_dir not in path.parents:
+        return True
+    if lib_dir / "metrics" in path.parents:
+        return True
+    return path == lib_dir / "utils" / "trace.py"
+
+
+def _is_perf_counter_call(node: ast.Call) -> bool:
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr == "perf_counter"
+            and isinstance(f.value, ast.Name) and f.value.id == "time"):
+        return True
+    return isinstance(f, ast.Name) and f.id == "perf_counter"
+
+
+def lint_python(path: pathlib.Path, findings: list,
+                root: pathlib.Path = ROOT) -> None:
+    lib_dir = root / "sparkrdma_tpu"
+    rel = path.relative_to(root)
     try:
         text = path.read_text()
     except UnicodeDecodeError as e:
@@ -130,7 +155,7 @@ def lint_python(path: pathlib.Path, findings: list) -> None:
                  "bare except: (name the exception type)")
             )
         if (
-            LIB_DIR in path.parents
+            lib_dir in path.parents
             and isinstance(node, ast.Call)
             and isinstance(node.func, ast.Name)
             and node.func.id == "print"
@@ -138,6 +163,16 @@ def lint_python(path: pathlib.Path, findings: list) -> None:
             findings.append(
                 (rel, node.lineno, "PY07",
                  "print() in library code (use logging)")
+            )
+        if (
+            isinstance(node, ast.Call)
+            and _is_perf_counter_call(node)
+            and not _perf_counter_exempt(path, lib_dir)
+        ):
+            findings.append(
+                (rel, node.lineno, "PY08",
+                 "time.perf_counter() in library code (metric timing "
+                 "goes through metrics/ or utils/trace.py)")
             )
 
 

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Render a metrics-registry JSON snapshot as a human-readable table.
+
+Usage:
+    python tools/metrics_report.py SNAPSHOT.json [BASELINE.json]
+
+With one argument, renders the snapshot (written by
+``TpuShuffleConf metricsJsonPath`` at manager stop, or
+``sparkrdma_tpu.metrics.write_json_snapshot``).  With two, renders
+``SNAPSHOT - BASELINE`` (counter/histogram deltas; gauges keep the new
+reading) so one run's activity can be isolated from a warm process.
+
+Histograms print count/sum plus approximate p50/p95/p99 interpolated
+from the bucket counts, and the nonzero buckets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from sparkrdma_tpu.metrics import diff_snapshots  # noqa: E402
+
+
+def _fmt_series(rec) -> str:
+    labels = rec.get("labels") or {}
+    if not labels:
+        return rec["name"]
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{rec['name']}{{{inner}}}"
+
+
+def _fmt_num(v: float) -> str:
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return f"{int(f):,}"
+    return f"{f:,.3f}"
+
+
+def _percentile(edges, counts, total, q) -> float:
+    """Approximate quantile from bucket counts: linear interpolation
+    inside the bucket that crosses rank q*total (the overflow bucket
+    reports its lower edge)."""
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        hi = edges[i] if i < len(edges) else lo
+        if cum + c >= rank and c > 0:
+            if i >= len(edges):
+                return lo  # open-ended overflow bucket
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * frac
+        cum += c
+        if i < len(edges):
+            lo = edges[i]
+    return lo
+
+
+def render(snap: dict, title: str = "") -> str:
+    lines = []
+    if title:
+        lines.append(title)
+    counters = [c for c in snap.get("counters", [])]
+    gauges = [g for g in snap.get("gauges", [])]
+    hists = [h for h in snap.get("histograms", [])]
+    width = max(
+        [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
+    )
+    if counters:
+        lines.append("counters")
+        for c in counters:
+            lines.append(
+                f"  {_fmt_series(c):<{width}}  {_fmt_num(c['value']):>16}"
+            )
+    if gauges:
+        lines.append("gauges")
+        for g in gauges:
+            lines.append(
+                f"  {_fmt_series(g):<{width}}  {_fmt_num(g['value']):>16}"
+            )
+    if hists:
+        lines.append("histograms")
+        for h in hists:
+            total = h["count"]
+            p50 = _percentile(h["edges"], h["counts"], total, 0.50)
+            p95 = _percentile(h["edges"], h["counts"], total, 0.95)
+            p99 = _percentile(h["edges"], h["counts"], total, 0.99)
+            lines.append(
+                f"  {_fmt_series(h):<{width}}  count={total} "
+                f"sum={_fmt_num(h['sum'])} "
+                f"p50~{p50:.3g} p95~{p95:.3g} p99~{p99:.3g}"
+            )
+            nonzero = []
+            lo = 0.0
+            for i, c in enumerate(h["counts"]):
+                if i < len(h["edges"]):
+                    span = f"[{lo:g}-{h['edges'][i]:g})"
+                    lo = h["edges"][i]
+                else:
+                    span = f"[{lo:g}+)"
+                if c:
+                    nonzero.append(f"{span}: {c}")
+            if nonzero:
+                lines.append(f"    {', '.join(nonzero)}")
+    if len(lines) <= (1 if title else 0):
+        lines.append("(empty snapshot)")
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    if len(argv) not in (2, 3):
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        snap = json.load(f)
+    title = f"metrics snapshot: {argv[1]}"
+    if len(argv) == 3:
+        with open(argv[2]) as f:
+            base = json.load(f)
+        snap = diff_snapshots(snap, base)
+        title += f" (diff vs {argv[2]})"
+    print(render(snap, title))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
