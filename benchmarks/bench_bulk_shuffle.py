@@ -1,19 +1,186 @@
 #!/usr/bin/env python
-"""Bulk-synchronous collective shuffle end to end (readPlane=bulk).
+"""Bulk-synchronous collective shuffle end to end (readPlane=bulk) plus
+the windowed-plane byte-throughput bench for the zero-copy pipelined
+data path.
 
-Same record job as ``bench_collective_shuffle`` (shared workload from
-benchmarks/common.py) but on the bulk-synchronous plane: the map phase
-publishes normally, then ONE plan barrier + ONE symmetric
-``exchange_bytes`` moves every stream (shuffle/bulk.py) — the
-multi-host scaling mode.  Needs ≥4 mesh devices; on the single-chip
-bench host it re-execs onto a spoofed 8-device CPU mesh, so the number
-gauges the plane's overhead, not TPU silicon.
+Part 1 — the original record job (shared workload from
+benchmarks/common.py) on the bulk-synchronous plane: map phase, then
+ONE plan barrier + ONE symmetric ``exchange_bytes`` moves every stream
+(shuffle/bulk.py).
+
+Part 2 — the windowed plane at a ≥64 MiB working set: maps publish,
+then driver-planned window collectives move the bytes with the
+double-buffered pipeline ON and OFF.  Reports GB/s for both, the
+pipeline speedup, and the plan_wait vs exchange span split from the
+tracer (the round-5 "unmeasured plan-fetch overlap" item), all
+embedded in BENCH_bulk_shuffle.json next to the metrics snapshot
+(copy-bytes-avoided, assembly overlap ratio).
+
+Needs ≥4 mesh devices; on the single-chip bench host it re-execs onto
+a spoofed 8-device CPU mesh, so the numbers gauge the plane's
+overhead, not TPU silicon.
 """
 
 import os
 import sys
+import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WINDOWED_TOTAL_MIB = 96      # working set (acceptance floor: 64 MiB)
+WINDOWED_EXECUTORS = 4
+WINDOWED_MAPS = 8
+WINDOWED_REPS = 3
+
+# pre-zero-copy reference (commit 23de5aa, this same bench run against
+# the legacy b"".join / tobytes data path on the same spoofed-CPU
+# host) — the "before" half of the before/after record in the JSON
+PRE_PR_REFERENCE = {
+    "commit": "23de5aa",
+    "windowed_gbps": 0.085,
+    "pipelined_s": 1.1787,
+    "serial_s": 1.2078,
+    "plan_wait_ms": {"pipelined": 54.6, "serial": 45.2},
+    "exchange_ms": {"pipelined": 16433.8, "serial": 14352.3},
+}
+
+
+def _windowed_bench(pipelined: bool, base_port: int):
+    """Time the windowed exchange of a WINDOWED_TOTAL_MIB working set
+    across WINDOWED_EXECUTORS in-process executors; returns
+    (best_seconds, payload_bytes, plan_wait_ms, exchange_ms)."""
+    import numpy as np
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+    from sparkrdma_tpu.shuffle.bulk import (
+        BulkExchangeReader,
+        BulkShuffleSession,
+        iter_plan_blocks,
+    )
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+    from sparkrdma_tpu.transport import LoopbackNetwork
+    from sparkrdma_tpu.utils.trace import get_tracer
+
+    E = WINDOWED_EXECUTORS
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": base_port,
+        "spark.shuffle.tpu.serializer": "columnar",
+        "spark.shuffle.tpu.readPlane": "windowed",
+        "spark.shuffle.tpu.bulkWindowMaps": "2",
+        "spark.shuffle.tpu.bulkPipelineWindows": str(pipelined),
+        "spark.shuffle.tpu.exchangeTileBytes": "4m",
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "60s",
+        "spark.shuffle.tpu.metrics": "true",
+        "spark.shuffle.tpu.trace": "true",
+        # managers dump the trace at stop(); keep the litter out of
+        # the repo root (the spans are read via get_tracer().events)
+        "spark.shuffle.tpu.tracePath": os.path.join(
+            __import__("tempfile").gettempdir(),
+            "bench_bulk_shuffle_trace.json",
+        ),
+    })
+    net = LoopbackNetwork()
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=base_port + 100 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(E)
+    ]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == E for e in executors):
+            break
+        time.sleep(0.01)
+
+    payload = 1024
+    total_bytes = WINDOWED_TOTAL_MIB << 20
+    n_records = total_bytes // (payload + 8)
+    per_map = n_records // WINDOWED_MAPS
+    rng = np.random.default_rng(0)
+    num_parts = 2 * E
+    part = HashPartitioner(num_parts)
+
+    best = float("inf")
+    moved = 0
+    try:
+        for rep in range(WINDOWED_REPS):
+            sid = 900 + rep
+            handle = driver.register_shuffle(sid, WINDOWED_MAPS, part)
+            for m in range(WINDOWED_MAPS):
+                keys = rng.integers(
+                    0, 1 << 30, per_map
+                ).astype(np.int64)
+                vals = np.frombuffer(
+                    rng.bytes(per_map * payload), dtype=f"S{payload}"
+                )
+                w = executors[m % E].get_writer(handle, m)
+                w.write(list(zip(keys.tolist(), vals.tolist())))
+                w.stop(True)
+
+            session = BulkShuffleSession(
+                TileExchange.from_conf(conf, make_mesh(E)), E,
+                timeout_s=conf.bulk_barrier_timeout_ms / 1000.0,
+            )
+            consumed = [0] * E
+            errors = {}
+
+            def read_task(i, sid=sid, consumed=consumed,
+                          errors=errors, session=session):
+                try:
+                    r = BulkExchangeReader(
+                        executors[i], session=session
+                    )
+                    n = 0
+                    for plan, nE, row in r._iter_windowed_exchanges(
+                        sid
+                    ):
+                        for _s, _m, _r, blk in iter_plan_blocks(
+                            plan, nE, row
+                        ):
+                            n += len(blk)
+                    consumed[i] = n
+                except BaseException as err:  # pragma: no cover
+                    errors[i] = err
+
+            threads = [
+                threading.Thread(target=read_task, args=(i,),
+                                 daemon=True)
+                for i in range(E)
+            ]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            took = time.monotonic() - t0
+            assert not errors, errors
+            moved = sum(consumed)
+            assert moved > 0, "no bytes moved"
+            best = min(best, took)
+            driver.unregister_shuffle(sid)
+            for e in executors:
+                e.unregister_shuffle(sid)
+    finally:
+        spans = get_tracer().events
+        for m in executors + [driver]:
+            m.stop()
+    plan_wait_ms = sum(
+        ev.get("dur", 0) for ev in spans
+        if ev.get("name") == "shuffle.windowed.plan_wait"
+    ) / 1000.0
+    exchange_ms = sum(
+        ev.get("dur", 0) for ev in spans
+        if ev.get("name") == "shuffle.bulk.exchange"
+    ) / 1000.0
+    get_tracer().clear()
+    return best, moved, plan_wait_ms, exchange_ms
 
 
 def main():
@@ -23,6 +190,7 @@ def main():
         emit,
         enable_metrics,
         ensure_multidevice,
+        metrics_snapshot,
         time_group_by_key,
         write_bench_json,
     )
@@ -57,7 +225,97 @@ def main():
         f"symmetric collective)",
         gbps, "GB/s", gbps / ROCE_LINE_RATE_GBPS,
     )
-    write_bench_json("bulk_shuffle")
+
+    # -- windowed plane, zero-copy pipelined data path ----------------------
+    from sparkrdma_tpu.metrics import get_registry
+
+    get_registry().enabled = True
+
+    def counter_totals() -> dict:
+        totals: dict = {}
+        for c in metrics_snapshot().get("counters", []):
+            totals[c["name"]] = totals.get(c["name"], 0) + c["value"]
+        return totals
+
+    # snapshot-deltas isolate the PIPELINED run's counters: the
+    # process-cumulative registry also carries Part 1's bulk plane and
+    # the serial run, which would dilute the overlap ratio
+    base_counters = counter_totals()
+    t_pipe, moved, pw_pipe, ex_pipe = _windowed_bench(
+        True, base_port=53100
+    )
+    pipe_counters = counter_totals()
+    pipe_delta = {
+        k: v - base_counters.get(k, 0)
+        for k, v in pipe_counters.items()
+    }
+    pipe_gbps = moved / t_pipe / 1e9
+    emit(
+        f"windowed-plane exchange throughput, pipelined "
+        f"({moved >> 20} MiB working set, double-buffered windows)",
+        pipe_gbps, "GB/s", pipe_gbps / ROCE_LINE_RATE_GBPS,
+    )
+    t_ser, moved_s, pw_ser, ex_ser = _windowed_bench(
+        False, base_port=53500
+    )
+    ser_gbps = moved_s / t_ser / 1e9
+    emit(
+        "windowed-plane exchange throughput, serial (pipeline off)",
+        ser_gbps, "GB/s", ser_gbps / ROCE_LINE_RATE_GBPS,
+    )
+    emit(
+        "windowed pipeline speedup (pipelined vs serial wall-clock; "
+        "<1 expected on a single-core host, where nothing can overlap)",
+        t_ser / t_pipe, "x", t_ser / t_pipe,
+    )
+    best_gbps = max(pipe_gbps, ser_gbps)
+    emit(
+        "windowed-plane zero-copy speedup vs pre-PR data path "
+        "(best mode on this host vs commit "
+        f"{PRE_PR_REFERENCE['commit']})",
+        best_gbps / PRE_PR_REFERENCE["windowed_gbps"], "x",
+        best_gbps / PRE_PR_REFERENCE["windowed_gbps"],
+    )
+
+    asm_us = pipe_delta.get("exchange_assembly_us_total", 0)
+    asm_overlap_us = pipe_delta.get(
+        "exchange_assembly_overlapped_us_total", 0
+    )
+    overlap_ratio = (asm_overlap_us / asm_us) if asm_us else 0.0
+    emit(
+        "windowed assembly overlap ratio (assembly ms hidden behind "
+        "collectives / total assembly ms)",
+        overlap_ratio, "ratio", overlap_ratio,
+    )
+    write_bench_json("bulk_shuffle", extra={
+        "windowed": {
+            "working_set_bytes": moved,
+            "pipelined_s": round(t_pipe, 4),
+            "serial_s": round(t_ser, 4),
+            "speedup_pipelined_vs_serial": round(t_ser / t_pipe, 3),
+            # plan-fetch overlap measurement (round-5 VERDICT item):
+            # cumulative span time blocked on window plans vs inside
+            # collectives, per mode
+            "plan_wait_ms": {
+                "pipelined": round(pw_pipe, 1),
+                "serial": round(pw_ser, 1),
+            },
+            "exchange_ms": {
+                "pipelined": round(ex_pipe, 1),
+                "serial": round(ex_ser, 1),
+            },
+            "assembly_overlap_ratio": round(overlap_ratio, 3),
+            # the pipelined run's own counters (snapshot delta), not
+            # the process-cumulative totals
+            "copy_bytes_avoided": pipe_delta.get(
+                "exchange_copy_bytes_avoided_total", 0
+            ),
+            "speedup_vs_pre_pr": round(
+                best_gbps / PRE_PR_REFERENCE["windowed_gbps"], 3
+            ),
+        },
+        "pre_pr_reference": PRE_PR_REFERENCE,
+    })
 
 
 if __name__ == "__main__":

@@ -143,6 +143,10 @@ class TpuShuffleContext:
                 TileExchange.from_conf(self.conf, sess_mesh),
                 E,
                 timeout_s=self.conf.bulk_barrier_timeout_ms / 1000.0,
+                # destination rows recycle through a staging pool (the
+                # executors share one process, so any executor's pool
+                # serves; release rides view GC)
+                out_alloc=self.executors[0].staging_pool.alloc_gc,
             )
             for ex in self.executors:
                 ex.windowed_plane = WindowedReadPlane(ex, session=session)
@@ -332,6 +336,7 @@ class TpuShuffleContext:
         session = BulkShuffleSession(
             TileExchange.from_conf(self.conf, make_mesh(E)), E,
             timeout_s=self.conf.bulk_barrier_timeout_ms / 1000.0,
+            out_alloc=self.executors[0].staging_pool.alloc_gc,
         )
 
         def bulk_task(i: int):

@@ -10,6 +10,7 @@ ibverbs (recv WR sizing, ODP) map onto their ICI/arena analogs.
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Dict, Mapping, Optional
 
@@ -55,7 +56,10 @@ class TpuShuffleConf:
     # spark.shuffle.tpu.* key always wins over its legacy alias.
     LEGACY_RENAMES = {
         "useOdp": "lazyStaging",          # on-demand registration analog
-        "cpuList": "deviceList",          # affinity → mesh device list
+        # RdmaNode's cpuList pinned the completion-vector THREADS, not
+        # devices — it maps onto the dispatcher-thread affinity knob,
+        # keeping deviceList free for mesh-device selection
+        "cpuList": "dispatcherCpuList",
     }
 
     def __init__(self, conf: Optional[Mapping[str, object]] = None):
@@ -283,6 +287,21 @@ class TpuShuffleConf:
         return self._int_in_range("bulkWindowMaps", 0, 0, 1 << 20)
 
     @property
+    def bulk_pipeline_windows(self) -> bool:
+        """Double-buffer the windowed plane: while window N's
+        collective runs, window N+1's plan barrier AND stream assembly
+        proceed on a background stage into a second pooled source row
+        (shuffle/bulk.py).  Abort/poison semantics are unchanged and
+        output is bit-identical to the serial loop.  Default: enabled
+        on multi-core hosts; a single-core host cannot overlap — the
+        stage thread would only timeslice against the collective — so
+        it falls back to the serial loop there.  An explicit setting
+        always wins."""
+        return self._bool(
+            "bulkPipelineWindows", (os.cpu_count() or 2) > 1
+        )
+
+    @property
     def bulk_barrier_timeout_ms(self) -> int:
         """How long an in-process bulk-session contributor waits for
         the other participating executors before failing the
@@ -422,9 +441,28 @@ class TpuShuffleConf:
         """Expand device_list against n_devices, dropping out-of-range
         entries; empty/invalid → all devices (reference semantics of
         initCpuArrayList, RdmaNode.java:216-273)."""
-        spec = self.device_list.strip()
+        return self._parse_index_list(self.device_list, n_devices)
+
+    @property
+    def dispatcher_cpu_list(self) -> str:
+        """Comma/range CPU list pinning the transport dispatcher and
+        bulk-pool threads via ``sched_setaffinity`` (the RdmaThread
+        comp-vector affinity, RdmaNode.java:216-273).  The reference's
+        ``spark.shuffle.rdma.cpuList`` aliases here.  Distinct from
+        ``deviceList`` — that names accelerator devices, this names
+        host CPUs."""
+        return str(self.get("dispatcherCpuList", ""))
+
+    def parse_dispatcher_cpu_list(self, n_cpus: int) -> list:
+        """Expand dispatcher_cpu_list against this host's CPU count;
+        empty/invalid → all CPUs (no pinning)."""
+        return self._parse_index_list(self.dispatcher_cpu_list, n_cpus)
+
+    @staticmethod
+    def _parse_index_list(spec: str, n: int) -> list:
+        spec = spec.strip()
         if not spec:
-            return list(range(n_devices))
+            return list(range(n))
         out = []
         try:
             for part in spec.split(","):
@@ -437,6 +475,6 @@ class TpuShuffleConf:
                 else:
                     out.append(int(part))
         except ValueError:
-            return list(range(n_devices))
-        out = [d for d in out if 0 <= d < n_devices]
-        return out or list(range(n_devices))
+            return list(range(n))
+        out = [d for d in out if 0 <= d < n]
+        return out or list(range(n))

@@ -1,6 +1,12 @@
 """Device-mesh parallel layer: meshes, collectives, the byte exchange engine."""
 
 from sparkrdma_tpu.parallel.mesh import make_mesh, mesh_devices
-from sparkrdma_tpu.parallel.exchange import ExchangePlan, TileExchange
+from sparkrdma_tpu.parallel.exchange import (
+    DestRowView,
+    ExchangePlan,
+    TileExchange,
+    row_offsets,
+)
 
-__all__ = ["make_mesh", "mesh_devices", "ExchangePlan", "TileExchange"]
+__all__ = ["make_mesh", "mesh_devices", "ExchangePlan", "TileExchange",
+           "DestRowView", "row_offsets"]

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
 from sparkrdma_tpu.transport.channel import TransportError
 
@@ -69,6 +70,40 @@ class ExchangeIntegrityError(TransportError):
 TILE_ALIGN = 128
 
 
+def row_offsets(lengths_1d) -> np.ndarray:
+    """Exclusive prefix sums of one lengths row/column: stream ``i`` of
+    a contiguous exchange row occupies ``[offs[i], offs[i + 1])``.
+    Returns int64 ``[D + 1]``."""
+    lengths_1d = np.asarray(lengths_1d, np.int64)
+    offs = np.zeros(len(lengths_1d) + 1, np.int64)
+    np.cumsum(lengths_1d, out=offs[1:])
+    return offs
+
+
+class DestRowView:
+    """One destination's received streams as ZERO-COPY slices of one
+    contiguous row buffer: ``row[s]`` is the uint8 view of the stream
+    from source ``s`` (the copy-free replacement for the legacy
+    per-pair ``bytes`` lists — consumers slice blocks out of the view
+    without ever materializing a ``bytes`` object)."""
+
+    __slots__ = ("buf", "offsets")
+
+    def __init__(self, buf: np.ndarray, offsets: np.ndarray):
+        self.buf = buf
+        self.offsets = offsets
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, s: int) -> np.ndarray:
+        return self.buf[int(self.offsets[s]):int(self.offsets[s + 1])]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.offsets[-1])
+
+
 class NonAddressableStreamError(TransportError):
     """A caller touched a destination row that lives on another host.
 
@@ -88,8 +123,10 @@ class NonAddressableStreamError(TransportError):
 
 
 class HostLocalStreams:
-    """Result of a multi-host ``exchange_bytes``: list-like [D][S] with
-    only this host's destination rows present.  Indexing a remote
+    """Result of a multi-host ``exchange_bytes`` (rows are per-source
+    ``bytes`` lists) or any ``exchange_into`` (rows are
+    :class:`DestRowView` zero-copy views): list-like [D][S] with only
+    this host's destination rows present.  Indexing a remote
     destination raises :class:`NonAddressableStreamError` instead of
     returning empty bytes; ``addressable`` lists the valid rows.
 
@@ -170,6 +207,28 @@ class ExchangePlan:
     def round_slice(self, r: int) -> Tuple[int, int]:
         """[start, end) byte range of round r within each pair stream."""
         return r * self.tile_bytes, (r + 1) * self.tile_bytes
+
+
+def _make_row_collect(plan: "ExchangePlan", lengths: np.ndarray,
+                      col_offs, get_dst):
+    """The ONE per-round destination scatter both byte paths share:
+    received tile slices land at their final offsets inside the
+    per-destination contiguous rows (a second copy of this slicing
+    loop drifting on round/offset math would silently misalign
+    stream boundaries)."""
+    D = lengths.shape[0]
+
+    def collect(r: int, d: int, local: np.ndarray) -> None:
+        lo, hi = plan.round_slice(r)
+        buf = get_dst(d)
+        offs = col_offs[d]
+        for s in range(D):
+            take = min(hi, int(lengths[s, d])) - lo
+            if take > 0:
+                o = int(offs[s]) + lo
+                buf[o : o + take] = local[s, :take]
+
+    return collect
 
 
 @functools.lru_cache(maxsize=64)
@@ -302,63 +361,56 @@ class TileExchange:
                             f"local_sources may be empty)"
                         )
         plan = self.plan(lengths)
-        out: List[List[bytearray]] = [
-            [bytearray() for _ in range(D)] for _ in range(D)
-        ]
         if plan.rounds == 0:
-            return [[bytes(out[d][s]) for s in range(D)] for d in range(D)]
+            return [[b""] * D for _ in range(D)]
 
-        # our own staging arrays: safe to donate, halves HBM per round
-        fn, sharding = _a2a_fn(self.mesh, D, plan.tile_bytes, True)
-        inflight: deque = deque()
+        col_offs = [row_offsets(lengths[:, d]) for d in range(D)]
+        # destination rows preallocated ONCE at their exact payload
+        # size: the per-round collect slice-assigns into them instead
+        # of growing per-pair bytearrays round by round (the old
+        # ``out[d][s] += local[s].tobytes()`` accumulation reallocated
+        # and re-copied every pair every round)
+        dst_rows: Dict[int, np.ndarray] = {}
 
-        filled_dsts = set()  # destinations addressable on THIS host
+        def get_dst(d: int) -> np.ndarray:
+            buf = dst_rows.get(d)
+            if buf is None:
+                buf = dst_rows[d] = np.empty(
+                    int(lengths[:, d].sum()), np.uint8
+                )
+            return buf
 
-        def collect(done):
-            # pull each destination's local shard and append its per-src
-            # tile slices (on a pod each host pulls only its own shard)
-            for shard in done.addressable_shards:
-                d = shard.index[0].start if shard.index[0].start is not None else 0
-                filled_dsts.add(d)
-                local = np.asarray(shard.data)[0]  # [D, tile]
-                for s in range(D):
-                    out[d][s] += local[s].tobytes()
-
-        multi = jax.process_count() > 1
-        if multi:
-            local_rows = np.array([
-                i for i, dev in enumerate(self.devices)
-                if dev.process_index == jax.process_index()
-            ])
-        for r in range(plan.rounds):
+        def fill(r: int) -> np.ndarray:
             lo, hi = plan.round_slice(r)
+            # np.zeros, not np.empty: calloc's zero pages make the
+            # untouched padding free until faulted, and everything the
+            # collective ships stays deterministic — np.empty would
+            # transmit stale heap memory in the pad spans (a cross-host
+            # disclosure on a real mesh).  Omitted rows outside
+            # local_sources read as zeros, as before.
             mat = np.zeros((D, D, plan.tile_bytes), dtype=np.uint8)
             for s in range(D):
+                row = streams[s]
                 for d in range(D):
-                    chunk = streams[s][d][lo:hi]
-                    if chunk:
-                        mat[s, d, : len(chunk)] = np.frombuffer(chunk, np.uint8)
-            if multi:
-                # multi-controller: a process may only place its own
-                # devices' shards (device_put of a global array would
-                # reject the non-addressable ones)
-                garr = jax.make_array_from_process_local_data(
-                    sharding, mat[local_rows], (D, D, plan.tile_bytes)
-                )
-            else:
-                garr = jax.device_put(mat, sharding)
-            inflight.append(fn(garr))
-            self.rounds_executed += 1
-            if len(inflight) >= self.max_rounds_in_flight:
-                collect(inflight.popleft())
-        while inflight:
-            collect(inflight.popleft())
+                    take = min(hi, int(lengths[s, d])) - lo
+                    if take <= 0:
+                        continue
+                    chunk = row[d][lo : lo + take]
+                    if len(chunk):
+                        mat[s, d, : len(chunk)] = np.frombuffer(
+                            chunk, np.uint8
+                        )
+            return mat
 
-        self.payload_bytes_moved += plan.payload_bytes
-        self.padded_bytes_moved += plan.moved_bytes
-        # trim pair streams to their true lengths (drop tile padding)
+        collect = _make_row_collect(plan, lengths, col_offs, get_dst)
+        filled_dsts = self._run_tile_rounds(plan, fill, collect)
         result = [
-            [bytes(out[d][s][: int(lengths[s, d])]) for s in range(D)]
+            [
+                bytes(memoryview(
+                    dst_rows[d][col_offs[d][s]:col_offs[d][s + 1]]
+                )) if d in filled_dsts else b""
+                for s in range(D)
+            ]
             for d in range(D)
         ]
         if self.verify_integrity:
@@ -369,6 +421,199 @@ class TileExchange:
             # loudly instead of reading as empty streams
             return HostLocalStreams(result, frozenset(filled_dsts))
         return result
+
+    def exchange_into(
+        self,
+        lengths: np.ndarray,
+        src_rows,
+        local_sources: Optional[frozenset] = None,
+        out_alloc=None,
+    ) -> HostLocalStreams:
+        """Zero-copy exchange over preallocated contiguous rows.
+
+        ``src_rows`` maps source index → one contiguous uint8 buffer
+        (ndarray / memoryview) laid out per ``lengths[s]``: the stream
+        to destination ``d`` occupies ``[row_offsets(lengths[s])[d],
+        row_offsets(lengths[s])[d + 1])``.  Assembly writes map-output
+        blocks into that row ONCE; the round loop stages tile slices
+        straight out of it (no per-destination ``bytes`` joins, no
+        ``frombuffer`` round-trips).
+
+        Returns a :class:`HostLocalStreams` whose addressable rows are
+        :class:`DestRowView` objects — ``result[d][s]`` is a uint8 VIEW
+        of the received stream from source ``s``, sliced out of one
+        per-destination buffer that ``out_alloc(nbytes)`` provides
+        (default ``np.empty``; pass a pooled allocator such as
+        ``StagingPool.alloc_gc`` to recycle the buffers).  Same
+        multi-host contract as :meth:`exchange_bytes`: every process
+        passes the same ``lengths``; ``local_sources`` names the rows
+        this caller vouches for (their buffers must be present and
+        exactly sized; other sources' rows may be omitted)."""
+        D = self.n_devices
+        lengths = np.asarray(lengths, dtype=np.int64)
+        if lengths.shape != (D, D):
+            raise ValueError(
+                f"lengths must be [{D}, {D}], got {lengths.shape}"
+            )
+        if (lengths < 0).any():
+            raise ValueError("negative stream length")
+        if local_sources is None:
+            proc = jax.process_index()
+            local_sources = frozenset(
+                s for s, dev in enumerate(self.devices)
+                if dev.process_index == proc
+            )
+        src: Dict[int, np.ndarray] = {}
+        src_offs: Dict[int, np.ndarray] = {}
+        for s in sorted(local_sources):
+            row = src_rows[s] if not hasattr(src_rows, "get") \
+                else src_rows.get(s)
+            if row is None:
+                raise ValueError(f"no source row for vouched source {s}")
+            arr = row if isinstance(row, np.ndarray) \
+                else np.frombuffer(row, np.uint8)
+            if arr.dtype != np.uint8 or arr.ndim != 1:
+                raise ValueError(
+                    f"source row {s} must be a flat uint8 buffer, got "
+                    f"{arr.dtype} ndim={arr.ndim}"
+                )
+            need = int(lengths[s].sum())
+            if arr.shape[0] != need:
+                raise ValueError(
+                    f"source row {s} is {arr.shape[0]}B but its lengths "
+                    f"row sums to {need}B"
+                )
+            src[s] = arr
+            src_offs[s] = row_offsets(lengths[s])
+
+        plan = self.plan(lengths)
+        col_offs = [row_offsets(lengths[:, d]) for d in range(D)]
+        alloc = out_alloc if out_alloc is not None else (
+            lambda n: np.empty(n, np.uint8)
+        )
+        dst_rows: Dict[int, np.ndarray] = {}
+
+        def get_dst(d: int) -> np.ndarray:
+            buf = dst_rows.get(d)
+            if buf is None:
+                n = int(lengths[:, d].sum())
+                buf = np.empty(0, np.uint8) if n == 0 else alloc(n)[:n]
+                dst_rows[d] = buf
+            return buf
+
+        if plan.rounds == 0:
+            rows = [
+                DestRowView(get_dst(d), col_offs[d]) for d in range(D)
+            ]
+            return HostLocalStreams(rows, frozenset(range(D)))
+
+        def fill(r: int) -> np.ndarray:
+            lo, hi = plan.round_slice(r)
+            # np.zeros for the same reason as exchange_bytes: pad spans
+            # and unvouched sources' cells must ship deterministic
+            # zeros, never stale heap memory
+            mat = np.zeros((D, D, plan.tile_bytes), dtype=np.uint8)
+            for s, row in src.items():
+                offs = src_offs[s]
+                for d in range(D):
+                    take = min(hi, int(lengths[s, d])) - lo
+                    if take > 0:
+                        o = int(offs[d]) + lo
+                        mat[s, d, :take] = row[o : o + take]
+            return mat
+
+        collect = _make_row_collect(plan, lengths, col_offs, get_dst)
+        filled_dsts = self._run_tile_rounds(plan, fill, collect)
+        sent = sum(int(lengths[s].sum()) for s in src)
+        received = sum(
+            int(lengths[:, d].sum()) for d in filled_dsts
+        )
+        # vs the legacy bytes path: assembly skipped the per-destination
+        # join of the source payload; consumption skipped the per-pair
+        # tobytes + trim materializations of the received payload
+        counter("exchange_copy_bytes_avoided_total").inc(
+            sent + 2 * received
+        )
+        rows: List[Optional[DestRowView]] = [None] * D
+        for d in filled_dsts:
+            rows[d] = DestRowView(get_dst(d), col_offs[d])
+        if self.verify_integrity:
+            self._verify_rows(
+                src, src_offs, rows, filled_dsts, lengths
+            )
+        return HostLocalStreams(rows, frozenset(filled_dsts))
+
+    def _run_tile_rounds(self, plan: ExchangePlan, fill_round,
+                         collect_round) -> set:
+        """The ONE tile-round engine both byte paths share:
+        ``fill_round(r)`` stages round ``r``'s [D, D, tile] host
+        matrix, ``collect_round(r, d, local)`` consumes destination
+        ``d``'s received [D, tile] slab for round ``r``.  Keeps the
+        bounded in-flight window (rounds collect FIFO, so round
+        indices pair correctly with completions) and returns the set
+        of destinations addressable on this host."""
+        D = self.n_devices
+        # our own staging arrays: safe to donate, halves HBM per round
+        fn, sharding = _a2a_fn(self.mesh, D, plan.tile_bytes, True)
+        inflight: deque = deque()
+        filled_dsts: set = set()
+
+        def collect(r, done):
+            # pull each destination's local shard (on a pod each host
+            # pulls only its own shard)
+            for shard in done.addressable_shards:
+                d = shard.index[0].start \
+                    if shard.index[0].start is not None else 0
+                filled_dsts.add(d)
+                local = np.asarray(shard.data)[0]  # [D, tile]
+                collect_round(r, d, local)
+
+        multi = jax.process_count() > 1
+        if multi:
+            local_rows = np.array([
+                i for i, dev in enumerate(self.devices)
+                if dev.process_index == jax.process_index()
+            ])
+        for r in range(plan.rounds):
+            mat = fill_round(r)
+            if multi:
+                # multi-controller: a process may only place its own
+                # devices' shards (device_put of a global array would
+                # reject the non-addressable ones)
+                garr = jax.make_array_from_process_local_data(
+                    sharding, mat[local_rows], (D, D, plan.tile_bytes)
+                )
+            else:
+                garr = jax.device_put(mat, sharding)
+            inflight.append((r, fn(garr)))
+            self.rounds_executed += 1
+            if len(inflight) >= self.max_rounds_in_flight:
+                collect(*inflight.popleft())
+        while inflight:
+            collect(*inflight.popleft())
+        self.payload_bytes_moved += plan.payload_bytes
+        self.padded_bytes_moved += plan.moved_bytes
+        return filled_dsts
+
+    def _verify_rows(self, src, src_offs, rows, filled_dsts,
+                     lengths) -> None:
+        """Integrity check for the zero-copy path: same scope as
+        :meth:`_verify` (pairs whose source AND destination are
+        addressable here), comparing views without materializing."""
+        for d in sorted(filled_dsts):
+            row = rows[d]
+            for s in sorted(src):
+                o = int(src_offs[s][d])
+                n = int(lengths[s, d])
+                sent = src[s][o : o + n]
+                got = row[s]
+                if not np.array_equal(got, sent):
+                    self.integrity_failures += 1
+                    raise ExchangeIntegrityError(
+                        s, d,
+                        zlib.crc32(memoryview(sent)),
+                        zlib.crc32(memoryview(got)),
+                    )
 
     def _verify(self, streams, result, filled_dsts,
                 local_sources=None) -> None:

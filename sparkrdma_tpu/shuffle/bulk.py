@@ -39,7 +39,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from sparkrdma_tpu.metrics import counter, gauge
-from sparkrdma_tpu.parallel.exchange import TileExchange
+from sparkrdma_tpu.parallel.exchange import TileExchange, row_offsets
 from sparkrdma_tpu.rpc.messages import FetchExchangePlanMsg
 from sparkrdma_tpu.shuffle.reader import (
     FetchFailedError,
@@ -59,10 +59,14 @@ class BulkShuffleSession:
     """
 
     def __init__(self, exchange: TileExchange, n_hosts: int,
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0, out_alloc=None):
         self.exchange = exchange
         self.n_hosts = n_hosts
         self.timeout_s = timeout_s
+        # optional pooled allocator for destination rows (e.g. a
+        # StagingPool.alloc_gc): zero-copy results then recycle their
+        # buffers once the last consumer view dies
+        self.out_alloc = out_alloc
         self._cv = threading.Condition()
         self._rows = {}
         self._lengths = None
@@ -114,15 +118,10 @@ class BulkShuffleSession:
                 raise ValueError(f"row {me} contributed twice")
             self._rows[me] = row
             if len(self._rows) == self.n_hosts:
-                E = self.n_hosts
-                streams = [[b""] * E for _ in range(E)]
-                for s, r in self._rows.items():
-                    streams[s] = list(r)
                 try:
                     self._results[gen] = (
-                        self.exchange.exchange_bytes(
-                            streams, lengths=self._lengths,
-                            local_sources=frozenset(self._rows),
+                        self._exchange_contributed(
+                            self._rows, self._lengths
                         ),
                         None,
                     )
@@ -178,14 +177,9 @@ class BulkShuffleSession:
                 )
             st["rows"][me] = row
             if len(st["rows"]) == self.n_hosts:
-                E = self.n_hosts
-                streams = [[b""] * E for _ in range(E)]
-                for s, r in st["rows"].items():
-                    streams[s] = list(r)
                 try:
-                    st["result"] = self.exchange.exchange_bytes(
-                        streams, lengths=st["lengths"],
-                        local_sources=frozenset(st["rows"]),
+                    st["result"] = self._exchange_contributed(
+                        st["rows"], st["lengths"]
                     )
                 except BaseException as e:
                     st["error"] = e
@@ -214,13 +208,61 @@ class BulkShuffleSession:
                 raise error
             return result
 
+    def _exchange_contributed(self, rows: dict, lengths) -> object:
+        """Run the one collective over the contributed rows.  Rows come
+        in two shapes: contiguous uint8 arrays (the zero-copy path —
+        one buffer per source, laid out per its lengths row, exchanged
+        through ``exchange_into`` into destination row VIEWS) or the
+        legacy per-destination ``bytes`` lists (``exchange_bytes``).
+        Mixed contributions (a mid-upgrade cluster) downgrade the
+        array rows to bytes so one legacy participant never deadlocks
+        the round."""
+        E = self.n_hosts
+        if rows and all(
+            isinstance(r, np.ndarray) for r in rows.values()
+        ):
+            return self.exchange.exchange_into(
+                lengths, dict(rows), local_sources=frozenset(rows),
+                out_alloc=self._dst_alloc,
+            )
+        streams: list = [[b""] * E for _ in range(E)]
+        for s, r in rows.items():
+            if isinstance(r, np.ndarray):
+                offs = row_offsets(lengths[s])
+                streams[s] = [
+                    bytes(memoryview(
+                        r[int(offs[d]):int(offs[d + 1])]
+                    ))
+                    for d in range(E)
+                ]
+            else:
+                streams[s] = list(r)
+        return self.exchange.exchange_bytes(
+            streams, lengths=lengths, local_sources=frozenset(rows),
+        )
+
+    def _dst_alloc(self, nbytes: int) -> np.ndarray:
+        """Destination-row buffer: pooled when the session was given an
+        allocator, fresh numpy memory otherwise (or when the pool's
+        budget is exhausted — an exchange must not fail on pool
+        pressure when plain memory would serve)."""
+        if self.out_alloc is not None:
+            try:
+                return self.out_alloc(nbytes)
+            except MemoryError:
+                counter("exchange_row_pool_fallbacks_total").inc()
+        return np.empty(nbytes, np.uint8)
+
 
 def iter_plan_blocks(plan, E: int, row):
     """Walk one exchange result row by its plan manifest: yields
-    ``(source, map_id, reduce_id, block bytes)`` for every block this
+    ``(source, map_id, reduce_id, block payload)`` for every block this
     host received — the ONE offset-slicing loop shared by the windowed
     pump and both bulk consumption paths (a second copy drifting on
-    manifest layout would silently misalign block boundaries)."""
+    manifest layout would silently misalign block boundaries).  Block
+    payloads are zero-copy slices of the row (uint8 views on the
+    ``exchange_into`` path, ``bytes`` slices on the legacy one); every
+    consumer downstream takes bytes-likes."""
     for s in range(E):
         data = row[s]
         off = 0
@@ -506,6 +548,78 @@ class WindowedShuffleReader:
         return postprocess_records(_records(), self.handle)
 
 
+class _StagedWindow:
+    """One window's assembled exchange inputs: the plan, this host's
+    index, the [E, E] lengths matrix, and the contiguous pooled source
+    row — everything the collective stage needs, produced off the
+    critical path by the pipelined assembler."""
+
+    __slots__ = ("plan", "E", "me", "lengths", "row")
+
+    def __init__(self, plan, E: int, me: int, lengths: np.ndarray,
+                 row: np.ndarray):
+        self.plan = plan
+        self.E = E
+        self.me = me
+        self.lengths = lengths
+        self.row = row
+
+
+class _StagingTask:
+    """Background plan-wait + assembly for one window (the pipelined
+    loop's second buffer).  A daemon thread owns the blocking work;
+    ``result()`` joins it, ``cancel()`` unblocks a plan wait in flight
+    (the waiter's cancel poisons its event) so an abandoned pipeline
+    never strands the assembler until the plan timeout."""
+
+    def __init__(self, reader: "BulkExchangeReader", shuffle_id: int,
+                 window: int, overlapped: bool):
+        from sparkrdma_tpu.utils.trace import get_tracer
+
+        self._tracer = get_tracer()
+        self._reader = reader
+        self._shuffle_id = shuffle_id
+        self._window = window
+        self._overlapped = overlapped
+        self._waiter = reader._fetch_plan_async(
+            shuffle_id, window=window
+        )
+        self._done = threading.Event()
+        self._out: dict = {}
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"window-stage-{shuffle_id}-{window}",
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            with self._tracer.span(
+                "shuffle.windowed.plan_wait",
+                shuffle=self._shuffle_id, window=self._window,
+            ):
+                plan = self._waiter.wait()
+            self._out["staged"] = self._reader._assemble(
+                self._shuffle_id, plan, window=self._window,
+                overlapped=self._overlapped,
+            )
+        except BaseException as e:
+            self._out["error"] = e
+        finally:
+            self._done.set()
+
+    def result(self) -> _StagedWindow:
+        # the plan wait bounds itself (partitionLocationFetchTimeout /
+        # cancel); assembly is local work — no extra timer here
+        self._done.wait()
+        if "error" in self._out:
+            raise self._out["error"]
+        return self._out["staged"]
+
+    def cancel(self) -> None:
+        self._waiter.cancel()
+
+
 class BulkExchangeReader:
     """Runs steps 2-4 for one executor (one per participating host)."""
 
@@ -584,6 +698,11 @@ class BulkExchangeReader:
                 return box["plan"]
 
             def cancel(self):
+                # also unblocks a wait() in flight on another thread
+                # (the pipelined assembler): a cancelled waiter must
+                # fail NOW, not ride out the full plan timeout
+                box.setdefault("error", "plan waiter cancelled")
+                event.set()
                 mgr.unregister_plan_callback(cb_id)
 
         return _PlanWaiter()
@@ -597,14 +716,16 @@ class BulkExchangeReader:
         ):
             return self._fetch_plan_async(shuffle_id, window).wait()
 
-    def _run_exchange(self, shuffle_id: int, me: int, streams, lengths,
-                      window: int = -1):
+    def _run_exchange(self, shuffle_id: int, me: int, row: np.ndarray,
+                      lengths, window: int = -1):
+        """One collective over this host's contiguous source ``row``
+        (laid out per ``lengths[me]``)."""
         if self.session is not None:
             # key the in-process barrier by (shuffle, window) so
             # concurrent shuffles through one shared session never
             # cross-contribute rows
             return self.session.run(
-                me, streams[me], lengths,
+                me, row, lengths,
                 round_key=(shuffle_id, window),
             )
         import jax
@@ -612,7 +733,7 @@ class BulkExchangeReader:
         dev = self.exchange.devices[me]
         if (jax.process_count() > 1
                 and dev.process_index != jax.process_index()):
-            # exchange_bytes only stages THIS process's device rows: a
+            # the exchange only stages THIS process's device rows: a
             # mesh whose device order disagrees with the canonical host
             # order would silently exchange zeros
             raise MetadataFetchFailedError(
@@ -622,8 +743,9 @@ class BulkExchangeReader:
                 f"process {jax.process_index()} — order the mesh "
                 f"devices like the plan's host order",
             )
-        return self.exchange.exchange_bytes(
-            streams, lengths=lengths, local_sources=frozenset({me}),
+        return self.exchange.exchange_into(
+            lengths, {me: row}, local_sources=frozenset({me}),
+            out_alloc=self._alloc_row,
         )
 
     # -- steps 3-4: exchange + consume --------------------------------------
@@ -640,11 +762,26 @@ class BulkExchangeReader:
         return out
 
     def _iter_windowed_exchanges(self, shuffle_id: int):
-        """Run each plan window's exchange in order, with the NEXT
-        window's plan fetch overlapping the current collective (the
-        plan barrier includes waiting for that window's maps to
-        publish — serializing it behind the exchange doubled the
-        per-window latency at fine window settings).
+        """Run each plan window's exchange in order.  With
+        ``bulkPipelineWindows`` (the default) the NEXT window's plan
+        fetch AND stream assembly both overlap the current collective
+        (double-buffered: window N+1 assembles into a second pooled
+        row while window N's bytes ride the mesh); disabling the knob
+        keeps only the plan-fetch overlap — output is bit-identical
+        either way."""
+        mgr = getattr(self, "manager", None)
+        if mgr is not None and mgr.conf.bulk_pipeline_windows:
+            yield from self._iter_windowed_pipelined(shuffle_id)
+        else:
+            yield from self._iter_windowed_serial(shuffle_id)
+
+    def _iter_windowed_serial(self, shuffle_id: int):
+        """The non-pipelined window loop: only the next window's plan
+        FETCH overlaps the current collective (the plan barrier
+        includes waiting for that window's maps to publish —
+        serializing it behind the exchange doubled the per-window
+        latency at fine window settings); assembly stays on the
+        critical path.
 
         The whole loop — INCLUDING the yields — runs under one
         try/finally: when the consumer abandons the generator
@@ -687,15 +824,94 @@ class BulkExchangeReader:
                     "shuffle_plan_waiters_cancelled_total"
                 ).inc(cancelled)
 
+    def _iter_windowed_pipelined(self, shuffle_id: int):
+        """The double-buffered window loop: while window N's collective
+        runs, window N+1's plan barrier AND stream assembly proceed on
+        a background stage into a second pooled source row — the
+        maxBytesInFlight overlap applied to the whole host-side data
+        path, not just the plan RPC.
+
+        Abort/poison semantics are preserved: a poisoned session fails
+        the in-flight exchange immediately (session.run re-checks
+        under its condition), the error unwinds this generator, and
+        the finally cancels the being-assembled window's stage — its
+        plan waiter is unblocked by cancel(), so the assembler thread
+        exits promptly instead of riding out the plan timeout."""
+        w = 0
+        prep = _StagingTask(self, shuffle_id, 0, overlapped=False)
+        nxt = None
+        try:
+            while True:
+                staged = prep.result()
+                prep = None
+                if not staged.plan.final:
+                    # window w+1 stages (plan barrier + assembly into
+                    # the second buffer) while window w exchanges
+                    nxt = _StagingTask(
+                        self, shuffle_id, w + 1, overlapped=True
+                    )
+                    counter("exchange_windows_pipelined_total").inc()
+                result = self._exchange_staged(
+                    shuffle_id, staged, window=w
+                )
+                prep, nxt = nxt, None
+                yield result
+                if staged.plan.final:
+                    return
+                w += 1
+        finally:
+            cancelled = 0
+            for pending in (prep, nxt):
+                if pending is not None:
+                    pending.cancel()
+                    cancelled += 1
+            if cancelled:
+                counter(
+                    "shuffle_plan_waiters_cancelled_total"
+                ).inc(cancelled)
+
     def _exchange_rows(self, shuffle_id: int, window: int = -1,
                        plan=None):
-        """Plan barrier + stream build + ONE collective exchange; all
-        EAGER (a lazily-deferred exchange would leave every other
+        """Plan barrier + stream assembly + ONE collective exchange;
+        all EAGER (a lazily-deferred exchange would leave every other
         participant blocked in the collective).  Returns (plan, E,
-        row) where row[s] is the received stream from source s."""
-        mgr = self.manager
+        row) where row[s] is the received stream from source s (a
+        zero-copy view of this host's destination row)."""
         if plan is None:
             plan = self._fetch_plan(shuffle_id, window=window)
+        staged = self._assemble(shuffle_id, plan, window=window)
+        return self._exchange_staged(shuffle_id, staged, window=window)
+
+    def _alloc_row(self, nbytes: int) -> np.ndarray:
+        """One pooled contiguous source row (memory/staging.py): the
+        pool recycles the buffer once the last view of it dies, which
+        is what makes the double-buffered windows a TWO-buffer steady
+        state instead of an allocation per window."""
+        if nbytes <= 0:
+            return np.empty(0, np.uint8)
+        pool = getattr(self.manager, "staging_pool", None)
+        if pool is not None:
+            try:
+                return pool.alloc_gc(nbytes)[:nbytes]
+            except MemoryError:
+                counter("exchange_row_pool_fallbacks_total").inc()
+        return np.empty(nbytes, np.uint8)
+
+    def _assemble(self, shuffle_id: int, plan, window: int = -1,
+                  overlapped: bool = False) -> "_StagedWindow":
+        """Stage this host's source row for one exchange: map-output
+        blocks are gathered ONCE into a single preallocated uint8 row
+        laid out per the plan's lengths (map_id asc, reduce_id asc,
+        empties skipped — the exact order the driver's plan assumed).
+        No per-destination ``bytes`` join, no per-block
+        materialization: block views copy straight into their final
+        offset.  A host that ran no map tasks still participates (the
+        collective needs every member) with an all-empty row.  A
+        windowed plan names exactly which of my maps belong to THIS
+        window (the driver assigns maps to windows as fills land)."""
+        from sparkrdma_tpu.utils.trace import get_tracer
+
+        mgr = self.manager
         hosts = list(plan.hosts)
         E = len(hosts)
         try:
@@ -707,66 +923,103 @@ class BulkExchangeReader:
                 "(did it hello the driver?)",
             )
         lengths = np.asarray(plan.lengths, np.int64).reshape(E, E)
-
-        # my source streams: local blocks concatenated per destination
-        # in the canonical order (map_id asc, reduce_id asc, empties
-        # skipped) — the exact order the driver's plan assumed.  A host
-        # that ran no map tasks still participates (the collective
-        # needs every member) with all-empty source streams.  A
-        # windowed plan names exactly which of my maps belong to THIS
-        # window (the driver assigns maps to windows as fills land).
-        from sparkrdma_tpu.utils.trace import get_tracer
-
         if window >= 0:
             my_maps = sorted(plan.my_maps)
         else:
             my_maps = mgr.resolver.map_ids(shuffle_id)
-        streams: List[List[bytes]] = [[b""] * E for _ in range(E)]
+        offs = row_offsets(lengths[me])
+        total = int(offs[-1])
+        row = self._alloc_row(total)
+        cursors = [int(offs[d]) for d in range(E)]
+        t0 = time.monotonic()
         with get_tracer().span(
             "shuffle.windowed.stream_build", shuffle=shuffle_id,
             window=window, maps=len(my_maps),
         ):
-            if my_maps:
+            if my_maps and total:
                 num_parts = mgr.resolver.num_partitions(shuffle_id)
                 # one batched backing-store read per map output (every
                 # partition ships somewhere, so fetch each segment
                 # ONCE instead of a device round-trip per block), then
-                # deal the blocks out to their destination streams
-                parts_by_dst: List[List[bytes]] = [[] for _ in range(E)]
+                # write each block view at its destination offset
                 for map_id in my_maps:
                     blocks = mgr.resolver.get_local_blocks(
                         shuffle_id, map_id, range(num_parts)
                     )
                     for d in range(E):
+                        cur = cursors[d]
                         for r in range(d, num_parts, E):
                             blk = blocks[r]
-                            if len(blk):
-                                parts_by_dst[d].append(
-                                    blk if isinstance(blk, bytes)
-                                    else bytes(blk)
+                            n = len(blk)
+                            if not n:
+                                continue
+                            if isinstance(blk, np.ndarray) \
+                                    and blk.dtype == np.uint8:
+                                src = blk
+                            else:
+                                try:
+                                    src = np.frombuffer(blk, np.uint8)
+                                except (TypeError, ValueError):
+                                    # exotic block store: materialize
+                                    # once and COUNT it — the zero-copy
+                                    # smoke test pins this at zero
+                                    counter(
+                                        "exchange_assembly_"
+                                        "materialized_blocks_total"
+                                    ).inc()
+                                    src = np.frombuffer(
+                                        bytes(blk), np.uint8
+                                    )
+                            end = cur + n
+                            if end > int(offs[d + 1]):
+                                raise MetadataFetchFailedError(
+                                    mgr.local_smid.host, shuffle_id,
+                                    f"local stream to dst {d} "
+                                    f"overflows its planned "
+                                    f"{int(lengths[me, d])}B",
                                 )
-                for d in range(E):
-                    streams[me][d] = b"".join(parts_by_dst[d])
+                            row[cur:end] = src
+                            cur = end
+                        cursors[d] = cur
         for d in range(E):
-            if len(streams[me][d]) != int(lengths[me, d]):
+            got = cursors[d] - int(offs[d])
+            if got != int(lengths[me, d]):
                 raise MetadataFetchFailedError(
                     mgr.local_smid.host, shuffle_id,
-                    f"local stream to dst {d} is "
-                    f"{len(streams[me][d])}B, plan says "
+                    f"local stream to dst {d} is {got}B, plan says "
                     f"{int(lengths[me, d])}B",
                 )
+        # microseconds: whole-ms granularity truncated fast windows to
+        # zero and zeroed the overlap ratio on fine window settings
+        us = int((time.monotonic() - t0) * 1e6)
+        counter("exchange_assembly_us_total").inc(us)
+        counter("exchange_assembly_bytes_total").inc(total)
+        if overlapped:
+            # staged while another window's collective was in flight:
+            # this host-side work left the critical path entirely
+            counter("exchange_assembly_overlapped_us_total").inc(us)
+        return _StagedWindow(plan, E, me, lengths, row)
 
+    def _exchange_staged(self, shuffle_id: int,
+                         staged: "_StagedWindow", window: int = -1):
+        """Run the one collective for an assembled window; returns
+        (plan, E, row) with row = this host's destination-row view."""
+        from sparkrdma_tpu.utils.trace import get_tracer
+
+        lengths = staged.lengths
         with get_tracer().span(
-            "shuffle.bulk.exchange", shuffle=shuffle_id, hosts=E,
-            window=window, payload_bytes=int(lengths.sum()),
+            "shuffle.bulk.exchange", shuffle=shuffle_id,
+            hosts=staged.E, window=window,
+            payload_bytes=int(lengths.sum()),
         ):
             result = self._run_exchange(
-                shuffle_id, me, streams, lengths, window=window
+                shuffle_id, staged.me, staged.row, lengths,
+                window=window,
             )
         self.window_events.append(
             (window, time.monotonic(), int(lengths.sum()))
         )
-        return plan, E, result[me]
+        return staged.plan, staged.E, result[staged.me]
 
     def read(self, shuffle_id: int) -> Iterator:
         """Blocking bulk read of this host's partitions (the

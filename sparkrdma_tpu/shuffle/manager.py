@@ -1413,6 +1413,18 @@ class TpuShuffleManager:
         return {"per_host": per_host, "total": total}
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
+        if self.windowed_plane is not None and self.conf.metrics_enabled:
+            # fold the zero-copy plane's window landings into the
+            # shuffle's telemetry before it ships to the driver
+            evs = self.windowed_plane.window_events(shuffle_id)
+            if evs:
+                self._telemetry_add(
+                    shuffle_id,
+                    exchange_windows=len(evs),
+                    exchange_window_payload_bytes=sum(
+                        b for _w, _t, b in evs
+                    ),
+                )
         self._publish_shuffle_telemetry(shuffle_id)
         if (self.conf.metrics_enabled and self.conf.trace
                 and self.conf.metrics_trace_bridge):

@@ -135,7 +135,7 @@ class ShuffleReader:
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
 
     # -- fetch machinery ----------------------------------------------------
-    def _start_remote_fetches(self) -> Iterator[bytes]:
+    def _start_remote_fetches(self) -> Iterator:
         """Kick off async location fetches; returns a LAZY iterator of
         local block payloads (startAsyncRemoteFetches,
         RdmaShuffleFetcherIterator.scala:174-311).  Locals must stream
@@ -207,7 +207,7 @@ class ShuffleReader:
                 self._fail(MetadataFetchFailedError(
                     host.host, self.handle.shuffle_id, str(e)))
 
-        def _iter_local() -> Iterator[bytes]:
+        def _iter_local() -> Iterator:
             # local_blocks/local_bytes count at CONSUMPTION: an
             # abandoned iteration reports only what was actually
             # read (remote counters behave the same — blocks left in
@@ -331,10 +331,15 @@ class ShuffleReader:
         self._results.put(_Result(error=err))
 
     # -- consumption --------------------------------------------------------
-    def _iter_block_bytes(self) -> Iterator[bytes]:
+    def _iter_block_bytes(self) -> Iterator:
         """Blocking consume of raw block payloads: local first, then
         remote completions (hasNext/next,
-        RdmaShuffleFetcherIterator.scala:332-374)."""
+        RdmaShuffleFetcherIterator.scala:332-374).  Payloads are
+        bytes-LIKE, not necessarily ``bytes``: local short-circuits and
+        pooled receives hand back zero-copy views (ndarray/memoryview),
+        exactly like the windowed plane's destination-row slices — the
+        deserializers (utils/serde.py) take any of them without
+        copying."""
         try:
             local_payloads = self._start_remote_fetches()
             yield from local_payloads

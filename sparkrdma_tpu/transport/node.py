@@ -20,6 +20,7 @@ via ``register_passive_channel``.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -68,9 +69,15 @@ class Node:
         self._passive: List[Channel] = []
         self._passive_lock = threading.Lock()
         # completion/dispatch pool — the RdmaThread analog: completions and
-        # inbound frames are delivered off the caller's thread
+        # inbound frames are delivered off the caller's thread.  When
+        # conf dispatcherCpuList (legacy alias: spark.shuffle.rdma
+        # .cpuList) names a CPU subset, every worker pins itself to it
+        # — the RdmaThread comp-vector affinity (RdmaNode.java:216-273)
+        self._cpu_pins = self._parse_cpu_pins()
         self._dispatcher = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix=f"node-{address[0]}:{address[1]}"
+            max_workers=4,
+            thread_name_prefix=f"node-{address[0]}:{address[1]}",
+            initializer=self._pin_worker_thread,
         )
         # bulk work (read service) runs on its OWN pool so multi-MB
         # block serves can never starve control-plane traffic — a
@@ -78,6 +85,33 @@ class Node:
         self._bulk_pool: Optional[ThreadPoolExecutor] = None
         self._bulk_lock = threading.Lock()
         self._stopped = threading.Event()
+
+    # -- dispatcher thread placement ----------------------------------------
+    def _parse_cpu_pins(self) -> Optional[frozenset]:
+        """Expand conf dispatcherCpuList against this host's CPUs for
+        dispatcher-thread affinity.  None (no pinning) when the knob is
+        unset, the platform has no ``sched_setaffinity``, or the parse
+        resolves to every CPU anyway."""
+        spec = self.conf.dispatcher_cpu_list.strip()
+        if not spec or not hasattr(os, "sched_setaffinity"):
+            return None
+        ncpu = os.cpu_count() or 1
+        pins = frozenset(self.conf.parse_dispatcher_cpu_list(ncpu))
+        if not pins or pins == frozenset(range(ncpu)):
+            return None
+        return pins
+
+    def _pin_worker_thread(self) -> None:
+        if not self._cpu_pins:
+            return
+        try:
+            os.sched_setaffinity(0, self._cpu_pins)
+            counter("transport_threads_pinned_total").inc()
+        except OSError as e:
+            logger.warning(
+                "%s: could not pin dispatcher thread to CPUs %s: %s",
+                self, sorted(self._cpu_pins), e,
+            )
 
     # -- receive dispatch ---------------------------------------------------
     def set_receive_listener(self, listener: ReceiveListener) -> None:
@@ -133,6 +167,7 @@ class Node:
                         thread_name_prefix=(
                             f"bulk-{self.address[0]}:{self.address[1]}"
                         ),
+                        initializer=self._pin_worker_thread,
                     )
                 pool = self._bulk_pool
         return pool.submit(fn, *args)

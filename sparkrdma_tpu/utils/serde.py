@@ -21,15 +21,29 @@ Record = Tuple[Any, Any]
 _LEN = struct.Struct("<I")
 
 
+def as_view(data) -> memoryview:
+    """Normalize any bytes-like block payload (``bytes``, ``bytearray``,
+    ``memoryview``, contiguous uint8 ndarray) to a flat memoryview
+    WITHOUT copying — the zero-copy exchange hands deserializers views
+    of its destination rows, and every frame walker below slices this
+    one view instead of materializing ``bytes``."""
+    if isinstance(data, memoryview):
+        return data.cast("B") if data.format != "B" else data
+    return memoryview(data)
+
+
 class Serializer:
     # True when the serializer offers ``deserialize_columns`` (the
-    # columnar fast path); readers route on this flag
+    # columnar fast path); readers route on this flag.  ``data``
+    # arguments throughout are bytes-like: deserializers must accept
+    # memoryview/uint8-ndarray slices of an exchange destination row,
+    # not just materialized ``bytes``.
     supports_columns = False
 
     def serialize(self, records: Iterable[Record]) -> bytes:  # pragma: no cover
         raise NotImplementedError
 
-    def deserialize(self, data: bytes) -> Iterator[Record]:  # pragma: no cover
+    def deserialize(self, data) -> Iterator[Record]:  # pragma: no cover
         raise NotImplementedError
 
 
@@ -55,8 +69,8 @@ class PickleSerializer(Serializer):
             out += raw
         return bytes(out)
 
-    def deserialize(self, data: bytes) -> Iterator[Record]:
-        view = memoryview(data)
+    def deserialize(self, data) -> Iterator[Record]:
+        view = as_view(data)
         off = 0
         while off < len(view):
             if off + _LEN.size > len(view):
@@ -189,7 +203,7 @@ class ColumnarSerializer(Serializer):
 
         return total, chunks
 
-    def deserialize_columns(self, data: bytes):
+    def deserialize_columns(self, data):
         """Fast path: yields :class:`ColumnBatch` per frame (pickle
         frames are re-packed into columns, or raise if unpackable)."""
         from sparkrdma_tpu.utils.columns import ColumnBatch
@@ -207,12 +221,14 @@ class ColumnarSerializer(Serializer):
                         "pickle serializer"
                     ) from e
 
-    def _iter_items(self, data: bytes):
+    def _iter_items(self, data):
         """Walk frames: yields a ColumnBatch per columnar frame, a raw
-        record list per pickle-fallback frame."""
+        record list per pickle-fallback frame.  ``data`` may be any
+        bytes-like; column arrays come out as zero-copy views over it
+        (keep the backing row alive while the batches are)."""
         from sparkrdma_tpu.utils.columns import ColumnBatch
 
-        view = memoryview(data)
+        view = as_view(data)
         off = 0
         total = len(view)
         while off < total:
@@ -253,7 +269,7 @@ class ColumnarSerializer(Serializer):
             off += vbytes
             yield ColumnBatch(keys, vals, key_sorted=bool(flags & 1))
 
-    def deserialize(self, data: bytes) -> Iterator[Record]:
+    def deserialize(self, data) -> Iterator[Record]:
         # ColumnBatch and raw record lists both iterate as (k, v)
         for item in self._iter_items(data):
             yield from item
@@ -338,8 +354,8 @@ class CompressedSerializer(Serializer):
             )
         return bytes([tag]) + _LEN.pack(len(body)) + body
 
-    def _iter_frames(self, data: bytes) -> Iterator[bytes]:
-        view = memoryview(data)
+    def _iter_frames(self, data) -> Iterator[bytes]:
+        view = as_view(data)
         off = 0
         while off < len(view):
             if off + 1 + _LEN.size > len(view):
@@ -367,7 +383,7 @@ class CompressedSerializer(Serializer):
             else:
                 raise ValueError(f"unknown codec tag {tag}")
 
-    def deserialize(self, data: bytes) -> Iterator[Record]:
+    def deserialize(self, data) -> Iterator[Record]:
         for raw in self._iter_frames(data):
             yield from self.inner.deserialize(raw)
 

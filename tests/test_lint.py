@@ -66,3 +66,40 @@ def test_py08_ignores_non_library_code(tmp_path):
     findings = []
     lint.lint_python(bench, findings, root=tmp_path)
     assert not [f for f in findings if f[2] == "PY08"], findings
+
+
+def test_py09_flags_hot_path_materialization(tmp_path):
+    """.tobytes() / b"".join in the exchange hot paths regress the
+    zero-copy data path; PY09 pins them out (noqa escapes)."""
+    lint = _load_lint()
+    lib = tmp_path / "sparkrdma_tpu"
+    (lib / "parallel").mkdir(parents=True)
+    (lib / "shuffle").mkdir()
+
+    hot = lib / "parallel" / "exchange.py"
+    hot.write_text(
+        "def f(a, parts):\n"
+        "    x = a.tobytes()\n"
+        '    y = b"".join(parts)\n'
+        "    z = a.tobytes()  # noqa\n"
+        "    return x, y, z\n"
+    )
+    hot2 = lib / "shuffle" / "bulk.py"
+    hot2.write_text("def g(a):\n    return a.tobytes()\n")
+    cold = lib / "shuffle" / "writer.py"
+    cold.write_text(
+        'def h(a, parts):\n    return a.tobytes(), b"".join(parts)\n'
+    )
+
+    findings = []
+    for f in (hot, hot2, cold):
+        lint.lint_python(f, findings, root=tmp_path)
+    py09 = sorted(
+        (str(rel), line) for rel, line, code, _m in findings
+        if code == "PY09"
+    )
+    assert py09 == [
+        ("sparkrdma_tpu/parallel/exchange.py", 2),
+        ("sparkrdma_tpu/parallel/exchange.py", 3),
+        ("sparkrdma_tpu/shuffle/bulk.py", 2),
+    ], findings

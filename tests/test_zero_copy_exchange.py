@@ -1,0 +1,477 @@
+"""Zero-copy pipelined bulk-exchange data path (ISSUE 2):
+
+- ``TileExchange.exchange_into``: preallocated contiguous source rows
+  in, destination-row VIEWS out — bit-exact with ``exchange_bytes``.
+- ``BulkShuffleSession`` accepting array rows (and downgrading mixed
+  legacy/array rounds).
+- The double-buffered windowed pipeline: bit-exact vs the serial loop,
+  prompt failure of in-flight AND being-assembled windows on abort.
+- The tier-1 perf smoke: assembly materializes no per-block ``bytes``
+  (copy counter stays zero) while the zero-copy counters move.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.parallel.exchange import (
+    DestRowView,
+    TileExchange,
+    row_offsets,
+)
+from sparkrdma_tpu.parallel.mesh import make_mesh
+from sparkrdma_tpu.shuffle.bulk import BulkShuffleSession
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.transport import LoopbackNetwork
+
+
+def test_row_offsets():
+    offs = row_offsets([3, 0, 5, 2])
+    assert offs.tolist() == [0, 3, 3, 8, 10]
+    assert row_offsets([]).tolist() == [0]
+
+
+def test_dest_row_view_slices():
+    buf = np.arange(10, dtype=np.uint8)
+    v = DestRowView(buf, row_offsets([4, 0, 6]))
+    assert len(v) == 3
+    assert v[0].tolist() == [0, 1, 2, 3]
+    assert v[1].tolist() == []
+    assert v[2].tolist() == [4, 5, 6, 7, 8, 9]
+    assert v.nbytes == 10
+    # zero-copy: slices share the row buffer
+    assert v[2].base is buf or v[2].base is v[2].base
+
+
+def _random_lengths(rng, D, max_len=4000):
+    return rng.integers(0, max_len, size=(D, D)).astype(np.int64)
+
+
+def _rows_from_streams(streams, lengths):
+    """Pack per-pair byte streams into contiguous per-source rows."""
+    rows = {}
+    for s in range(len(streams)):
+        offs = row_offsets(lengths[s])
+        row = np.empty(int(offs[-1]), np.uint8)
+        for d in range(len(streams)):
+            if lengths[s][d]:
+                row[int(offs[d]):int(offs[d + 1])] = np.frombuffer(
+                    streams[s][d], np.uint8
+                )
+        rows[s] = row
+    return rows
+
+
+def test_exchange_into_matches_exchange_bytes(devices):
+    mesh = make_mesh(8)
+    ex = TileExchange(mesh, tile_bytes=1 << 10)
+    D = ex.n_devices
+    rng = np.random.default_rng(7)
+    lengths = _random_lengths(rng, D)
+    streams = [
+        [rng.bytes(int(lengths[s, d])) for d in range(D)]
+        for s in range(D)
+    ]
+    legacy = ex.exchange_bytes(streams, lengths=lengths)
+    rows = _rows_from_streams(streams, lengths)
+    result = ex.exchange_into(lengths, rows)
+    for d in range(D):
+        view = result[d]
+        assert isinstance(view, DestRowView)
+        for s in range(D):
+            got = view[s]
+            assert bytes(memoryview(got)) == legacy[d][s], (s, d)
+            assert bytes(memoryview(got)) == streams[s][d], (s, d)
+
+
+def test_exchange_into_multi_round(devices):
+    """Small tiles force many rounds through the in-flight window; the
+    round/offset bookkeeping must reassemble every stream exactly."""
+    mesh = make_mesh(8)
+    ex = TileExchange(mesh, tile_bytes=256, max_rounds_in_flight=3)
+    D = ex.n_devices
+    rng = np.random.default_rng(8)
+    lengths = _random_lengths(rng, D, max_len=5000)
+    streams = [
+        [rng.bytes(int(lengths[s, d])) for d in range(D)]
+        for s in range(D)
+    ]
+    result = ex.exchange_into(
+        lengths, _rows_from_streams(streams, lengths)
+    )
+    for d in range(D):
+        for s in range(D):
+            assert bytes(memoryview(result[d][s])) == streams[s][d]
+    assert ex.rounds_executed > 3
+
+
+def test_exchange_into_empty(devices):
+    ex = TileExchange(make_mesh(4))
+    lengths = np.zeros((4, 4), np.int64)
+    result = ex.exchange_into(
+        lengths, {s: np.empty(0, np.uint8) for s in range(4)}
+    )
+    for d in range(4):
+        for s in range(4):
+            assert len(result[d][s]) == 0
+
+
+def test_exchange_into_validates_rows(devices):
+    ex = TileExchange(make_mesh(4), tile_bytes=1 << 10)
+    lengths = np.full((4, 4), 10, np.int64)
+    rows = {s: np.zeros(40, np.uint8) for s in range(4)}
+    with pytest.raises(ValueError, match="vouched source"):
+        ex.exchange_into(lengths, {s: rows[s] for s in range(3)},
+                         local_sources=frozenset(range(4)))
+    rows[2] = np.zeros(39, np.uint8)  # one byte short
+    with pytest.raises(ValueError, match="source row 2"):
+        ex.exchange_into(lengths, rows)
+
+
+def test_exchange_into_integrity_and_out_alloc(devices):
+    ex = TileExchange(make_mesh(4), tile_bytes=512,
+                      verify_integrity=True)
+    rng = np.random.default_rng(9)
+    lengths = _random_lengths(rng, 4, max_len=2000)
+    streams = [
+        [rng.bytes(int(lengths[s, d])) for d in range(4)]
+        for s in range(4)
+    ]
+    allocs = []
+
+    def alloc(n):
+        buf = np.empty(n, np.uint8)
+        allocs.append(n)
+        return buf
+
+    result = ex.exchange_into(
+        lengths, _rows_from_streams(streams, lengths), out_alloc=alloc
+    )
+    assert ex.stats()["integrity_failures"] == 0
+    # destination rows really came from the caller's allocator, sized
+    # at each destination's exact column sum
+    expect = sorted(
+        int(lengths[:, d].sum()) for d in range(4)
+        if int(lengths[:, d].sum())
+    )
+    assert sorted(allocs) == expect
+    for d in range(4):
+        for s in range(4):
+            assert bytes(memoryview(result[d][s])) == streams[s][d]
+
+
+def test_session_array_and_mixed_rows(devices):
+    """Array rows ride exchange_into; a mixed round (one legacy list
+    contributor) downgrades to the bytes path with identical output."""
+    E = 2
+    rng = np.random.default_rng(11)
+    lengths = np.array([[100, 200], [300, 50]], np.int64)
+    streams = [
+        [rng.bytes(int(lengths[s, d])) for d in range(E)]
+        for s in range(E)
+    ]
+    rows = _rows_from_streams(streams, lengths)
+
+    for mixed in (False, True):
+        session = BulkShuffleSession(
+            TileExchange(make_mesh(E), tile_bytes=1 << 12), E
+        )
+        out = {}
+
+        def run(me, contribution):
+            out[me] = session.run(me, contribution, lengths)
+
+        contrib1 = list(streams[1]) if mixed else rows[1]
+        t = threading.Thread(
+            target=run, args=(1, contrib1), daemon=True
+        )
+        t.start()
+        time.sleep(0.05)
+        run(0, rows[0])
+        t.join(timeout=30)
+        for me in range(E):
+            row = out[me][me]
+            for s in range(E):
+                assert bytes(memoryview(row[s])) == streams[s][me], (
+                    mixed, me, s,
+                )
+
+
+# -- windowed plane: pipelined vs serial -------------------------------------
+
+def _cluster(base_port, conf_extra=None, n_exec=2):
+    from sparkrdma_tpu.shuffle.bulk import WindowedReadPlane
+
+    net = LoopbackNetwork()
+    overrides = {
+        "spark.shuffle.tpu.driverPort": base_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "15s",
+        "spark.shuffle.tpu.bulkWindowMaps": "2",
+        "spark.shuffle.tpu.readPlane": "windowed",
+    }
+    overrides.update(conf_extra or {})
+    conf = TpuShuffleConf(overrides)
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=base_port + 100 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(n_exec)
+    ]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == n_exec for e in executors):
+            break
+        time.sleep(0.01)
+    session = BulkShuffleSession(
+        TileExchange(make_mesh(n_exec), tile_bytes=1 << 12), n_exec,
+        timeout_s=conf.bulk_barrier_timeout_ms / 1000.0,
+    )
+    for e in executors:
+        e.windowed_plane = WindowedReadPlane(e, session=session)
+    return net, conf, driver, executors, session
+
+
+def _write_maps(driver, executors, sid, num_maps, num_parts, seed=0):
+    rng = np.random.default_rng(seed)
+    part = HashPartitioner(num_parts)
+    handle = driver.register_shuffle(sid, num_maps, part)
+    records_per_map = [
+        [(f"m{m}k{j}", rng.bytes(int(rng.integers(1, 200))))
+         for j in range(30)]
+        for m in range(num_maps)
+    ]
+    for m, recs in enumerate(records_per_map):
+        w = executors[m % len(executors)].get_writer(handle, m)
+        w.write(recs)
+        w.stop(True)
+    return handle, part, records_per_map
+
+
+def _read_all_blocks(executors, handle, num_parts):
+    """Every partition's raw block payloads via reducer-issued reads;
+    returns {pid: [bytes]} (payloads materialized for comparison)."""
+    E = len(executors)
+    out, errs = {}, {}
+
+    def reduce_task(pid):
+        try:
+            r = executors[pid % E].get_reader(handle, pid, pid + 1, {})
+            out[pid] = [
+                bytes(memoryview(b)) if not isinstance(b, bytes)
+                else b
+                for b in r._iter_block_bytes()
+            ]
+        except BaseException as e:
+            errs[pid] = e
+
+    threads = [
+        threading.Thread(target=reduce_task, args=(p,), daemon=True)
+        for p in range(num_parts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    return out
+
+
+def test_windowed_pipelined_bit_exact_vs_serial(devices):
+    """The double-buffer sweep: identical shuffle data through the
+    pipelined and serial window loops yields byte-identical block
+    streams per partition."""
+    blocks_by_mode = {}
+    for base_port, pipelined in ((52200, True), (52400, False)):
+        net, conf, driver, executors, _session = _cluster(
+            base_port,
+            {"spark.shuffle.tpu.bulkPipelineWindows": str(pipelined)},
+        )
+        try:
+            handle, _part, _recs = _write_maps(
+                driver, executors, 210, num_maps=6, num_parts=6,
+                seed=42,
+            )
+            blocks_by_mode[pipelined] = _read_all_blocks(
+                executors, handle, 6
+            )
+        finally:
+            for m in executors + [driver]:
+                m.stop()
+    assert blocks_by_mode[True] == blocks_by_mode[False]
+    assert any(v for v in blocks_by_mode[True].values())
+
+
+def test_windowed_pipeline_abort_fails_all_windows_promptly(devices):
+    """Poisoning the session mid-pipeline fails the in-flight window
+    AND the being-assembled one: readers get FetchFailedError fast, no
+    stage thread rides out the plan/barrier timeout."""
+    from sparkrdma_tpu.shuffle.reader import FetchFailedError
+
+    net, conf, driver, executors, session = _cluster(
+        52600, {"spark.shuffle.tpu.bulkPipelineWindows": "true"}
+    )
+    try:
+        E = len(executors)
+        num_maps, num_parts = 6, 4
+        part = HashPartitioner(num_parts)
+        handle = driver.register_shuffle(211, num_maps, part)
+        for m in range(3):  # window 0 plannable; windows 1+ straggle
+            w = executors[m % E].get_writer(handle, m)
+            w.write([(f"m{m}k{j}", j) for j in range(20)])
+            w.stop(True)
+        results, errors = {}, {}
+
+        def reduce_task(pid):
+            try:
+                r = executors[pid % E].get_reader(
+                    handle, pid, pid + 1, {}
+                )
+                results[pid] = list(r.read())
+            except BaseException as e:
+                errors[pid] = e
+
+        threads = [
+            threading.Thread(target=reduce_task, args=(p,),
+                             daemon=True)
+            for p in range(num_parts)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(
+                e.windowed_plane.window_events(211) for e in executors
+            ):
+                break
+            time.sleep(0.01)
+        assert all(
+            e.windowed_plane.window_events(211) for e in executors
+        ), "window 0 never exchanged"
+        # the pipeline is now parked: window 1's plan barrier waits for
+        # unpublished maps while its assembler sits in flight — poison
+        t0 = time.monotonic()
+        session.abort(RuntimeError("mid-pipeline participant loss"))
+        for t in threads:
+            t.join(timeout=20)
+        took = time.monotonic() - t0
+        assert not any(t.is_alive() for t in threads), "reader hung"
+        assert not results, results
+        assert set(errors) == set(range(num_parts))
+        assert all(
+            isinstance(e, FetchFailedError) for e in errors.values()
+        ), errors
+        assert took < 15, f"abort took {took:.1f}s"
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+def test_windowed_zero_copy_smoke_counters(devices):
+    """Tier-1 perf smoke (loopback, small payload): the assembly path
+    materializes NO per-block bytes (counter absent/zero) while the
+    zero-copy counters move, and at least one window staged while
+    another exchanged."""
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.reset()
+    GLOBAL_REGISTRY.enabled = True
+    try:
+        net, conf, driver, executors, _session = _cluster(
+            52800, {
+                "spark.shuffle.tpu.metrics": "true",
+                "spark.shuffle.tpu.bulkPipelineWindows": "true",
+            }
+        )
+        try:
+            handle, part, recs = _write_maps(
+                driver, executors, 212, num_maps=4, num_parts=4,
+                seed=3,
+            )
+            got = _read_all_blocks(executors, handle, 4)
+            assert any(got.values())
+        finally:
+            for m in executors + [driver]:
+                m.stop()
+        snap = GLOBAL_REGISTRY.snapshot()
+        vals = {}
+        for c in snap["counters"]:
+            vals[c["name"]] = vals.get(c["name"], 0) + c["value"]
+        assert vals.get("exchange_assembly_bytes_total", 0) > 0
+        assert vals.get(
+            "exchange_assembly_materialized_blocks_total", 0
+        ) == 0, "assembly materialized per-block bytes"
+        assert vals.get("exchange_copy_bytes_avoided_total", 0) > 0
+        assert vals.get("exchange_windows_pipelined_total", 0) >= 1
+    finally:
+        GLOBAL_REGISTRY.enabled = prev
+        GLOBAL_REGISTRY.reset()
+
+
+# -- transport dispatcher CPU pinning (conf dispatcherCpuList) ---------------
+
+def test_dispatcher_cpu_list_conf_parses():
+    """The knob parses (range syntax, legacy alias, all-CPUs default)
+    on every platform — pinning itself is covered below where
+    sched_setaffinity exists."""
+    conf = TpuShuffleConf({"spark.shuffle.rdma.cpuList": "0-1,3"})
+    assert conf.parse_dispatcher_cpu_list(8) == [0, 1, 3]
+    explicit = TpuShuffleConf(
+        {"spark.shuffle.tpu.dispatcherCpuList": "2"}
+    )
+    assert explicit.parse_dispatcher_cpu_list(4) == [2]
+    assert TpuShuffleConf().parse_dispatcher_cpu_list(4) == [0, 1, 2, 3]
+    # deviceList remains a separate (mesh-device) namespace
+    dev = TpuShuffleConf({"spark.shuffle.tpu.deviceList": "0"})
+    assert dev.dispatcher_cpu_list == ""
+
+
+@pytest.mark.skipif(
+    not hasattr(__import__("os"), "sched_setaffinity"),
+    reason="platform has no sched_setaffinity",
+)
+def test_dispatcher_threads_pinned_to_device_list():
+    import os
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("needs >= 2 CPUs to observe a restricted mask")
+    from sparkrdma_tpu.transport.node import Node
+
+    # legacy reference spelling (spark.shuffle.rdma.cpuList) aliases
+    # onto dispatcherCpuList — the RdmaThread comp-vector pinning
+    # analog (deviceList stays a mesh-DEVICE selector)
+    conf = TpuShuffleConf({"spark.shuffle.rdma.cpuList": "0"})
+    assert conf.dispatcher_cpu_list == "0"
+    assert conf.parse_dispatcher_cpu_list(os.cpu_count()) == [0]
+    node = Node(("127.0.0.1", 0), conf)
+    try:
+        got = node.submit(
+            lambda: sorted(os.sched_getaffinity(0))
+        ).result(timeout=10)
+        assert got == [0], got
+    finally:
+        node.stop()
+
+
+def test_dispatcher_unpinned_without_device_list():
+    import os
+
+    from sparkrdma_tpu.transport.node import Node
+
+    node = Node(("127.0.0.1", 0), TpuShuffleConf())
+    try:
+        assert node._cpu_pins is None
+        if hasattr(os, "sched_getaffinity"):
+            got = node.submit(
+                lambda: sorted(os.sched_getaffinity(0))
+            ).result(timeout=10)
+            assert got == sorted(os.sched_getaffinity(0))
+    finally:
+        node.stop()

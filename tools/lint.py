@@ -22,6 +22,11 @@ Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
         metric timing must flow through the registry/tracer (use
         ``Histogram.time()`` or ``time.monotonic()`` for plain
         interval math)
+  PY09  ``.tobytes()`` call or ``b"".join`` in the exchange hot paths
+        (sparkrdma_tpu/parallel/exchange.py, sparkrdma_tpu/shuffle/
+        bulk.py) — the zero-copy data path stages into preallocated
+        contiguous rows; per-block ``bytes`` materialization there is
+        a regression (suppress a deliberate one with ``# noqa``)
 
 C++ (native/):
   CC01  line longer than 100 characters
@@ -80,6 +85,28 @@ class _ImportUsage(ast.NodeVisitor):
 
     def visit_Attribute(self, node):
         self.generic_visit(node)
+
+
+# zero-copy exchange hot paths: PY09 bans per-block bytes
+# materialization (.tobytes() / b"".join) inside these files
+HOT_PATHS = (
+    pathlib.Path("sparkrdma_tpu/parallel/exchange.py"),
+    pathlib.Path("sparkrdma_tpu/shuffle/bulk.py"),
+)
+
+
+def _is_hot_path_copy(node: ast.Call) -> bool:
+    """``x.tobytes(...)`` or ``b"".join(...)``."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr == "tobytes":
+        return True
+    return (
+        f.attr == "join"
+        and isinstance(f.value, ast.Constant)
+        and f.value.value == b""
+    )
 
 
 def _perf_counter_exempt(path: pathlib.Path, lib_dir: pathlib.Path) -> bool:
@@ -173,6 +200,21 @@ def lint_python(path: pathlib.Path, findings: list,
                 (rel, node.lineno, "PY08",
                  "time.perf_counter() in library code (metric timing "
                  "goes through metrics/ or utils/trace.py)")
+            )
+        if (
+            rel in HOT_PATHS
+            and isinstance(node, ast.Call)
+            and _is_hot_path_copy(node)
+            and "# noqa" not in (
+                lines[node.lineno - 1] if node.lineno <= len(lines)
+                else ""
+            )
+        ):
+            findings.append(
+                (rel, node.lineno, "PY09",
+                 'per-block bytes materialization (.tobytes()/b"".join)'
+                 " in an exchange hot path (stage into preallocated "
+                 "rows instead)")
             )
 
 
