@@ -1,25 +1,225 @@
 #!/usr/bin/env python
-"""BASELINE config 2: 2-executor reduceByKey over the loopback transport.
+"""BASELINE config 2: 2-executor reduceByKey over the loopback transport,
+plus the striped-fetch sweep.
 
 The reference's second measurement config is a 2-executor
 RdmaShuffleManager run with the bypass serializer (BASELINE.md).  Here:
 two executor managers + a driver on the loopback network, reduceByKey
 with map-side combine, raw-bytes-free int payloads.  Reported as
 records/s through the full control+data plane.
+
+The striped-fetch sweep (``BENCH_striped_fetch.json``) measures the
+remote block-fetch data path over REAL sockets: stripes ∈ {1, 2, 4} ×
+payload sizes, all against the single-channel pre-striping wire path
+(``transportScatterGather=off``, one data lane — concat+sendall serve,
+whole-frame receive) as baseline, plus RPC echo latency while bulk
+reads saturate the data lanes (the head-of-line-blocking check).
 """
 
 import sys
+import threading
 import time
 
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import emit, maybe_spoof_cpu
+from benchmarks.common import RESULTS, emit, maybe_spoof_cpu
 
 from sparkrdma_tpu.api import TpuShuffleContext
 
 N_RECORDS = 300_000
 N_KEYS = 1024
+
+BASE_PORT = 46300
+STORE_BYTES = 32 << 20
+SWEEP_STRIPES = (1, 2, 4)
+SWEEP_SIZES = (1 << 20, 8 << 20, 32 << 20)
+TARGET_MOVE = 192 << 20  # bytes moved per (config, size) measurement
+RPC_SAMPLES = 400
+
+
+def _fetch_config(name, port, stripes, scatter_gather):
+    """One measurement config: nodes+network over real sockets, a
+    registered 32 MiB store, and the per-peer read group."""
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.memory.arena import ArenaManager
+    from sparkrdma_tpu.transport import TcpNetwork
+    from sparkrdma_tpu.transport.node import Node
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportNumStripes": stripes,
+        "spark.shuffle.tpu.transportStripeThreshold": "256k",
+        "spark.shuffle.tpu.transportScatterGather": scatter_gather,
+    })
+    net = TcpNetwork()
+    a = Node(("127.0.0.1", port), conf)
+    b = Node(("127.0.0.1", port + 5), conf)
+    net.register(a)
+    net.register(b)
+    arena = ArenaManager()
+    data = (np.arange(STORE_BYTES, dtype=np.uint32) % 251).astype(np.uint8)
+    seg = arena.register(data, zero_copy_ok=True)
+    b.register_block_store(seg.mkey, arena)
+    group = a.get_read_group(b.address, net.connect)
+    return {
+        "name": name, "net": net, "a": a, "b": b, "mkey": seg.mkey,
+        "group": group, "arena": arena,
+    }
+
+
+def _teardown_config(cfg):
+    cfg["a"].stop()
+    cfg["b"].stop()
+    cfg["net"].unregister(cfg["a"])
+    cfg["net"].unregister(cfg["b"])
+
+
+def _read_once(cfg, size, timeout=120):
+    from sparkrdma_tpu.transport.channel import FnCompletionListener
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    done = threading.Event()
+    err = []
+    cfg["group"].read_blocks(
+        [BlockLocation(0, size, cfg["mkey"])],
+        FnCompletionListener(
+            lambda blocks: done.set(),
+            lambda e: (err.append(e), done.set()),
+        ),
+    )
+    if not done.wait(timeout):
+        raise RuntimeError("fetch hung")
+    if err:
+        raise err[0]
+
+
+def _fetch_throughput(cfg, size):
+    """GB/s of sequential whole-block fetches totalling TARGET_MOVE."""
+    iters = max(2, TARGET_MOVE // size)
+    _read_once(cfg, size)  # warmup (connects the lanes)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _read_once(cfg, size)
+    dt = time.perf_counter() - t0
+    return iters * size / dt / 1e9
+
+
+def _rpc_latency_under_bulk(cfg, bulk_size=8 << 20):
+    """Median RPC echo RTT (ms) while a background loop keeps bulk
+    striped reads saturating the data lanes."""
+    from sparkrdma_tpu.transport.channel import (
+        ChannelType,
+        FnCompletionListener,
+    )
+
+    a, b, net = cfg["a"], cfg["b"], cfg["net"]
+    pong = {"event": threading.Event()}
+
+    def echo(channel, frame):
+        channel.reply_channel().send_rpc([frame], FnCompletionListener())
+
+    def on_pong(_channel, _frame):
+        pong["event"].set()
+
+    b.set_receive_listener(echo)
+    a.set_receive_listener(on_pong)
+    rpc_ch = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, net.connect)
+    stop = threading.Event()
+    bulk_reads = [0]
+
+    def bulk_loop():
+        while not stop.is_set():
+            _read_once(cfg, bulk_size)
+            bulk_reads[0] += 1
+
+    t = threading.Thread(target=bulk_loop, daemon=True)
+    t.start()
+    time.sleep(0.05)  # bulk in flight before sampling
+    lat = []
+    for _ in range(RPC_SAMPLES):
+        pong["event"].clear()
+        t0 = time.perf_counter()
+        rpc_ch.send_rpc([b"ping"], FnCompletionListener())
+        if not pong["event"].wait(10):
+            raise RuntimeError("rpc echo hung under bulk load")
+        lat.append((time.perf_counter() - t0) * 1000)
+    stop.set()
+    t.join(timeout=30)
+    if bulk_reads[0] == 0:
+        # an unloaded link would fake the head-of-line-blocking number
+        raise RuntimeError("bulk loop made no reads during RPC sampling")
+    lat.sort()
+    return lat[len(lat) // 2]
+
+
+def striped_fetch_sweep():
+    """stripes × payload-size sweep vs the single-channel baseline;
+    writes BENCH_striped_fetch.json with the metrics snapshot."""
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+
+    GLOBAL_REGISTRY.enabled = True
+    port = BASE_PORT
+    baseline = {}
+    cfg = _fetch_config("single-channel baseline", port, 1, "off")
+    try:
+        for size in SWEEP_SIZES:
+            baseline[size] = _fetch_throughput(cfg, size)
+            emit(
+                f"remote fetch {size >> 20}MiB single-channel baseline "
+                f"(stripes=1, scatter-gather off)",
+                baseline[size], "GB/s", 1.0,
+            )
+        base_rpc = _rpc_latency_under_bulk(cfg)
+        emit(
+            "RPC echo p50 under concurrent bulk reads "
+            "(single-channel baseline)",
+            base_rpc, "ms", 1.0,
+        )
+    finally:
+        _teardown_config(cfg)
+
+    best = {"ratio": 0.0, "stripes": 1, "size": 0, "gbps": 0.0}
+    rpc_striped = None
+    for stripes in SWEEP_STRIPES:
+        port += 20
+        cfg = _fetch_config(f"stripes={stripes}", port, stripes, "on")
+        try:
+            for size in SWEEP_SIZES:
+                gbps = _fetch_throughput(cfg, size)
+                ratio = gbps / baseline[size]
+                emit(
+                    f"remote fetch {size >> 20}MiB stripes={stripes} "
+                    f"scatter-gather",
+                    gbps, "GB/s", ratio,
+                )
+                if ratio > best["ratio"]:
+                    best.update(ratio=ratio, stripes=stripes,
+                                size=size, gbps=gbps)
+            if stripes == max(SWEEP_STRIPES):
+                rpc_striped = _rpc_latency_under_bulk(cfg)
+                emit(
+                    f"RPC echo p50 under concurrent bulk reads "
+                    f"(stripes={stripes})",
+                    rpc_striped, "ms",
+                    base_rpc / rpc_striped if rpc_striped else 1.0,
+                )
+        finally:
+            _teardown_config(cfg)
+
+    emit(
+        f"best striped fetch vs single-channel baseline "
+        f"(stripes={best['stripes']}, {best['size'] >> 20}MiB)",
+        best["gbps"], "GB/s", best["ratio"],
+    )
+    from benchmarks.common import write_bench_json
+
+    write_bench_json("striped_fetch", extra={
+        "baseline": "single TCP data channel, scatter-gather off "
+                    "(pre-striping wire path)",
+        "best": best,
+        "rpc_p50_ms": {"baseline": base_rpc, "striped": rpc_striped},
+    })
+    GLOBAL_REGISTRY.enabled = False
 
 
 def main():
@@ -47,6 +247,8 @@ def main():
     from benchmarks.common import write_bench_json
 
     write_bench_json("reduce_loopback")
+    RESULTS.clear()
+    striped_fetch_sweep()
 
 
 if __name__ == "__main__":

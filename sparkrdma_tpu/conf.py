@@ -198,6 +198,62 @@ class TpuShuffleConf:
         hatch.  0 disables (all commits stay in memory/HBM)."""
         return self._bytes_in_range("fileBackedCommitBytes", 0, 0, 1 << 44)
 
+    # -- transport striping / scatter-gather / read serving -----------------
+    @property
+    def transport_num_stripes(self) -> int:
+        """Data channels per peer for striped block reads (the channel
+        group's bulk lanes).  Block reads larger than
+        ``transportStripeThreshold`` are chunked round-robin across this
+        many dedicated READ channels and reassembled zero-copy into one
+        pooled destination row; small reads and RPCs keep their own
+        channel so metadata never queues behind bulk bytes (the
+        reference's RPC vs RDMA_READ channel split, RdmaChannel.java:41,
+        extended with fabric-lib-style striping).  1 disables striping
+        (single data channel per peer)."""
+        return self._int_in_range(
+            "transportNumStripes", min(4, os.cpu_count() or 1), 1, 16
+        )
+
+    @property
+    def transport_stripe_threshold(self) -> int:
+        """Block reads strictly larger than this are striped across the
+        peer's data channels; smaller reads ride the dedicated
+        small-read channel whole."""
+        return self._bytes_in_range(
+            "transportStripeThreshold", 512 << 10, 64 << 10, 1 << 30
+        )
+
+    @property
+    def transport_scatter_gather(self) -> bool:
+        """Scatter-gather socket I/O on the TCP data path: frames go
+        out as ``sendmsg`` iovecs (header + length prefixes + block
+        views, no concatenation copy) and read responses land via
+        ``recv_into`` pre-sized pooled/destination buffers.  ``off``
+        restores the pre-striping concat+``sendall`` wire path (same
+        framing — the two interoperate) for A/B measurement."""
+        return self._bool("transportScatterGather", True)
+
+    @property
+    def transport_serve_threads(self) -> int:
+        """Worker threads on the node's read-serve pool (one-sided READ
+        service).  Serving runs off the channel reader loops so one
+        large serve never head-of-line-blocks completions on its
+        channel."""
+        return self._int_in_range(
+            "transportServeThreads", min(4, os.cpu_count() or 1), 1, 64
+        )
+
+    @property
+    def transport_serve_credit_bytes(self) -> int:
+        """Byte-credit budget of the read-serve pool: the total
+        requested bytes of serves running concurrently is capped here,
+        so a slow reducer draining many bulk responses cannot pin
+        unbounded server memory (responder-side flow control; the
+        recv-WR credit scheme's serve-side analog)."""
+        return self._bytes_in_range(
+            "transportServeCreditBytes", 64 << 20, 1 << 20, 1 << 40
+        )
+
     # -- memory / arenas (reference: maxBufferAllocationSize, ODP) ----------
     @property
     def max_buffer_allocation_size(self) -> int:

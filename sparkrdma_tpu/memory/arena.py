@@ -120,7 +120,10 @@ DIRECT_READ_MIN = 1 << 20
 def _read_spans_clustered(spans, fetch):
     """Serve ``(offset, length)`` spans via ``fetch(lo, hi)`` range
     reads, one per proximity cluster (gaps above READ_MANY_MAX_GAP are
-    skipped rather than transferred).  Returns blocks in input order."""
+    skipped rather than transferred).  Returns blocks in input order —
+    as zero-copy CHUNK VIEWS of each cluster's landed buffer (the view
+    keeps the cluster alive by refcount; re-materializing every block
+    as ``bytes`` doubled the serve path's copies)."""
     order = sorted(range(len(spans)), key=lambda i: spans[i][0])
     out: list = [b""] * len(spans)
     cluster: list = []
@@ -134,7 +137,7 @@ def _read_spans_clustered(spans, fetch):
         buf = fetch(clo, chi)
         for i in cluster:
             o, ln = spans[i]
-            out[i] = bytes(buf[o - clo : o - clo + ln])
+            out[i] = buf[o - clo : o - clo + ln]
         cluster.clear()
 
     for i in order:

@@ -275,6 +275,23 @@ def native_hash_partition_order(keys: np.ndarray, num_partitions: int,
     return order, counts
 
 
+def alloc_row_gc(pool, nbytes: int, fallback_counter: str) -> np.ndarray:
+    """One pooled contiguous row sized exactly ``nbytes`` whose release
+    is tied to GC of the returned view (``StagingPool.alloc_gc``) —
+    shared by the bulk-exchange source rows and the striped-transport
+    destination rows.  Falls back to a plain numpy buffer (counting the
+    fallback under ``fallback_counter``) when no pool is wired or its
+    budget is exhausted."""
+    if nbytes <= 0:
+        return np.empty(0, np.uint8)
+    if pool is not None:
+        try:
+            return pool.alloc_gc(nbytes)[:nbytes]
+        except MemoryError:
+            counter(fallback_counter).inc()
+    return np.empty(nbytes, np.uint8)
+
+
 class StagingBuffer:
     """One pooled, page-aligned host buffer exposed as a numpy view."""
 

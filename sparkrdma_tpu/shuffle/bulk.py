@@ -887,15 +887,12 @@ class BulkExchangeReader:
         pool recycles the buffer once the last view of it dies, which
         is what makes the double-buffered windows a TWO-buffer steady
         state instead of an allocation per window."""
-        if nbytes <= 0:
-            return np.empty(0, np.uint8)
-        pool = getattr(self.manager, "staging_pool", None)
-        if pool is not None:
-            try:
-                return pool.alloc_gc(nbytes)[:nbytes]
-            except MemoryError:
-                counter("exchange_row_pool_fallbacks_total").inc()
-        return np.empty(nbytes, np.uint8)
+        from sparkrdma_tpu.memory.staging import alloc_row_gc
+
+        return alloc_row_gc(
+            getattr(self.manager, "staging_pool", None), nbytes,
+            "exchange_row_pool_fallbacks_total",
+        )
 
     def _assemble(self, shuffle_id: int, plan, window: int = -1,
                   overlapped: bool = False) -> "_StagedWindow":

@@ -28,7 +28,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
-from sparkrdma_tpu.transport.channel import ChannelType, FnCompletionListener
+from sparkrdma_tpu.transport.channel import FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
 from sparkrdma_tpu.utils.serde import Record
 from sparkrdma_tpu.utils.trace import get_tracer
@@ -288,11 +288,38 @@ class ShuffleReader:
 
     def _issue(self, fetch: _PendingFetch) -> None:
         t0 = time.monotonic()
+        progressed = [0]
+        settled = [False]
+
+        def on_progress(n):
+            # stripe-granular window accounting: each landed stripe (or
+            # small block) frees its bytes from the in-flight window
+            # IMMEDIATELY, so the next pending fetch can issue while
+            # the rest of a big striped block is still crossing the
+            # wire — the window throttles bytes, not whole blocks
+            with self._pending_lock:
+                if settled[0]:
+                    # a lane's progress racing the group's completion/
+                    # failure must not release bytes settle() already
+                    # reclaimed (the window would over-admit)
+                    return
+                progressed[0] += n
+                self._bytes_in_flight -= n
+            self._pump()
+
+        def settle():
+            # idempotent: release whatever progress callbacks didn't
+            with self._pending_lock:
+                if settled[0]:
+                    return
+                settled[0] = True
+                left = fetch.total_bytes - progressed[0]
+                if left > 0:
+                    self._bytes_in_flight -= left
 
         def on_success(blocks):
             latency = (time.monotonic() - t0) * 1000
-            with self._pending_lock:
-                self._bytes_in_flight -= fetch.total_bytes
+            settle()
             if self.manager.stats is not None:
                 self.manager.stats.update(fetch.host.host, latency)
             self._m_fetch_latency.observe(latency)
@@ -306,8 +333,7 @@ class ShuffleReader:
             self._pump()
 
         def on_failure(err):
-            with self._pending_lock:
-                self._bytes_in_flight -= fetch.total_bytes
+            settle()
             self._fail(
                 FetchFailedError(
                     fetch.host.host, self.handle.shuffle_id, str(err)
@@ -315,13 +341,14 @@ class ShuffleReader:
             )
 
         try:
-            ch = self.manager.node.get_channel(
+            group = self.manager.node.get_read_group(
                 (fetch.host.host, fetch.host.port),
-                ChannelType.READ_REQUESTOR,
                 self.manager.network.connect,
             )
-            ch.read_blocks(
-                fetch.locations, FnCompletionListener(on_success, on_failure)
+            group.read_blocks(
+                fetch.locations,
+                FnCompletionListener(on_success, on_failure),
+                on_progress=on_progress,
             )
         except Exception as e:
             on_failure(e)

@@ -556,7 +556,13 @@ class ShuffleBlockResolver:
                 self.arena.release(old_seg.mkey)
 
     # -- read side (local short-circuit) ------------------------------------
-    def get_local_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
+    def get_local_block(self, shuffle_id: int, map_id: int, reduce_id: int):
+        """Serve one partition block as a bytes-LIKE payload — host
+        segments hand back zero-copy chunk views (read-only ndarray /
+        memoryview over the registered buffer), device segments the
+        landed host array; nothing on the serve path materializes
+        ``bytes`` (the transport sends any buffer view scatter-gather,
+        and the deserializers consume views directly)."""
         with self._lock:
             sd = self._shuffles.get(shuffle_id)
             entry = sd.outputs.get(map_id) if sd else None
@@ -572,12 +578,15 @@ class ShuffleBlockResolver:
 
     def get_local_blocks(
         self, shuffle_id: int, map_id: int, reduce_ids
-    ) -> List[bytes]:
+    ) -> List:
         """Serve many of one map output's partition blocks with ONE
         backing-store read (``Segment.read_many`` batches the
         device→host transfer — the bulk plane reads every partition of
         every map, and a per-block fetch pays a device round-trip
-        each).  Empty partitions come back as ``b""``."""
+        each).  Blocks are chunk VIEWS of the landed cluster buffers,
+        never per-block ``bytes`` joins (see
+        :func:`sparkrdma_tpu.memory.arena._read_spans_clustered`).
+        Empty partitions come back as ``b""``."""
         with self._lock:
             sd = self._shuffles.get(shuffle_id)
             entry = sd.outputs.get(map_id) if sd else None
@@ -595,7 +604,7 @@ class ShuffleBlockResolver:
                 by_seg.setdefault(loc.mkey, []).append(
                     (i, loc.address, loc.length)
                 )
-        out: List[bytes] = [b""] * len(locs)
+        out: List = [b""] * len(locs)
         for mkey, items in by_seg.items():
             blocks = segs[mkey].read_many([(a, ln) for _i, a, ln in items])
             for (i, _a, _ln), blk in zip(items, blocks):

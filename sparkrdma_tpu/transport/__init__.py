@@ -18,10 +18,12 @@ from sparkrdma_tpu.transport.channel import (
 )
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.transport.loopback import LoopbackNetwork
+from sparkrdma_tpu.transport.stripe import ReadGroup
 from sparkrdma_tpu.transport.tcp import TcpNetwork
 
 __all__ = [
     "TcpNetwork",
+    "ReadGroup",
     "Channel",
     "ChannelState",
     "ChannelType",

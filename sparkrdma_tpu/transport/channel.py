@@ -26,7 +26,7 @@ from __future__ import annotations
 import enum
 import threading
 from collections import deque
-from typing import Callable, List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 from sparkrdma_tpu.metrics import gauge
 from sparkrdma_tpu.utils.types import BlockLocation
@@ -86,6 +86,11 @@ class Channel:
     thread), then ``_release_budget()``.
     """
 
+    #: whether this channel's ``_post_read`` honors ``dest`` scatter
+    #: buffers and ``on_progress`` callbacks (the striped-read group
+    #: only stripes across channels that do)
+    supports_scatter = False
+
     def __init__(self, channel_type: ChannelType, send_queue_depth: int = 4096):
         self.channel_type = channel_type
         self._state = ChannelState.IDLE
@@ -135,13 +140,38 @@ class Channel:
         self._enqueue(lambda: self._post_rpc(list(frames), listener), listener)
 
     def read_blocks(
-        self, locations: Sequence[BlockLocation], listener: CompletionListener
+        self,
+        locations: Sequence[BlockLocation],
+        listener: CompletionListener,
+        dest: Optional[Sequence] = None,
+        on_progress: Optional[Callable[[int], None]] = None,
     ) -> None:
         """Post a scatter read of remote blocks — the one-sided RDMA READ
         analog (reference: rdmaReadInQueue, RdmaChannel.java:441-474).
-        Completion delivers a list of ``bytes``, one per location."""
+        Completion delivers a list of bytes-like payloads, one per
+        location.
+
+        Channels with ``supports_scatter`` additionally honor:
+
+        - ``dest``: per-location writable uint8 buffers (or None
+          entries) the payloads land in DIRECTLY — the striped
+          reassembly path; completion then delivers the dest buffers
+          themselves in place of fresh payloads.
+        - ``on_progress(nbytes)``: fires as each location's payload
+          arrives, before completion — stripe-granular in-flight-window
+          accounting for the reader."""
         self._check_usable()
-        self._enqueue(lambda: self._post_read(list(locations), listener), listener)
+        if dest is None and on_progress is None:
+            self._enqueue(
+                lambda: self._post_read(list(locations), listener), listener
+            )
+        else:
+            self._enqueue(
+                lambda: self._post_read(
+                    list(locations), listener, dest, on_progress
+                ),
+                listener,
+            )
 
     def stop(self) -> None:
         """Teardown: fail every outstanding / pending listener
@@ -233,7 +263,11 @@ class Channel:
         raise NotImplementedError
 
     def _post_read(
-        self, locations: List[BlockLocation], listener: CompletionListener
+        self,
+        locations: List[BlockLocation],
+        listener: CompletionListener,
+        dest=None,
+        on_progress=None,
     ) -> None:
         raise NotImplementedError
 
@@ -254,16 +288,18 @@ class BlockStore:
 
 class BytesBlockStore(BlockStore):
     """Host-memory block store over one contiguous buffer; ``address``
-    is the byte offset within it."""
+    is the byte offset within it.  Blocks serve as zero-copy chunk
+    views of the backing buffer (the transport sends views
+    scatter-gather; the view keeps the buffer alive by refcount)."""
 
     def __init__(self, data: bytes):
         self._view = memoryview(data)
 
-    def read_block(self, location: BlockLocation) -> bytes:
+    def read_block(self, location: BlockLocation):
         end = location.address + location.length
         if location.address < 0 or end > len(self._view):
             raise TransportError(
                 f"read [{location.address},{end}) outside store of "
                 f"{len(self._view)}B"
             )
-        return bytes(self._view[location.address : end])
+        return self._view[location.address : end]

@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
@@ -40,6 +42,23 @@ _PAIRED = {
 }
 
 
+def _land(block, d):
+    """Copy one served block into its registered dest buffer (the
+    recv_into analog — bit-exact with TcpChannel's scatter path)."""
+    arr = d if isinstance(d, np.ndarray) else np.frombuffer(d, np.uint8)
+    src = (
+        block if isinstance(block, np.ndarray)
+        else np.frombuffer(memoryview(block), np.uint8)
+    )
+    if src.shape[0] != arr.shape[0]:
+        raise TransportError(
+            f"stripe length mismatch: {src.shape[0]}B payload for "
+            f"{arr.shape[0]}B dest buffer"
+        )
+    arr[:] = src
+    return d
+
+
 class LoopbackChannel(Channel):
     """One direction of an in-process channel pair.
 
@@ -47,6 +66,8 @@ class LoopbackChannel(Channel):
     the node's conf enables it (reference: sender consumes one credit
     per SEND, receiver piggybacks credit reports once half the recv
     queue is consumed, RdmaChannel.java:56-59,508-520,690-703)."""
+
+    supports_scatter = True
 
     def __init__(
         self,
@@ -178,7 +199,8 @@ class LoopbackChannel(Channel):
             self._release_budget()
             return True
 
-    def _post_read(self, locations, listener: CompletionListener) -> None:
+    def _post_read(self, locations, listener: CompletionListener,
+                   dest=None, on_progress=None) -> None:
         # clock starts at POST time (like TcpChannel stamping t0 in
         # _post_read): the dispatcher-queue wait is part of the RTT, so
         # the tcp/loopback series stay comparable under load
@@ -195,6 +217,22 @@ class LoopbackChannel(Channel):
                 # one-sided: read directly from the peer's registered
                 # memory, batched per backing segment
                 data = self.remote.read_local_blocks(locations)
+                if dest is not None:
+                    # striped-reassembly parity with TcpChannel: each
+                    # payload lands in its registered dest buffer and
+                    # the dest object IS the completed block
+                    data = [
+                        _land(data[i], dest[i])
+                        if i < len(dest) and dest[i] is not None
+                        else data[i]
+                        for i in range(len(data))
+                    ]
+                if on_progress is not None:
+                    for b in data:
+                        try:
+                            on_progress(len(b))
+                        except BaseException:
+                            pass
             except BaseException as e:
                 self._error(e)
                 self._fail(listener, e)

@@ -28,7 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
-from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.transport.channel import (
     BlockStore,
     Channel,
@@ -43,6 +43,91 @@ Address = Tuple[str, int]
 
 # Frames arriving on a channel are handed to: (source_channel, frame_bytes)
 ReceiveListener = Callable[[Channel, bytes], None]
+
+
+class _ServePool:
+    """Bounded read-serve pool: fixed worker threads drain a FIFO of
+    serve tasks under a byte-credit budget — the responder-side flow
+    control of the one-sided READ service.  A serve's cost is the
+    requested byte total; workers block until enough credits are free,
+    so a slow reducer draining many multi-MB responses can never pin
+    unbounded server memory (the serve holds its resolved block views
+    only while it owns credits).  A single serve larger than the whole
+    budget clamps to it and runs alone rather than deadlocking."""
+
+    def __init__(self, name: str, workers: int, credit_bytes: int,
+                 init_fn=None):
+        self._budget = max(int(credit_bytes), 1)
+        self._credits = self._budget
+        self._cv = threading.Condition()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stopped = False
+        self._m_depth = gauge("transport_serve_queue_depth")
+        self._m_tasks = counter("transport_serve_tasks_total")
+        self._m_credit_waits = counter("transport_serve_credit_waits_total")
+        self._workers = [
+            threading.Thread(
+                target=self._run, daemon=True, name=f"serve-{name}-{i}",
+                args=(init_fn,),
+            )
+            for i in range(max(1, workers))
+        ]
+        for t in self._workers:
+            t.start()
+
+    def submit(self, fn, args: tuple, cost: int) -> None:
+        """Never blocks the caller (channel reader loops post here)."""
+        if self._stopped:
+            raise TransportError("serve pool stopped")
+        self._m_depth.inc()
+        self._queue.put((fn, args, max(int(cost), 0)))
+
+    def _run(self, init_fn) -> None:
+        if init_fn is not None:
+            init_fn()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            self._m_depth.dec()
+            fn, args, cost = item
+            cost = min(cost, self._budget)
+            with self._cv:
+                if self._credits < cost:
+                    self._m_credit_waits.inc()
+                while self._credits < cost and not self._stopped:
+                    self._cv.wait(timeout=0.5)
+                if self._stopped:
+                    return
+                self._credits -= cost
+            self._m_tasks.inc()
+            try:
+                fn(*args)
+            except BaseException:
+                logger.exception("read serve failed")
+            finally:
+                with self._cv:
+                    self._credits += cost
+                    self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        # abandon queued serves (their channels are tearing down) and
+        # keep the queue-depth gauge honest for the next node in this
+        # process
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._m_depth.dec()
+        for _ in self._workers:
+            self._queue.put(None)
+        for t in self._workers:
+            t.join(timeout=2.0)
 
 
 class Node:
@@ -63,9 +148,14 @@ class Node:
         self._receive_listener: Optional[ReceiveListener] = None
         self._block_stores: Dict[int, BlockStore] = {}
         self._block_store_lock = threading.Lock()
-        # active (locally initiated) channels keyed by (peer, type)
-        self._active: Dict[Tuple[Address, ChannelType], Channel] = {}
+        # active (locally initiated) channels keyed by (peer, type, slot)
+        # — slots > 0 are the striped data lanes of a peer's channel
+        # group (transport/stripe.py)
+        self._active: Dict[Tuple[Address, ChannelType, int], Channel] = {}
         self._active_lock = threading.Lock()
+        # per-peer striped read groups (lazy; share the channel cache)
+        self._read_groups: Dict[Address, object] = {}
+        self._read_groups_lock = threading.Lock()
         self._passive: List[Channel] = []
         self._passive_lock = threading.Lock()
         # completion/dispatch pool — the RdmaThread analog: completions and
@@ -79,11 +169,13 @@ class Node:
             thread_name_prefix=f"node-{address[0]}:{address[1]}",
             initializer=self._pin_worker_thread,
         )
-        # bulk work (read service) runs on its OWN pool so multi-MB
-        # block serves can never starve control-plane traffic — a
-        # starved heartbeat ack would get a healthy executor pruned
-        self._bulk_pool: Optional[ThreadPoolExecutor] = None
-        self._bulk_lock = threading.Lock()
+        # the read service runs on its OWN bounded serve pool so
+        # multi-MB block serves can never starve control-plane traffic
+        # (a starved heartbeat ack would get a healthy executor pruned)
+        # nor the channel reader loops, and its byte credits bound how
+        # much registered memory concurrent serves pin
+        self._serve_pool: Optional[_ServePool] = None
+        self._serve_lock = threading.Lock()
         self._stopped = threading.Event()
 
     # -- dispatcher thread placement ----------------------------------------
@@ -153,24 +245,25 @@ class Node:
         """Run fn on the dispatcher (async completion delivery)."""
         return self._dispatcher.submit(fn, *args)
 
-    def submit_bulk(self, fn, *args):
-        """Run bulk data-plane work (block serving) on the dedicated
-        bulk pool, created on first use."""
+    def submit_serve(self, fn, args: tuple = (), cost: int = 0):
+        """Run one read serve on the node's bounded serve pool (created
+        on first use; workers pin to ``dispatcherCpuList`` like the
+        dispatcher).  ``cost`` is the serve's requested byte total —
+        the pool's credit budget throttles admission on it."""
         if self._stopped.is_set():
             raise TransportError(f"{self}: stopped")
-        pool = self._bulk_pool
+        pool = self._serve_pool
         if pool is None:
-            with self._bulk_lock:
-                if self._bulk_pool is None:
-                    self._bulk_pool = ThreadPoolExecutor(
-                        max_workers=2,
-                        thread_name_prefix=(
-                            f"bulk-{self.address[0]}:{self.address[1]}"
-                        ),
-                        initializer=self._pin_worker_thread,
+            with self._serve_lock:
+                if self._serve_pool is None:
+                    self._serve_pool = _ServePool(
+                        f"{self.address[0]}:{self.address[1]}",
+                        self.conf.transport_serve_threads,
+                        self.conf.transport_serve_credit_bytes,
+                        init_fn=self._pin_worker_thread,
                     )
-                pool = self._bulk_pool
-        return pool.submit(fn, *args)
+                pool = self._serve_pool
+        pool.submit(fn, args, cost)
 
     # -- block stores (registered memory domains) ---------------------------
     def register_block_store(self, mkey: int, store: BlockStore) -> None:
@@ -224,6 +317,7 @@ class Node:
         channel_type: ChannelType,
         connect: Callable[["Node", Address, ChannelType], Channel],
         must_retry: bool = True,
+        slot: int = 0,
     ) -> Channel:
         """Get-or-create a channel to ``peer``.
 
@@ -231,11 +325,13 @@ class Node:
         racy-create + retry loop (RdmaNode.java:277-351): concurrent
         callers race benignly, losers close their extra channel; dead
         cached channels are replaced up to max_connection_attempts.
+        ``slot`` distinguishes the parallel data lanes of a striped
+        channel group — each slot is its own cached connection.
         """
         attempts = 0
         last_err: Optional[BaseException] = None
         max_attempts = self.conf.max_connection_attempts if must_retry else 1
-        key = (peer, channel_type)
+        key = (peer, channel_type, slot)
         while attempts < max_attempts and not self._stopped.is_set():
             attempts += 1
             if attempts > 1:
@@ -275,7 +371,27 @@ class Node:
             f"after {attempts} attempts"
         ) from last_err
 
+    def get_read_group(self, peer: Address, connect):
+        """Get-or-create ``peer``'s striped read group (one small-read
+        lane + ``transportNumStripes`` data lanes over the channel
+        cache) — the bulk-fetch entry point for readers."""
+        with self._read_groups_lock:
+            group = self._read_groups.get(peer)
+            if group is None:
+                from sparkrdma_tpu.transport.stripe import ReadGroup
+
+                group = self._read_groups[peer] = ReadGroup(
+                    self, peer, connect
+                )
+        return group
+
     def register_passive_channel(self, channel: Channel) -> None:
+        if self._stopped.is_set():
+            # an acceptor racing node teardown would otherwise hand out
+            # a channel nothing ever stops — the peer's reads against
+            # it would hang instead of failing fast
+            channel.stop()
+            return
         with self._passive_lock:
             self._passive.append(channel)
 
@@ -346,10 +462,12 @@ class Node:
                     hung, budget,
                 )
         self._dispatcher.shutdown(wait=True)
-        with self._bulk_lock:
-            bulk, self._bulk_pool = self._bulk_pool, None
-        if bulk is not None:
-            bulk.shutdown(wait=True)
+        with self._serve_lock:
+            serve, self._serve_pool = self._serve_pool, None
+        if serve is not None:
+            serve.stop()
+        with self._read_groups_lock:
+            self._read_groups.clear()
         with self._block_store_lock:
             self._block_stores.clear()
 

@@ -1,0 +1,225 @@
+"""Striped multi-channel block reads: per-peer channel groups.
+
+SparkRDMA's point-to-point perf trick was channel specialization: each
+peer pair keeps RPC channels separate from dedicated RDMA_READ
+requestor/responder channels so bulk reads never head-of-line-block
+control traffic (RdmaChannel.java:41; our ``ChannelType`` mirrors the
+split but every peer previously shared ONE serialized socket per
+type).  This module extends the split with fabric-lib-style striping:
+
+- a :class:`ReadGroup` per peer owns one SMALL-read lane (slot 0) plus
+  ``transportNumStripes`` DATA lanes (slots 1..N) over the node's
+  slot-keyed channel cache;
+- block reads larger than ``transportStripeThreshold`` are chunked and
+  issued round-robin across the data lanes as ordinary sub-range
+  one-sided reads (a stripe is just a ``BlockLocation`` at
+  ``address + offset`` — the responder needs no special handling), each
+  landing via ``recv_into`` DIRECTLY in its slice of one pooled
+  destination row (``StagingPool.alloc_gc``) — reassembly happens in
+  the kernel copy, with no intermediate buffers or joins;
+- small reads ride slot 0 whole, so metadata-sized fetches never queue
+  behind multi-MB stripes.
+
+Failure contract: the first failing sub-read fails the WHOLE group
+read exactly once (each lane's ``_fail_outstanding`` covers its
+stripes; the combiner fans the first error out to the caller), so a
+dead data channel surfaces as a prompt fetch failure, never a hang.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Sequence
+
+import numpy as np
+
+from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.transport.channel import (
+    ChannelType,
+    CompletionListener,
+    FnCompletionListener,
+)
+from sparkrdma_tpu.utils.types import BlockLocation
+
+
+def _alloc_row(pool, nbytes: int) -> np.ndarray:
+    """Pooled destination row for one striped block (zero-copy slices,
+    GC-tied release); plain numpy when no pool is wired or the budget
+    is exhausted."""
+    from sparkrdma_tpu.memory.staging import alloc_row_gc
+
+    return alloc_row_gc(
+        pool, nbytes, "transport_stripe_row_pool_fallbacks_total"
+    )
+
+
+class _GroupRead:
+    """Completion combiner for one group read: N sub-reads, one
+    caller-facing listener.  First failure wins and suppresses further
+    progress reports; success fires once when every sub-read landed."""
+
+    __slots__ = ("listener", "out", "rows", "on_progress", "pending",
+                 "lock", "finished")
+
+    def __init__(self, listener: CompletionListener, out: list,
+                 rows: List[int], on_progress, pending: int):
+        self.listener = listener
+        self.out = out
+        self.rows = rows  # indices whose out[] entry is a dest row
+        self.on_progress = on_progress
+        self.pending = pending
+        self.lock = threading.Lock()
+        self.finished = False
+
+    def progress(self, n: int) -> None:
+        cb = self.on_progress
+        if cb is not None and not self.finished:
+            cb(n)
+
+    def part_done(self) -> None:
+        with self.lock:
+            if self.finished:
+                return
+            self.pending -= 1
+            if self.pending:
+                return
+            self.finished = True
+        for i in self.rows:
+            row = self.out[i]
+            if isinstance(row, np.ndarray):
+                row.flags.writeable = False
+        self.listener.on_success(self.out)
+
+    def fail(self, err: BaseException) -> None:
+        with self.lock:
+            if self.finished:
+                return
+            self.finished = True
+        self.listener.on_failure(err)
+
+
+class ReadGroup:
+    """One peer's channel group: stripes bulk reads, keeps small reads
+    on their own lane.  Obtained via ``Node.get_read_group``; channels
+    come from the node's slot-keyed cache, so lane death/reconnect
+    rides the existing racy-create machinery."""
+
+    def __init__(self, node, peer, connect):
+        self.node = node
+        self.peer = peer
+        self._connect = connect
+        conf = node.conf
+        self.num_stripes = conf.transport_num_stripes
+        self.threshold = max(conf.transport_stripe_threshold, 1)
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._m_stripes = counter("transport_stripes_total")
+        self._m_stripe_bytes = counter("transport_stripe_bytes_total")
+        self._m_striped_reads = counter("transport_striped_reads_total")
+
+    def channel(self, slot: int = 0):
+        return self.node.get_channel(
+            self.peer, ChannelType.READ_REQUESTOR, self._connect, slot=slot
+        )
+
+    def data_channels(self) -> List:
+        """The live data lanes (slots 1..N) — chaos tests reach in here
+        to kill one mid-read."""
+        return [self.channel(s) for s in range(1, self.num_stripes + 1)]
+
+    def read_blocks(
+        self,
+        locations: Sequence[BlockLocation],
+        listener: CompletionListener,
+        on_progress=None,
+    ) -> None:
+        """Same contract as ``Channel.read_blocks``: completion delivers
+        one bytes-like payload per location, in order — striped blocks
+        arrive as the full reassembled destination row (read-only
+        ndarray), small ones exactly as a plain channel read returns
+        them."""
+        locations = list(locations)
+        ch0 = self.channel(0)
+        scatter = getattr(ch0, "supports_scatter", False)
+        striped = (
+            [i for i, loc in enumerate(locations)
+             if loc.length > self.threshold]
+            if scatter and self.num_stripes > 1 else []
+        )
+        if not striped:
+            if scatter and on_progress is not None:
+                ch0.read_blocks(locations, listener, on_progress=on_progress)
+            else:
+                ch0.read_blocks(locations, listener)
+            return
+
+        striped_set = set(striped)
+        small = [i for i in range(len(locations)) if i not in striped_set]
+        out: list = [None] * len(locations)
+        # lane -> ([sub-locations], [dest views])
+        lanes = {s: ([], []) for s in range(1, self.num_stripes + 1)}
+        pool = getattr(self.node, "staging_pool", None)
+        with self._rr_lock:
+            rr = self._rr
+            self._rr += sum(
+                self._num_chunks(locations[i].length) for i in striped
+            )
+        for i in striped:
+            loc = locations[i]
+            row = _alloc_row(pool, loc.length)
+            out[i] = row
+            k = self._num_chunks(loc.length)
+            base, extra = divmod(loc.length, k)
+            off = 0
+            for j in range(k):
+                n = base + (1 if j < extra else 0)
+                slot = 1 + (rr % self.num_stripes)
+                rr += 1
+                locs, dests = lanes[slot]
+                locs.append(BlockLocation(loc.address + off, n, loc.mkey))
+                dests.append(row[off:off + n])
+                off += n
+            self._m_stripes.inc(k)
+            self._m_stripe_bytes.inc(loc.length)
+            self._m_striped_reads.inc()
+
+        live_lanes = [s for s, (locs, _d) in lanes.items() if locs]
+        state = _GroupRead(
+            listener, out, striped, on_progress,
+            pending=len(live_lanes) + (1 if small else 0),
+        )
+
+        def lane_listener():
+            return FnCompletionListener(
+                lambda _blocks: state.part_done(), state.fail
+            )
+
+        def small_done(blocks):
+            for idx, b in zip(small, blocks):
+                out[idx] = b
+            state.part_done()
+
+        try:
+            if small:
+                self.channel(0).read_blocks(
+                    [locations[i] for i in small],
+                    FnCompletionListener(small_done, state.fail),
+                    on_progress=state.progress,
+                )
+            for s in live_lanes:
+                locs, dests = lanes[s]
+                self.channel(s).read_blocks(
+                    locs, lane_listener(), dest=dests,
+                    on_progress=state.progress,
+                )
+        except BaseException as e:
+            state.fail(e)
+
+    def _num_chunks(self, length: int) -> int:
+        """Stripes for one block: every chunk stays above half the
+        threshold so tiny tail chunks never pay a full round trip."""
+        min_chunk = max(self.threshold // 2, 1)
+        return max(1, min(self.num_stripes, length // min_chunk))
+
+
+__all__ = ["ReadGroup"]

@@ -73,6 +73,10 @@ _PAIRED = {
 
 _MAX_FRAME = 1 << 30
 
+# iovec batch per sendmsg call (IOV_MAX is ≥1024 on Linux; stay well
+# under it — grouped fetches of many blocks produce many segments)
+_IOV_MAX = 256
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -84,9 +88,48 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _discard_exact(sock: socket.socket, n: int) -> None:
+    """Consume and drop n payload bytes (a response whose request raced
+    teardown) without materializing the frame."""
+    while n:
+        chunk = sock.recv(min(n, 1 << 16))
+        if not chunk:
+            raise TransportError("connection closed by peer")
+        n -= len(chunk)
+
+
+def _as_view(buf) -> memoryview:
+    """Flat byte view over any contiguous buffer (bytes, bytearray,
+    uint8 ndarray, memoryview) — what sendmsg/recv_into consume."""
+    v = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if v.format != "B" or v.ndim != 1:
+        v = v.cast("B")
+    return v
+
+
+def _req_cost(payload: bytes) -> int:
+    """Total requested bytes of one OP_READ_REQ — the serve pool's
+    admission cost (credits bound resident serve memory).  Runs on the
+    channel reader thread, so a malformed request must cost 0, not
+    kill the channel — the serve path answers it with a scoped error
+    reply (or logs, when even the req_id is unparseable)."""
+    try:
+        _req_id, count = _REQ_HDR.unpack_from(payload, 0)
+        off = _REQ_HDR.size
+        total = 0
+        for _ in range(count):
+            total += _LOC.unpack_from(payload, off)[1]
+            off += _LOC.size
+        return total
+    except Exception:
+        return 0
+
+
 class TcpChannel(Channel):
     """One TCP connection; either endpoint can carry RPC frames, the
     acceptor side additionally serves block reads."""
+
+    supports_scatter = True
 
     def __init__(self, channel_type: ChannelType, node: Node,
                  peer: Address, sock: socket.socket):
@@ -94,10 +137,14 @@ class TcpChannel(Channel):
         self.node = node
         self.peer = peer
         self._sock = sock
+        self._sg = (
+            node.conf.transport_scatter_gather
+            and hasattr(sock, "sendmsg")
+        )
         self._send_lock = threading.Lock()
         self._next_req = 1
-        # req_id -> (location count, listener, post monotonic time)
-        self._reads: Dict[int, Tuple[int, CompletionListener, float]] = {}
+        # req_id -> (count, listener, post time, dest, on_progress)
+        self._reads: Dict[int, Tuple] = {}
         self._reads_lock = threading.Lock()
         self._reader: Optional[threading.Thread] = None
         self._m_bytes_sent = counter(
@@ -112,6 +159,10 @@ class TcpChannel(Channel):
             "transport_read_rtt_ms", transport="tcp")
         self._m_fail_outstanding = counter(
             "transport_fail_outstanding_total", transport="tcp")
+        self._m_sendmsg_bytes = counter(
+            "transport_sendmsg_bytes_total", transport="tcp")
+        self._m_sendall_bytes = counter(
+            "transport_sendall_bytes_total", transport="tcp")
 
     # -- lifecycle ----------------------------------------------------------
     def start_reader(self) -> None:
@@ -134,22 +185,60 @@ class TcpChannel(Channel):
         with self._reads_lock:
             reads = list(self._reads.values())
             self._reads.clear()
-        for _, listener, _t0 in reads:
-            self._safe_fail(listener, err)
+        for entry in reads:
+            self._safe_fail(entry[1], err)
         super().stop()
 
     # -- sending ------------------------------------------------------------
-    def _send_msg(self, opcode: int, payload: bytes) -> None:
+    def _send_msg(self, opcode: int, parts) -> None:
+        """Send one frame as a scatter-gather iovec — header, length
+        prefixes and block views go to the socket WITHOUT being
+        concatenated into an intermediate buffer (``parts`` is a
+        sequence of buffer-likes).  ``transportScatterGather=off``
+        falls back to the legacy concat+sendall wire path."""
+        views = [v for v in map(_as_view, parts) if v.nbytes]
+        length = sum(v.nbytes for v in views)
+        hdr = _HDR.pack(opcode, length)
         with self._send_lock:
-            self._sock.sendall(_HDR.pack(opcode, len(payload)) + payload)
+            if self._sg:
+                self._sendmsg_all([memoryview(hdr)] + views)
+            else:
+                self._send_concat(hdr, views)
         self._m_msgs_sent.inc()
-        self._m_bytes_sent.inc(_HDR.size + len(payload))
+        self._m_bytes_sent.inc(_HDR.size + length)
+
+    def _sendmsg_all(self, views: List[memoryview]) -> None:
+        """writev the iovec list, advancing across partial sends."""
+        i = 0
+        while i < len(views):
+            n = self._sock.sendmsg(views[i:i + _IOV_MAX])
+            if n <= 0:
+                raise TransportError("sendmsg made no progress")
+            self._m_sendmsg_bytes.inc(n)
+            while n and i < len(views):
+                v = views[i]
+                if n >= v.nbytes:
+                    n -= v.nbytes
+                    i += 1
+                else:
+                    views[i] = v[n:]
+                    n = 0
+
+    def _send_concat(self, hdr: bytes, views: List[memoryview]) -> None:
+        # pre-scatter-gather wire path (one concatenation copy +
+        # sendall), kept behind transportScatterGather=off for A/B
+        # measurement and exotic sockets without sendmsg
+        payload = bytearray(hdr)
+        for v in views:
+            payload += v
+        self._sock.sendall(payload)
+        self._m_sendall_bytes.inc(len(payload))
 
     def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:
         def run():
             try:
                 for frame in frames:
-                    self._send_msg(OP_RPC, frame)
+                    self._send_msg(OP_RPC, (frame,))
             except BaseException as e:
                 self._error(e)
                 self._fail(listener, e)
@@ -161,18 +250,22 @@ class TcpChannel(Channel):
         self.node.submit(run)
 
     def _post_read(self, locations: List[BlockLocation],
-                   listener: CompletionListener) -> None:
+                   listener: CompletionListener,
+                   dest=None, on_progress=None) -> None:
         with self._reads_lock:
             req_id = self._next_req
             self._next_req += 1
-            self._reads[req_id] = (len(locations), listener, time.monotonic())
+            self._reads[req_id] = (
+                len(locations), listener, time.monotonic(), dest,
+                on_progress,
+            )
         payload = bytearray(_REQ_HDR.pack(req_id, len(locations)))
         for loc in locations:
             payload += _LOC.pack(loc.address, loc.length, loc.mkey)
 
         def run():
             try:
-                self._send_msg(OP_READ_REQ, bytes(payload))
+                self._send_msg(OP_READ_REQ, (payload,))
             except BaseException as e:
                 with self._reads_lock:
                     self._reads.pop(req_id, None)
@@ -193,10 +286,12 @@ class TcpChannel(Channel):
                 self._m_msgs_recv.inc()
                 self._m_bytes_recv.inc(_HDR.size + length)
                 if opcode == OP_READ_RESP:
-                    # bulk data lands in a POOLED buffer; blocks are
-                    # zero-copy slices whose collection returns it
-                    # (BufferReleasingInputStream analog via alloc_gc)
-                    self._finish_read(self._recv_payload(length))
+                    # structured scatter receive: the frame is never
+                    # materialized whole — blocks land in registered
+                    # dest buffers (striped reassembly) or ONE pooled
+                    # buffer (BufferReleasingInputStream analog via
+                    # alloc_gc)
+                    self._recv_read_resp(length)
                     continue
                 payload = _recv_exact(self._sock, length) if length else b""
                 if opcode == OP_RPC:
@@ -205,16 +300,104 @@ class TcpChannel(Channel):
                     # serve OFF the reader thread: one large read must
                     # not head-of-line-block further frames on this
                     # channel (the reference's CQ model has no such
-                    # serialization — the NIC serves reads).  Bulk pool,
-                    # not the dispatcher: multi-MB serves must never
-                    # starve heartbeat/RPC dispatch
-                    self.node.submit_bulk(self._serve_read, payload)
+                    # serialization — the NIC serves reads).  The serve
+                    # pool, not the dispatcher: multi-MB serves must
+                    # never starve heartbeat/RPC dispatch, and its
+                    # byte credits bound resident serve memory
+                    self.node.submit_serve(
+                        self._serve_read, (payload,), _req_cost(payload)
+                    )
                 else:
                     raise TransportError(f"unknown opcode {opcode}")
         except BaseException as e:
             if self.state not in (ChannelState.STOPPED,):
                 self._error(e)
                 self._fail_outstanding(e)
+
+    def _recv_read_resp(self, length: int) -> None:
+        """Receive one read response.  Striped reads (``dest`` buffers
+        registered at post time) scatter straight into their
+        destination row via ``recv_into`` — reassembly happens in the
+        kernel copy, with no intermediate frame buffer; plain reads
+        land in one pooled buffer and complete as zero-copy slices."""
+        if length < _RESP_HDR.size:
+            raise TransportError(f"short read response: {length}B")
+        req_id, status = _RESP_HDR.unpack(
+            _recv_exact(self._sock, _RESP_HDR.size)
+        )
+        body = length - _RESP_HDR.size
+        with self._reads_lock:
+            entry = self._reads.pop(req_id, None)
+        if entry is None:
+            _discard_exact(self._sock, body)  # raced with teardown
+            return
+        count, listener, t0, dest, on_progress = entry
+        # the entry left _reads above, so _fail_outstanding no longer
+        # covers it: ANY failure while the body is still on the wire
+        # must fail this listener HERE, then re-raise so the read loop
+        # tears the (now desynced) channel down
+        try:
+            if status != 0:
+                reason = _recv_exact(self._sock, body).decode(
+                    "utf-8", "replace"
+                )
+                err: BaseException = TransportError(reason)
+            elif dest is None:
+                payload = self._recv_payload(body)
+                blocks, off, err = [], 0, None
+                for _ in range(count):
+                    (n,) = _LEN.unpack_from(payload, off)
+                    off += _LEN.size
+                    blocks.append(payload[off: off + n])
+                    off += n
+                    if on_progress is not None:
+                        self._safe_progress(on_progress, n)
+            else:
+                blocks, err = [], None
+                for i in range(count):
+                    (n,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+                    d = dest[i] if i < len(dest) else None
+                    if d is None:
+                        blocks.append(self._recv_payload(n))
+                    else:
+                        view = _as_view(d)
+                        if view.nbytes != n:
+                            raise TransportError(
+                                f"stripe length mismatch: {n}B payload "
+                                f"for {view.nbytes}B dest buffer"
+                            )
+                        self._recv_into(view)
+                        blocks.append(d)
+                    if on_progress is not None:
+                        self._safe_progress(on_progress, n)
+        except BaseException as e:
+            self._fail(listener, e)
+            self._release_budget()
+            raise
+        # RTT covers the WHOLE transfer including the body (the
+        # loopback series measures through data landing — keep the
+        # tcp/loopback series comparable)
+        self._m_read_rtt.observe((time.monotonic() - t0) * 1000.0)
+        if err is not None:
+            self._fail(listener, err)
+        else:
+            self._complete(listener, blocks)
+        self._release_budget()
+
+    @staticmethod
+    def _safe_progress(on_progress, n: int) -> None:
+        try:
+            on_progress(n)
+        except BaseException:
+            logger.exception("read progress callback raised")
+
+    def _recv_into(self, view: memoryview) -> None:
+        got, n = 0, view.nbytes
+        while got < n:
+            r = self._sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise TransportError("connection closed by peer")
+            got += r
 
     def _recv_payload(self, length: int):
         """Receive a bulk payload, preferring a pooled staging buffer
@@ -226,13 +409,7 @@ class TcpChannel(Channel):
             except MemoryError:
                 arr = None
             if arr is not None:
-                view = memoryview(arr)[:length]
-                got = 0
-                while got < length:
-                    n = self._sock.recv_into(view[got:], length - got)
-                    if n == 0:
-                        raise TransportError("connection closed by peer")
-                    got += n
+                self._recv_into(memoryview(arr)[:length])
                 out = arr[:length]
                 out.flags.writeable = False
                 return out
@@ -243,17 +420,29 @@ class TcpChannel(Channel):
             reads = list(self._reads.values())
             self._reads.clear()
         self._m_fail_outstanding.inc()
-        for _, listener, _t0 in reads:
-            self._fail(listener, err)
+        for entry in reads:
+            self._fail(entry[1], err)
             self._release_budget()
 
     def _serve_read(self, payload: bytes) -> None:
-        """The one-sided READ service: runs on the node's bulk pool
-        (posted by the reader loop) against the registered block
+        """The one-sided READ service: runs on the node's bounded serve
+        pool (posted by the reader loop) against the registered block
         stores — never via the application receive listener, and never
         on the reader thread itself (a large serve must not
-        head-of-line-block the channel)."""
-        req_id, count = _REQ_HDR.unpack_from(payload, 0)
+        head-of-line-block the channel).  The response goes out as one
+        scatter-gather frame of header + length prefixes + the
+        resolved block VIEWS — registered memory is never copied into
+        an intermediate response buffer."""
+        try:
+            req_id, count = _REQ_HDR.unpack_from(payload, 0)
+        except Exception:
+            # not even a req_id to scope an error reply to — log and
+            # drop; the channel itself stays healthy
+            logger.warning(
+                "malformed read request from %s (%dB)",
+                self.peer, len(payload),
+            )
+            return
         try:
             locs = []
             off = _REQ_HDR.size
@@ -262,46 +451,20 @@ class TcpChannel(Channel):
                 off += _LOC.size
                 locs.append(BlockLocation(addr, length, mkey))
             blocks = self.node.read_local_blocks(locs)
-            body = bytearray(_RESP_HDR.pack(req_id, 0))
+            parts: List = [_RESP_HDR.pack(req_id, 0)]
             for b in blocks:
-                body += _LEN.pack(len(b))
-                # blocks may be zero-copy ndarray views; memoryview
-                # appends raw bytes (bytearray += ndarray would
-                # dispatch to numpy broadcasting)
-                body += memoryview(b)
+                v = _as_view(b)
+                parts.append(_LEN.pack(v.nbytes))
+                parts.append(v)
         except BaseException as e:
-            body = bytearray(_RESP_HDR.pack(req_id, 1))
-            body += str(e).encode("utf-8", "replace")
+            parts = [
+                _RESP_HDR.pack(req_id, 1),
+                str(e).encode("utf-8", "replace"),
+            ]
         try:
-            self._send_msg(OP_READ_RESP, bytes(body))
+            self._send_msg(OP_READ_RESP, parts)
         except BaseException:
             logger.warning("read response to %s failed", self.peer)
-
-    def _finish_read(self, payload: bytes) -> None:
-        req_id, status = _RESP_HDR.unpack_from(payload, 0)
-        with self._reads_lock:
-            entry = self._reads.pop(req_id, None)
-        if entry is None:
-            return  # raced with teardown
-        count, listener, t0 = entry
-        self._m_read_rtt.observe((time.monotonic() - t0) * 1000.0)
-        try:
-            if status != 0:
-                raise TransportError(
-                    bytes(payload[_RESP_HDR.size:]).decode("utf-8", "replace")
-                )
-            blocks, off = [], _RESP_HDR.size
-            for _ in range(count):
-                (n,) = _LEN.unpack_from(payload, off)
-                off += _LEN.size
-                blocks.append(payload[off: off + n])
-                off += n
-        except BaseException as e:
-            self._fail(listener, e)
-        else:
-            self._complete(listener, blocks)
-        finally:
-            self._release_budget()
 
     def reply_channel(self) -> Channel:
         """Replies ride the same socket."""

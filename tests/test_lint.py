@@ -103,3 +103,49 @@ def test_py09_flags_hot_path_materialization(tmp_path):
         ("sparkrdma_tpu/parallel/exchange.py", 3),
         ("sparkrdma_tpu/shuffle/bulk.py", 2),
     ], findings
+
+
+def test_py10_flags_tcp_hot_path_concat(tmp_path):
+    """sendall(a + b)-style payload concatenation and per-frame bytes()
+    materialization regress the scatter-gather TCP data path; PY10 pins
+    them out of transport/tcp.py (noqa escapes)."""
+    lint = _load_lint()
+    lib = tmp_path / "sparkrdma_tpu"
+    (lib / "transport").mkdir(parents=True)
+
+    hot = lib / "transport" / "tcp.py"
+    hot.write_text(
+        "class C:\n"
+        "    def _send_msg(self, opcode, payload):\n"
+        "        self._sock.sendall(HDR.pack(opcode) + payload)\n"
+        '        self._sock.sendall(b"".join(parts))\n'
+        "    def _serve_read(self, payload):\n"
+        "        body = bytes(payload)\n"
+        "        deliberate = bytes(payload)  # noqa\n"
+        "    def _post_read(self, locations, listener):\n"
+        "        cold = bytes(locations)\n"
+        "        self._sock.sendall(cold)\n"
+    )
+    cold = lib / "transport" / "loopback.py"
+    cold.write_text(
+        "def f(sock, a, b):\n"
+        "    sock.sendall(a + b)\n"
+        "    return bytes(a)\n"
+    )
+
+    findings = []
+    for f in (hot, cold):
+        lint.lint_python(f, findings, root=tmp_path)
+    py10 = sorted(
+        (str(rel), line) for rel, line, code, _m in findings
+        if code == "PY10"
+    )
+    # line 3: sendall concat; line 4: sendall join; line 6: bytes() in
+    # a hot function.  NOT flagged: the noqa'd bytes() (7), bytes()/
+    # sendall of a plain name in a non-hot function (9-10), and
+    # anything outside transport/tcp.py.
+    assert py10 == [
+        ("sparkrdma_tpu/transport/tcp.py", 3),
+        ("sparkrdma_tpu/transport/tcp.py", 4),
+        ("sparkrdma_tpu/transport/tcp.py", 6),
+    ], findings

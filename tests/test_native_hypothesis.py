@@ -5,7 +5,11 @@ fuzzers draw from modest ranges and would never propose INT64_MIN/MAX
 or adversarial duplicate structure on their own)."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 i64 = st.integers(min_value=-(2 ** 63), max_value=2 ** 63 - 1)
 

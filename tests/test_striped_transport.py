@@ -1,0 +1,469 @@
+"""Striped multi-channel block transport (transport/stripe.py):
+bit-exact sweeps across stripe counts and thresholds on BOTH backends,
+scatter-gather on/off interop, serve-pool credit bounding, and the
+reader-level striped fetch path."""
+
+import threading
+import time
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.memory.arena import ArenaManager
+from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+from sparkrdma_tpu.transport.channel import FnCompletionListener
+from sparkrdma_tpu.transport.node import Node
+from sparkrdma_tpu.utils.types import BlockLocation
+
+BASE_PORT = 45100
+
+_PATTERN = (np.arange(6 << 20, dtype=np.uint32) % 251).astype(np.uint8)
+
+
+def _conf(stripes, threshold, extra=None):
+    d = {
+        "spark.shuffle.tpu.transportNumStripes": stripes,
+        "spark.shuffle.tpu.transportStripeThreshold": threshold,
+    }
+    d.update(extra or {})
+    return TpuShuffleConf(d)
+
+
+def _pair(netcls, port, conf):
+    net = netcls()
+    a = Node(("127.0.0.1", port), conf)
+    b = Node(("127.0.0.1", port + 7), conf)
+    net.register(a)
+    net.register(b)
+    arena = ArenaManager()
+    seg = arena.register(_PATTERN, zero_copy_ok=True)
+    b.register_block_store(seg.mkey, arena)
+    return net, a, b, seg.mkey
+
+
+def _teardown(net, a, b):
+    a.stop()
+    b.stop()
+    net.unregister(a)
+    net.unregister(b)
+
+
+def _group_read(group, locs, timeout=30, on_progress=None):
+    done = threading.Event()
+    res = {}
+    group.read_blocks(
+        locs,
+        FnCompletionListener(
+            lambda blocks: (res.setdefault("blocks", blocks), done.set()),
+            lambda e: (res.setdefault("error", e), done.set()),
+        ),
+        on_progress=on_progress,
+    )
+    assert done.wait(timeout), "group read hung"
+    if "error" in res:
+        raise res["error"]
+    return res["blocks"]
+
+
+def _as_np(blk):
+    if isinstance(blk, np.ndarray):
+        return blk
+    return np.frombuffer(memoryview(blk), np.uint8)
+
+
+@pytest.mark.parametrize("netcls,port", [
+    (TcpNetwork, BASE_PORT),
+    (LoopbackNetwork, BASE_PORT + 20),
+])
+@pytest.mark.parametrize("stripes,threshold", [
+    (1, "128k"), (2, "128k"), (3, "64k"), (4, "256k"),
+])
+def test_striped_read_bit_exact_sweep(netcls, port, stripes, threshold):
+    """Every (backend, stripe count, threshold) serves bit-identical
+    payloads for a mixed small/large location batch — including
+    exactly-at-threshold and threshold+1 edge sizes."""
+    conf = _conf(stripes, threshold)
+    net, a, b, mkey = _pair(netcls, port + stripes, conf)
+    try:
+        th = conf.transport_stripe_threshold
+        locs = [
+            BlockLocation(3, 100, mkey),          # tiny
+            BlockLocation(103, th, mkey),         # == threshold: NOT striped
+            BlockLocation(5, th + 1, mkey),       # barely striped
+            BlockLocation(1 << 20, 3 << 20, mkey),  # bulk
+            BlockLocation(0, 1, mkey),
+        ]
+        group = a.get_read_group(b.address, net.connect)
+        blocks = _group_read(group, locs)
+        assert len(blocks) == len(locs)
+        for loc, blk in zip(locs, blocks):
+            got = _as_np(blk)
+            assert got.shape[0] == loc.length
+            assert np.array_equal(
+                got, _PATTERN[loc.address:loc.address + loc.length]
+            ), f"corrupt block {loc} at stripes={stripes}"
+        if stripes > 1:
+            # the bulk blocks actually rode the striped path
+            assert all(
+                isinstance(blocks[i], np.ndarray)
+                and not blocks[i].flags.writeable
+                for i in (2, 3)
+            )
+    finally:
+        _teardown(net, a, b)
+
+
+def test_striped_matches_single_channel_and_tcp_matches_loopback():
+    """The striped result is byte-identical to the single-channel
+    result, and the TCP plane is byte-identical to loopback (the
+    single-process tests exercise the same stripe/reassembly
+    contract)."""
+    locs_spec = [(11, 900_000), (950_000, 2 << 20), (7, 64)]
+    results = {}
+    for name, netcls, port, stripes in [
+        ("tcp1", TcpNetwork, BASE_PORT + 40, 1),
+        ("tcp4", TcpNetwork, BASE_PORT + 60, 4),
+        ("loop4", LoopbackNetwork, BASE_PORT + 80, 4),
+    ]:
+        net, a, b, mkey = _pair(netcls, port, _conf(stripes, "128k"))
+        try:
+            group = a.get_read_group(b.address, net.connect)
+            blocks = _group_read(
+                group, [BlockLocation(o, n, mkey) for o, n in locs_spec]
+            )
+            results[name] = [bytes(_as_np(blk)) for blk in blocks]
+        finally:
+            _teardown(net, a, b)
+    assert results["tcp4"] == results["tcp1"]
+    assert results["loop4"] == results["tcp4"]
+
+
+def test_scatter_gather_off_interop_bit_exact():
+    """transportScatterGather=off restores the concat+sendall wire path
+    with identical framing — the two endpoints interoperate and the
+    payloads stay bit-exact."""
+    conf = _conf(2, "128k", {
+        "spark.shuffle.tpu.transportScatterGather": "off",
+    })
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 100, conf)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        locs = [BlockLocation(9, 2 << 20, mkey), BlockLocation(1, 50, mkey)]
+        blocks = _group_read(group, locs)
+        for loc, blk in zip(locs, blocks):
+            assert np.array_equal(
+                _as_np(blk), _PATTERN[loc.address:loc.address + loc.length]
+            )
+    finally:
+        _teardown(net, a, b)
+
+
+def test_progress_accounts_every_stripe_byte():
+    """on_progress reports sum exactly to the requested byte total, in
+    stripe-sized increments for striped blocks (the reader's in-flight
+    window frees bytes as stripes land, not whole blocks)."""
+    conf = _conf(4, "128k")
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 120, conf)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        locs = [BlockLocation(0, 2 << 20, mkey), BlockLocation(5, 10, mkey)]
+        prog = []
+        _group_read(group, locs, on_progress=lambda n: prog.append(n))
+        assert sum(prog) == sum(loc.length for loc in locs)
+        # the 2 MiB block must have landed in more than one increment
+        assert len([n for n in prog if n > 10]) > 1
+    finally:
+        _teardown(net, a, b)
+
+
+def test_serve_pool_credits_bound_but_never_deadlock():
+    """A credit budget far below the concurrent serve volume must
+    throttle (credit waits observed) yet complete every read — a
+    single serve larger than the whole budget clamps instead of
+    wedging."""
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+
+    prev_enabled = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    conf = _conf(2, "256k", {
+        "spark.shuffle.tpu.transportServeThreads": 2,
+        "spark.shuffle.tpu.transportServeCreditBytes": "1m",
+    })
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 140, conf)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        done = [threading.Event() for _ in range(6)]
+        errors = []
+
+        def issue(i):
+            group.read_blocks(
+                [BlockLocation(i * 100, 2 << 20, mkey)],
+                FnCompletionListener(
+                    lambda blocks, i=i: (
+                        _check(blocks, i), done[i].set()
+                    ),
+                    lambda e, i=i: (errors.append(e), done[i].set()),
+                ),
+            )
+
+        def _check(blocks, i):
+            if not np.array_equal(
+                _as_np(blocks[0]), _PATTERN[i * 100:i * 100 + (2 << 20)]
+            ):
+                errors.append(AssertionError(f"corrupt read {i}"))
+
+        for i in range(6):
+            issue(i)
+        for ev in done:
+            assert ev.wait(30), "serve-credit read hung"
+        assert not errors, errors
+    finally:
+        _teardown(net, a, b)
+        GLOBAL_REGISTRY.enabled = prev_enabled
+
+
+def test_reader_striped_fetch_e2e_loopback():
+    """Manager-level reduce over loopback with striping forced on:
+    records come back exact and the stripe counters prove the striped
+    path actually ran."""
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+
+    prev_enabled = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    net = LoopbackNetwork()
+    conf_d = {
+        "spark.shuffle.tpu.driverPort": BASE_PORT + 160,
+        "spark.shuffle.tpu.transportNumStripes": 3,
+        "spark.shuffle.tpu.transportStripeThreshold": "64k",
+        # one fetch group may hold a whole multi-MB block
+        "spark.shuffle.tpu.shuffleReadBlockSize": "8m",
+        "spark.shuffle.tpu.maxAggBlock": "8m",
+    }
+    driver = TpuShuffleManager(
+        TpuShuffleConf(conf_d), is_driver=True, network=net,
+        port=BASE_PORT + 160, stage_to_device=False,
+    )
+    executors = [
+        TpuShuffleManager(
+            TpuShuffleConf(conf_d), is_driver=False, network=net,
+            port=BASE_PORT + 170 + i * 3, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(2)
+    ]
+    try:
+        stripes_before = GLOBAL_REGISTRY.counter(
+            "transport_stripes_total").value
+        part = HashPartitioner(2)
+        handle = driver.register_shuffle(31, 2, part)
+        maps_by_host = defaultdict(list)
+        expected = {}
+        for map_id in range(2):
+            ex = executors[map_id]
+            w = ex.get_writer(handle, map_id)
+            recs = [
+                (f"m{map_id}k{j}", bytes([j % 251]) * 40_000)
+                for j in range(40)
+            ]
+            expected.update(recs)
+            w.write(recs)
+            w.stop(True)
+            maps_by_host[ex.local_smid].append(map_id)
+        got = {}
+        for i, ex in enumerate(executors):
+            reader = ex.get_reader(handle, i, i + 1, dict(maps_by_host))
+            for k, v in reader.read():
+                got[k] = bytes(memoryview(v)) if not isinstance(v, bytes) \
+                    else v
+            assert reader.metrics.remote_blocks > 0
+        assert got == expected
+        stripes_after = GLOBAL_REGISTRY.counter(
+            "transport_stripes_total").value
+        assert stripes_after > stripes_before, (
+            "striped path never ran — threshold/grouping regression?"
+        )
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+        GLOBAL_REGISTRY.enabled = prev_enabled
+
+
+def test_killed_data_channel_fails_group_promptly():
+    """Stopping one data lane mid-striped-read surfaces a clean
+    TransportError on the whole group read (never a hang): each lane's
+    _fail_outstanding covers its stripes and the combiner fans the
+    first error out exactly once."""
+    conf = _conf(2, "128k")
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 200, conf)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        # pre-create the data lanes so the victim exists before the read
+        lanes = group.data_channels()
+        done = threading.Event()
+        res = {}
+        group.read_blocks(
+            [BlockLocation(0, 4 << 20, mkey)],
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("ok", blocks), done.set()),
+                lambda e: (res.setdefault("error", e), done.set()),
+            ),
+        )
+        lanes[0].stop()
+        assert done.wait(15), "striped read hung after lane death"
+        # either the whole payload raced home first, or the group
+        # failed cleanly — both are within the fetch contract
+        if "ok" in res:
+            assert np.array_equal(_as_np(res["ok"][0]),
+                                  _PATTERN[:4 << 20])
+        else:
+            assert isinstance(res["error"], Exception)
+    finally:
+        _teardown(net, a, b)
+
+
+def test_peer_death_mid_response_body_fails_listener():
+    """A peer that sends the OP_READ_RESP header then dies mid-body
+    must fail THAT read's listener promptly: the entry already left
+    _reads when the body receive started, so _fail_outstanding can't
+    cover it — the structured receive has to."""
+    import socket as socket_mod
+    import struct
+
+    from sparkrdma_tpu.transport import tcp as tcp_mod
+
+    port = BASE_PORT + 260
+    srv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port + 7))
+    srv.listen(4)
+
+    def evil_server():
+        while True:
+            try:
+                sock, _addr = srv.accept()
+            except OSError:
+                return
+            try:
+                sock.recv(tcp_mod._HELLO.size)       # hello
+                sock.sendall(b"\x01")                # ack
+                # one READ_REQ frame: header + req payload
+                hdr = sock.recv(tcp_mod._HDR.size)
+                _op, ln = tcp_mod._HDR.unpack(hdr)
+                req = b""
+                while len(req) < ln:
+                    req += sock.recv(ln - len(req))
+                (req_id,) = struct.unpack_from("<Q", req, 0)
+                # claim a full response, deliver the resp header +
+                # half a block, then die (no goodbye)
+                sock.sendall(tcp_mod._HDR.pack(
+                    tcp_mod.OP_READ_RESP,
+                    tcp_mod._RESP_HDR.size + tcp_mod._LEN.size + 1000,
+                ))
+                sock.sendall(tcp_mod._RESP_HDR.pack(req_id, 0))
+                sock.sendall(tcp_mod._LEN.pack(1000) + b"x" * 500)
+                sock.shutdown(socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+            finally:
+                sock.close()
+
+    t = threading.Thread(target=evil_server, daemon=True)
+    t.start()
+    net = TcpNetwork()
+    a = Node(("127.0.0.1", port), _conf(1, "128k"))
+    net.register(a)
+    try:
+        group = a.get_read_group(("127.0.0.1", port + 7), net.connect)
+        done = threading.Event()
+        res = {}
+        group.read_blocks(
+            [BlockLocation(0, 1000, 1)],
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("ok", blocks), done.set()),
+                lambda e: (res.setdefault("error", e), done.set()),
+            ),
+        )
+        assert done.wait(10), (
+            "listener stranded after peer death mid-body"
+        )
+        assert "error" in res
+    finally:
+        a.stop()
+        net.unregister(a)
+        srv.close()
+
+
+def test_malformed_read_request_keeps_channel_alive():
+    """A READ_REQ whose count field overruns the payload must get a
+    scoped status=1 reply (or be dropped when even the req_id is
+    garbage) — never kill the serving channel and its other reads."""
+    import struct
+
+    from sparkrdma_tpu.transport import tcp as tcp_mod
+    from sparkrdma_tpu.transport.channel import ChannelType
+
+    conf = _conf(1, "128k")
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 280, conf)
+    try:
+        ch = a.get_channel(
+            b.address, ChannelType.READ_REQUESTOR, net.connect
+        )
+        # hand-craft a request claiming 5 locations but carrying none
+        bogus = struct.pack("<QI", 999, 5)
+        ch._send_msg(tcp_mod.OP_READ_REQ, (bogus,))
+        # and one with an unparseable header
+        ch._send_msg(tcp_mod.OP_READ_REQ, (b"\x01",))
+        time.sleep(0.2)
+        # the channel still serves a real read afterwards
+        done = threading.Event()
+        res = {}
+        ch.read_blocks(
+            [BlockLocation(0, 4096, mkey)],
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("ok", blocks), done.set()),
+                lambda e: (res.setdefault("error", e), done.set()),
+            ),
+        )
+        assert done.wait(10), "read after malformed request hung"
+        assert "ok" in res, res.get("error")
+        assert np.array_equal(_as_np(res["ok"][0]), _PATTERN[:4096])
+    finally:
+        _teardown(net, a, b)
+
+
+def test_group_read_failure_converts_to_fetch_failed():
+    """Reader-level: a read group whose peer died surfaces as
+    FetchFailedError (stage-retriable), not a hang."""
+    from sparkrdma_tpu.shuffle.reader import FetchFailedError  # noqa: F401
+
+    conf = _conf(2, "128k")
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 220, conf)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        b.stop()  # peer gone: outstanding + future reads must fail
+        t0 = time.monotonic()
+        done = threading.Event()
+        res = {}
+        try:
+            group.read_blocks(
+                [BlockLocation(0, 2 << 20, mkey)],
+                FnCompletionListener(
+                    lambda blocks: (res.setdefault("ok", blocks),
+                                    done.set()),
+                    lambda e: (res.setdefault("error", e), done.set()),
+                ),
+            )
+        except Exception as e:
+            res["error"] = e
+            done.set()
+        assert done.wait(15), "read against dead peer hung"
+        assert "error" in res
+        assert time.monotonic() - t0 < 15
+    finally:
+        a.stop()
+        net.unregister(a)
+        net.unregister(b)

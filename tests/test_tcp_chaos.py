@@ -199,6 +199,97 @@ def _read_shuffle(driver, handle, maps_by_host, result):
     result["elapsed"] = time.monotonic() - t0
 
 
+def test_tcp_chaos_kill_data_channel_mid_striped_read():
+    """Kill ONE data lane of a striped channel group while a multi-MB
+    block is mid-flight across it: the fetch must either complete
+    BIT-EXACT (the stripes raced home first) or surface a clean
+    stage-retriable FetchFailedError promptly — never hang.  Each
+    lane's _fail_outstanding covers its stripes and the group combiner
+    fans the first error to the whole fetch."""
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager as Mgr
+
+    driver_port = BASE_PORT + 900
+    conf_d = {
+        "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "10s",
+        "spark.shuffle.tpu.connectTimeout": "5s",
+        "spark.shuffle.tpu.transportNumStripes": 2,
+        "spark.shuffle.tpu.transportStripeThreshold": "64k",
+        "spark.shuffle.tpu.shuffleReadBlockSize": "32m",
+        "spark.shuffle.tpu.maxAggBlock": "32m",
+        "spark.shuffle.tpu.maxBytesInFlight": "64m",
+    }
+    driver = Mgr(
+        TpuShuffleConf(conf_d), is_driver=True, network=TcpNetwork(),
+        port=driver_port, stage_to_device=False,
+    )
+    writer_ex = Mgr(
+        TpuShuffleConf(conf_d), is_driver=False, network=TcpNetwork(),
+        port=driver_port + 50, executor_id="w", stage_to_device=False,
+    )
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(writer_ex._peers) < 1:
+        time.sleep(0.01)
+    try:
+        part = HashPartitioner(1)
+        handle = driver.register_shuffle(77, 1, part)
+        rows = [(f"k{j}", bytes([j % 251]) * 65_536) for j in range(256)]
+        w = writer_ex.get_writer(handle, 0)
+        w.write(rows)  # one ~16 MB partition → many stripes
+        w.stop(True)
+        mbh = {writer_ex.local_smid: [0]}
+
+        res: dict = {}
+
+        def read():
+            try:
+                reader = driver.get_reader(handle, 0, 1, dict(mbh))
+                res["data"] = {
+                    k: bytes(memoryview(v)) for k, v in reader.read()
+                }
+            except (FetchFailedError, MetadataFetchFailedError) as e:
+                res["error"] = e
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        # grab the reader node's channel group to the writer and SIGKILL
+        # one data lane mid-read (socket shutdown, no goodbye)
+        victim = None
+        kill_deadline = time.monotonic() + 10
+        while victim is None and time.monotonic() < kill_deadline:
+            group = driver.node._read_groups.get(
+                (writer_ex.local_smid.host, writer_ex.local_smid.port)
+            )
+            if group is not None:
+                with driver.node._active_lock:
+                    active = list(driver.node._active.items())
+                lanes = [
+                    ch for (_p, _t, slot), ch in active
+                    if slot > 0 and ch.is_connected()
+                ]
+                if lanes:
+                    victim = lanes[0]
+                    victim.stop()
+                    break
+            time.sleep(0.0005)
+        t.join(timeout=30)
+        assert not t.is_alive(), "striped fetch hung after lane kill"
+        if "data" in res:
+            expected = {k: v for k, v in rows}
+            assert res["data"] == expected, "completed fetch not bit-exact"
+        else:
+            assert isinstance(
+                res["error"], (FetchFailedError, MetadataFetchFailedError)
+            )
+        # the retry path stays healthy: a fresh read completes exactly
+        reader2 = driver.get_reader(handle, 0, 1, dict(mbh))
+        got2 = {k: bytes(memoryview(v)) for k, v in reader2.read()}
+        assert got2 == {k: v for k, v in rows}
+    finally:
+        writer_ex.stop()
+        driver.stop()
+
+
 def test_tcp_chaos_sigkill_sweep():
     seed = int(os.environ.get("SPARKRDMA_TEST_CHAOS_SEED", "20260731"))
     trials = int(os.environ.get("SPARKRDMA_TCP_CHAOS_TRIALS", "20"))

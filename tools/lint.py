@@ -27,6 +27,13 @@ Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
         bulk.py) — the zero-copy data path stages into preallocated
         contiguous rows; per-block ``bytes`` materialization there is
         a regression (suppress a deliberate one with ``# noqa``)
+  PY10  payload concatenation / materialization on the TCP transport
+        hot paths (sparkrdma_tpu/transport/tcp.py): ``sendall(a + b)``
+        or ``sendall(b"".join(...))`` anywhere in the file, and
+        ``bytes(...)`` calls inside the hot send/serve/receive
+        functions — frames go out as sendmsg iovecs and land via
+        recv_into; an intermediate copy there is a regression
+        (suppress a deliberate one with ``# noqa``)
 
 C++ (native/):
   CC01  line longer than 100 characters
@@ -107,6 +114,48 @@ def _is_hot_path_copy(node: ast.Call) -> bool:
         and isinstance(f.value, ast.Constant)
         and f.value.value == b""
     )
+
+
+# TCP transport hot paths: PY10 bans concat-into-sendall anywhere in
+# the file and per-frame bytes() materialization inside these functions
+TCP_HOT_PATH = pathlib.Path("sparkrdma_tpu/transport/tcp.py")
+TCP_HOT_FUNCS = {
+    "_send_msg", "_sendmsg_all", "_serve_read", "_recv_read_resp",
+    "_recv_payload", "_recv_into", "_read_loop",
+}
+
+
+def _is_bytes_join(node: ast.expr) -> bool:
+    """``b"".join(...)``"""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "join"
+        and isinstance(node.func.value, ast.Constant)
+        and node.func.value.value == b""
+    )
+
+
+def _is_sendall_concat(node: ast.Call) -> bool:
+    """``<sock>.sendall(a + b)`` / ``<sock>.sendall(b"".join(...))``."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "sendall"):
+        return False
+    return any(
+        isinstance(a, ast.BinOp) and isinstance(a.op, ast.Add)
+        or _is_bytes_join(a)
+        for a in node.args
+    )
+
+
+def _tcp_hot_func_lines(tree: ast.AST) -> set:
+    """Line ranges of the TCP hot-path functions."""
+    lines = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in TCP_HOT_FUNCS):
+            lines.update(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+    return lines
 
 
 def _perf_counter_exempt(path: pathlib.Path, lib_dir: pathlib.Path) -> bool:
@@ -216,6 +265,33 @@ def lint_python(path: pathlib.Path, findings: list,
                  " in an exchange hot path (stage into preallocated "
                  "rows instead)")
             )
+
+    if rel == TCP_HOT_PATH:
+        hot_lines = _tcp_hot_func_lines(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            src_line = (
+                lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            )
+            if "# noqa" in src_line:
+                continue
+            if _is_sendall_concat(node):
+                findings.append(
+                    (rel, node.lineno, "PY10",
+                     "payload concatenation into sendall (send the "
+                     "parts as one sendmsg iovec instead)")
+                )
+            elif (
+                node.lineno in hot_lines
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "bytes"
+            ):
+                findings.append(
+                    (rel, node.lineno, "PY10",
+                     "per-frame bytes() materialization on a TCP hot "
+                     "path (use buffer views / recv_into instead)")
+                )
 
 
 def lint_cpp(path: pathlib.Path, findings: list) -> None:
