@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint test bench dryrun clean
+.PHONY: all native lint concheck test bench dryrun clean
 
 all: native
 
@@ -9,8 +9,16 @@ native:
 
 # style gate failing the build — the checkstyle/scalastyle analog
 # (reference pom.xml:93-141 runs both at validate, failsOnError=true)
+# — plus the concurrency lock-discipline gate (tools/concheck.py)
 lint:
 	python tools/lint.py
+	python tools/concheck.py
+
+# the concurrency gate alone: lock-order cycles/rank inversions (CK01),
+# blocking-under-lock (CK02), guarded-by discipline (CK03), unranked
+# locks (CK04) across sparkrdma_tpu/
+concheck:
+	python tools/concheck.py
 
 test: native lint
 	python -m pytest tests/ -x -q

@@ -401,6 +401,17 @@ class TpuShuffleConf:
         return self._bool("metrics", False)
 
     @property
+    def lock_debug(self) -> bool:
+        """Runtime lock sanitizer (utils/dbglock.py): rank-checked lock
+        wrappers with per-thread acquisition stacks and hold-time
+        histograms; raises LockOrderViolation on a same-thread rank
+        inversion.  Off by default — the transport/shuffle planes then
+        allocate plain ``threading`` primitives (zero overhead).  The
+        manager flips the process-global LockFactory on BEFORE building
+        its node, so every lock created under it is instrumented."""
+        return self._bool("lockDebug", False)
+
+    @property
     def metrics_json_path(self) -> str:
         """When set, manager.stop() writes a JSON snapshot of the
         registry here (executors suffix ``.<executor_id>`` so

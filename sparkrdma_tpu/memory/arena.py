@@ -16,7 +16,6 @@ bytes against ``max_buffer_allocation_size``, serves one-sided reads
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
@@ -24,6 +23,7 @@ import numpy as np
 
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.transport.channel import BlockStore, TransportError
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -207,11 +207,12 @@ class ArenaManager(BlockStore):
 
     def __init__(self, max_bytes: int = 0):
         self.max_bytes = max_bytes
-        self._segments: Dict[int, DeviceSegment] = {}
-        self._lock = threading.Lock()
+        self._segments: Dict[int, DeviceSegment] = {}  # guarded-by: _lock
+        self._lock = dbg_lock("arena.segments", 82)
         self._next_mkey = 1  # 0 is reserved for BlockLocation.EMPTY
-        self._total_bytes = 0
-        self._file_bytes = 0  # unbudgeted (file-backed mmap) segment bytes
+        self._total_bytes = 0  # guarded-by: _lock
+        # unbudgeted (file-backed mmap) segment bytes
+        self._file_bytes = 0  # guarded-by: _lock
         # stats
         self._registered_ever = 0
         self._released_ever = 0

@@ -21,10 +21,11 @@ not per commit.
 from __future__ import annotations
 
 import functools
-import threading
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 
 WRITE_ALIGN = 4096  # commit padding granularity (4 KiB, the mmap analog)
 
@@ -100,9 +101,9 @@ class DeviceArena:
         self.device = device if device is not None else jax.devices()[0]
         with jax.default_device(self.device):
             self.array = jnp.zeros((self.rows, ROW_BYTES), jnp.uint8)
-        self._lock = threading.Lock()
+        self._lock = dbg_lock("device_arena.free_list", 80)
         # first-fit free list: sorted non-adjacent (offset, nbytes)
-        self._free: List[Tuple[int, int]] = [(0, capacity)]
+        self._free: List[Tuple[int, int]] = [(0, capacity)]  # guarded-by: _lock
         self.allocated_bytes = 0
         self.peak_bytes = 0
         self.writes = 0

@@ -28,7 +28,7 @@ logger = logging.getLogger(__name__)
 
 
 _COMMIT_IO = None
-_COMMIT_IO_LOCK = threading.Lock()
+_COMMIT_IO_LOCK = threading.Lock()  # lock-order: 86
 
 
 def _commit_io_executor():

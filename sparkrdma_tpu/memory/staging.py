@@ -339,8 +339,12 @@ class StagingPool:
         # RLock: a cyclic-GC pass triggered INSIDE a locked region can
         # run an alloc_gc finalizer on the same thread, which takes
         # this lock again — re-entrant entry is safe (counter updates;
-        # destroy needs _closed, impossible mid-alloc)
-        self._lock = threading.RLock()
+        # destroy needs _closed, impossible mid-alloc).  Deliberately a
+        # PLAIN RLock, never a DebugLock: GC can fire the finalizer
+        # while the triggering thread holds ANY lock, so rank checks
+        # here would flag inversions that are not real lock-ordering
+        # commitments.
+        self._lock = threading.RLock()  # lock-order: 84
         self._closed = False
         # outstanding alloc_gc buffers: close() must DEFER destroying
         # the native pool until the last one is collected (destroying

@@ -87,7 +87,10 @@ class Counter:
         self.name = name
         self.labels = labels
         self._cells = [0] * _N_STRIPES
-        self._locks = [threading.Lock() for _ in range(_N_STRIPES)]
+        # metric leaf locks rank LAST (98): instruments record from
+        # under every other lock in the process; plain threading (not
+        # dbglock) because the sanitizer's own telemetry lands here
+        self._locks = [threading.Lock() for _ in range(_N_STRIPES)]  # lock-order: 98
 
     def inc(self, n: int = 1) -> None:
         i = _stripe()
@@ -112,7 +115,7 @@ class Gauge:
         self.name = name
         self.labels = labels
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 98
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -152,7 +155,7 @@ class Histogram:
             raise ValueError(f"bucket edges must ascend: {self.edges}")
         self._counts = [0] * (len(self.edges) + 1)
         self._sum = 0.0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 98
 
     def observe(self, v: float) -> None:
         idx = bisect.bisect_right(self.edges, v)
@@ -247,7 +250,7 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
         self._instruments: Dict[Tuple[str, str, LabelKey], object] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 96
 
     # -- handle factories ---------------------------------------------------
     def counter(self, name: str, force: bool = False, **labels) -> Counter:

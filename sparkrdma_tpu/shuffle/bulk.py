@@ -40,6 +40,7 @@ import numpy as np
 
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.parallel.exchange import TileExchange, row_offsets
+from sparkrdma_tpu.utils.dbglock import dbg_condition, dbg_lock
 from sparkrdma_tpu.rpc.messages import FetchExchangePlanMsg
 from sparkrdma_tpu.shuffle.reader import (
     FetchFailedError,
@@ -67,9 +68,9 @@ class BulkShuffleSession:
         # StagingPool.alloc_gc): zero-copy results then recycle their
         # buffers once the last consumer view dies
         self.out_alloc = out_alloc
-        self._cv = threading.Condition()
-        self._rows = {}
-        self._lengths = None
+        self._cv = dbg_condition("bulk.session", 26)
+        self._rows = {}  # guarded-by: _cv
+        self._lengths = None  # guarded-by: _cv
         # results keyed by ROUND generation: a waiter descheduled
         # across a whole subsequent round must still read its own
         # round's outcome, not the latest
@@ -277,9 +278,10 @@ class _ShuffleWindows:
     a final flag, and a sticky error."""
 
     def __init__(self):
-        self._cv = threading.Condition()
-        self._windows: List[List[tuple]] = []
-        self._events: List[tuple] = []  # (window, t, bytes) per deliver
+        self._cv = dbg_condition("bulk.windows", 28)
+        self._windows: List[List[tuple]] = []  # guarded-by: _cv
+        # (window, t, bytes) per deliver
+        self._events: List[tuple] = []  # guarded-by: _cv
         self.hosts = None   # canonical host order, pinned at window 0
         self.me = -1        # this executor's index in hosts
         self._done = False
@@ -357,8 +359,8 @@ class WindowedReadPlane:
         self._bulk = BulkExchangeReader(
             manager, exchange=exchange, mesh=mesh, session=session
         )
-        self._lock = threading.Lock()
-        self._shuffles = {}
+        self._lock = dbg_lock("bulk.plane", 24)
+        self._shuffles = {}  # guarded-by: _lock
 
     # -- reader factory (manager.get_reader hook) ---------------------------
     def reader(self, handle, start_partition: int, end_partition: int):

@@ -35,6 +35,7 @@ from sparkrdma_tpu.metrics import (
     write_json_snapshot,
     write_prometheus,
 )
+from sparkrdma_tpu.utils.dbglock import dbg_lock, dbg_rlock
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
@@ -159,9 +160,9 @@ class _FetchCallback:
                  on_error: Optional[Callable[[str], None]] = None):
         self.on_locations = on_locations
         self.on_error = on_error
-        self._parts: Dict[int, Tuple[BlockLocation, ...]] = {}
-        self._got = 0
-        self._lock = threading.Lock()
+        self._parts: Dict[int, Tuple[BlockLocation, ...]] = {}  # guarded-by: _lock
+        self._got = 0  # guarded-by: _lock
+        self._lock = dbg_lock("manager.fetch_callback", 22)
 
     def on_response(self, msg: FetchMapStatusResponseMsg) -> None:
         with self._lock:
@@ -170,10 +171,14 @@ class _FetchCallback:
             self._parts[msg.index] = msg.locations
             self._got += len(msg.locations)
             done = self._got >= msg.total
+            # snapshot under the lock; the callback runs outside it
+            # (it issues fetches) and a straggling duplicate segment
+            # must not mutate what we iterate
+            parts = dict(self._parts) if done else None
         if done:
             locs: List[BlockLocation] = []
-            for idx in sorted(self._parts):
-                locs.extend(self._parts[idx])
+            for idx in sorted(parts):
+                locs.extend(parts[idx])
             self.on_locations(locs)
 
     def on_failed(self, reason: str) -> None:
@@ -226,6 +231,12 @@ class TpuShuffleManager:
             # flip the process-wide registry on BEFORE any instrumented
             # object (node, arena, pool, writer) fetches its handles
             get_registry().enabled = True
+        if conf.lock_debug:
+            # same flow for the lock sanitizer: locks created from here
+            # on are rank-checked DebugLock wrappers (utils/dbglock.py)
+            from sparkrdma_tpu.utils.dbglock import get_lock_factory
+
+            get_lock_factory().enabled = True
         if serializer is not None:
             self.serializer = serializer
         else:
@@ -298,32 +309,39 @@ class TpuShuffleManager:
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)
-        self._executors: List[ShuffleManagerId] = []  # join order
-        self._removed: set = set()  # tombstones for pruned executors
-        self._executors_lock = threading.Lock()
+        # join order  # (see README "Concurrency discipline" rank table)
+        self._executors: List[ShuffleManagerId] = []  # guarded-by: _executors_lock
+        # tombstones for pruned executors
+        self._removed: set = set()  # guarded-by: _executors_lock
+        self._executors_lock = dbg_lock("manager.executors", 16)
         self._shuffle_partitions: Dict[int, int] = {}
         self._shuffle_num_maps: Dict[int, int] = {}
         # shuffle -> host smid -> map_id -> table
-        self._outputs: Dict[int, Dict[ShuffleManagerId, Dict[int, MapTaskOutput]]] = {}
-        self._outputs_lock = threading.Lock()
+        self._outputs: Dict[
+            int, Dict[ShuffleManagerId, Dict[int, MapTaskOutput]]
+        ] = {}  # guarded-by: _outputs_lock
+        self._outputs_lock = dbg_lock("manager.outputs", 14)
         # pending bulk-exchange plan requests (driver): shuffle_id →
         # [(msg, reply channel)], answered once every map published
-        self._plan_waiters: Dict[int, List] = {}
-        self._plan_cache: Dict[int, tuple] = {}
+        self._plan_waiters: Dict[int, List] = {}  # guarded-by: _plan_lock
+        self._plan_cache: Dict[int, tuple] = {}  # guarded-by: _plan_lock
         # bulk plans are only valid for the membership they were
         # registered under: every executor REMOVAL bumps the epoch and
         # dooms shuffles registered before it (additions are safe — the
         # cached snapshot keeps all requesters consistent)
-        self._membership_epoch = 0
-        self._shuffle_epoch: Dict[int, int] = {}
-        self._plan_lock = threading.Lock()
+        self._membership_epoch = 0  # guarded-by: _plan_lock
+        self._shuffle_epoch: Dict[int, int] = {}  # guarded-by: _plan_lock
+        self._plan_lock = dbg_lock("manager.plan", 12)
         # bumped (under _plan_lock) on every hello: lets the barrier
         # detect a hello that raced its pop/requeue of plan waiters
         self._hello_gen = 0
         # incremental (windowed) bulk plans: per-shuffle window state —
         # built in order under _window_lock (see _maybe_answer_windows)
-        self._window_state: Dict[int, dict] = {}
-        self._window_lock = threading.RLock()
+        self._window_state: Dict[int, dict] = {}  # guarded-by: _window_lock
+        # the OUTERMOST rank: window planning calls into the plan/
+        # outputs/executors locks below it; reentrant because
+        # _pin_window_hosts re-enters from _try_build_window
+        self._window_lock = dbg_rlock("manager.window", 10)
         # shuffle → first-seen plan mode (True = windowed); mixed modes
         # across hosts (conf skew) are rejected at request time
         self._plan_mode: Dict[int, bool] = {}
@@ -338,8 +356,8 @@ class TpuShuffleManager:
 
         # executor-side state
         self._peers: List[ShuffleManagerId] = []
-        self._callbacks: Dict[int, _FetchCallback] = {}
-        self._callbacks_lock = threading.Lock()
+        self._callbacks: Dict[int, _FetchCallback] = {}  # guarded-by: _callbacks_lock
+        self._callbacks_lock = dbg_lock("manager.callbacks", 18)
         self._next_callback_id = 1
         self._hello_sent = False
         self._stopped = False
@@ -347,9 +365,11 @@ class TpuShuffleManager:
         # record in), published to the driver at unregister time the
         # same way map-output locations flow; the driver keeps the last
         # _TELEMETRY_KEEP shuffles' per-host snapshots
-        self._telemetry: Dict[int, Dict[str, float]] = {}
-        self._telemetry_lock = threading.Lock()
-        self._shuffle_telemetry: Dict[int, Dict[str, Dict[str, float]]] = {}
+        self._telemetry: Dict[int, Dict[str, float]] = {}  # guarded-by: _telemetry_lock
+        self._telemetry_lock = dbg_lock("manager.telemetry", 20)
+        self._shuffle_telemetry: Dict[
+            int, Dict[str, Dict[str, float]]
+        ] = {}  # guarded-by: _telemetry_lock
         # unified reactive device plane (readPlane=windowed): attached
         # by the job layer (shared in-process session) or lazily built
         # by get_reader (one exchange per process on a multi-host mesh)
@@ -970,16 +990,18 @@ class TpuShuffleManager:
                         w for w in self._plan_waiters.get(shuffle_id, [])
                         if w[0].window >= 0
                     ]
+                    stale = (
+                        self._shuffle_epoch.get(shuffle_id)
+                        != self._membership_epoch
+                    )
                 if not win:
                     return
                 fail = st["failure"]
-                if fail is None and (
-                    self._shuffle_epoch.get(shuffle_id)
-                    != self._membership_epoch
-                ):
+                if fail is None and stale:
                     fail = st["failure"] = (
-                        "membership changed since shuffle registration "
-                        "(executor lost) — retry the stage"
+                        "membership changed since shuffle "
+                        "registration (executor lost) — retry "
+                        "the stage"
                     )
                 if fail is not None:
                     self._fail_window_waiters(shuffle_id, fail)

@@ -12,10 +12,10 @@ Backed by one contiguous ``bytearray`` rather than per-entry objects so a
 
 from __future__ import annotations
 
-import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import List
 
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import (
     LOCATION_ENTRY_SIZE,
     BlockLocation,
@@ -33,9 +33,9 @@ class MapTaskOutput:
         self._buf = bytearray(num_partitions * LOCATION_ENTRY_SIZE)
         # distinct-partition fill tracking: re-delivered publish segments
         # (RPC retries, overlapping ranges) must not double-count
-        self._filled_flags = bytearray(num_partitions)
-        self._filled = 0
-        self._lock = threading.Lock()
+        self._filled_flags = bytearray(num_partitions)  # guarded-by: _lock
+        self._filled = 0  # guarded-by: _lock
+        self._lock = dbg_lock("map_output.fill", 36)
         self._fill_future: Future = Future()
 
     # -- write side ---------------------------------------------------------
@@ -67,11 +67,24 @@ class MapTaskOutput:
         n = last - first + 1
         with self._lock:
             already = self._filled_flags.count(1, first, last + 1)
+            complete = False
             if already < n:
                 self._filled_flags[first : last + 1] = b"\x01" * n
                 self._filled += n - already
-            if self._filled >= self.num_partitions and not self._fill_future.done():
+                complete = self._filled >= self.num_partitions
+        if complete:
+            # OUTSIDE the lock: set_result runs done-callbacks inline
+            # (the driver's window-plan retrigger takes manager locks
+            # ranked far ABOVE this leaf) — firing it under _lock was a
+            # latent order inversion, caught by the rank sanitizer.
+            # Only the thread that crossed the threshold gets here
+            # (fills are monotonic under _lock), so the only possible
+            # race is remove_executor's set_exception — tolerate it the
+            # same way it tolerates us.
+            try:
                 self._fill_future.set_result(self)
+            except InvalidStateError:
+                pass  # lost the race; the failed future stands
 
     # -- read side ----------------------------------------------------------
     def get_location(self, partition_id: int) -> BlockLocation:

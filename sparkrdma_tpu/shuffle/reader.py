@@ -30,6 +30,7 @@ from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.transport.channel import FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.serde import Record
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.utils.types import BlockLocation, ShuffleManagerId
@@ -122,11 +123,13 @@ class ShuffleReader:
         self.maps_by_host = maps_by_host
         self.metrics = ReadMetrics()
         self._results: "queue.Queue[_Result]" = queue.Queue()
-        self._pending: List[_PendingFetch] = []
-        self._pending_lock = threading.Lock()
-        self._bytes_in_flight = 0
-        self._outstanding_blocks = 0  # non-empty remote blocks not yet delivered
-        self._awaiting_hosts = 0      # hosts whose locations are unresolved
+        self._pending: List[_PendingFetch] = []  # guarded-by: _pending_lock
+        self._pending_lock = dbg_lock("reader.pending", 30)
+        self._bytes_in_flight = 0  # guarded-by: _pending_lock
+        # non-empty remote blocks not yet delivered
+        self._outstanding_blocks = 0  # guarded-by: _pending_lock
+        # hosts whose locations are unresolved
+        self._awaiting_hosts = 0  # guarded-by: _pending_lock
         self._failed: Optional[FetchFailedError] = None
         self._timers: List[threading.Timer] = []
         self._callback_ids: List[int] = []

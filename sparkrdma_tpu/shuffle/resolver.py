@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -26,6 +25,7 @@ from sparkrdma_tpu.memory.arena import ArenaManager, DeviceSegment
 from sparkrdma_tpu.memory.device_arena import ROW_BYTES as _ROW_BYTES
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.transport.node import Node
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
 
 logger = logging.getLogger(__name__)
@@ -89,7 +89,10 @@ class ShuffleBlockResolver:
         # arena on demand (ensure_staged), optionally swept ahead by
         # prefetch_shuffle (RdmaMappedFile.java:158-168's odp prefetch)
         self.lazy_staging = lazy_staging
-        self._stage_lock = threading.Lock()
+        # ranks BELOW the arena/device-arena locks it calls into while
+        # staging a segment (ensure_staged holds it across the
+        # alloc + write + replace sequence)
+        self._stage_lock = dbg_lock("resolver.stage", 32)
         self.staging_pool = staging_pool  # pooled host buffers for concat
         # persistent per-device HBM arena (set when the executor is
         # attached to a collective network); commits then land as arena
@@ -108,8 +111,8 @@ class ShuffleBlockResolver:
         # fragmented arena allocatable and large map outputs from
         # needing one contiguous extent
         self.write_block_size = max(int(write_block_size), 1)
-        self._shuffles: Dict[int, _ShuffleData] = {}
-        self._lock = threading.Lock()
+        self._shuffles: Dict[int, _ShuffleData] = {}  # guarded-by: _lock
+        self._lock = dbg_lock("resolver.shuffles", 34)
 
     @property
     def commit_align(self) -> int:

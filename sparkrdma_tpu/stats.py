@@ -77,7 +77,7 @@ class ShuffleReaderStats:
         self._num_buckets = conf.fetch_time_num_buckets
         self._global = self._make("all")
         self._per_host: Dict[str, FetchHistogram] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 90
 
     def _make(self, host: str) -> FetchHistogram:
         edges = [

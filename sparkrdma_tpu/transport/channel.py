@@ -29,6 +29,7 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from sparkrdma_tpu.metrics import gauge
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -94,14 +95,16 @@ class Channel:
     def __init__(self, channel_type: ChannelType, send_queue_depth: int = 4096):
         self.channel_type = channel_type
         self._state = ChannelState.IDLE
-        self._state_lock = threading.Lock()
+        self._state_lock = dbg_lock("channel.state", 60)
         # send-WR budget: number of outstanding posted operations
         self._budget = threading.Semaphore(send_queue_depth)
         self._send_queue_depth = send_queue_depth
-        self._pending: deque = deque()  # (post_fn, listener)
-        self._pending_lock = threading.Lock()
-        self._outstanding: set = set()  # listeners awaiting completion
-        self._outstanding_lock = threading.Lock()
+        # (post_fn, listener) pairs
+        self._pending: deque = deque()  # guarded-by: _pending_lock
+        self._pending_lock = dbg_lock("channel.pending", 62)
+        # listeners awaiting completion
+        self._outstanding: set = set()  # guarded-by: _outstanding_lock
+        self._outstanding_lock = dbg_lock("channel.outstanding", 64)
         # active-channel gauge handle, held between CONNECTED and stop()
         self._m_active_gauge = None
 

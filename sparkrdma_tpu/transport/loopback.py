@@ -19,7 +19,6 @@ same failure semantics the reference gets from CQ error completions.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional
 
@@ -34,6 +33,7 @@ from sparkrdma_tpu.transport.channel import (
     TransportError,
 )
 from sparkrdma_tpu.transport.node import Address, Node
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 
 _PAIRED = {
     ChannelType.RPC_REQUESTOR: ChannelType.RPC_RESPONDER,
@@ -87,10 +87,11 @@ class LoopbackChannel(Channel):
             ChannelType.RPC_REQUESTOR, ChannelType.RPC_RESPONDER,
             ChannelType.RPC_WRAPPER,
         )
-        self._credits = conf.recv_queue_depth
-        self._credit_lock = threading.Lock()
-        self._credit_waiting: List = []  # (frames, listener) blocked on credits
-        self._consumed_since_report = 0
+        self._credits = conf.recv_queue_depth  # guarded-by: _credit_lock
+        self._credit_lock = dbg_lock("loopback.credits", 66)
+        # (frames, listener) blocked on credits
+        self._credit_waiting: List = []  # guarded-by: _credit_lock
+        self._consumed_since_report = 0  # guarded-by: _credit_lock
         self._report_threshold = max(1, conf.recv_queue_depth // 2)
         self._m_bytes_sent = counter(
             "transport_bytes_sent_total", transport="loopback")
@@ -273,9 +274,10 @@ class LoopbackNetwork:
     """Registry of in-process nodes + connector, with failure injection."""
 
     def __init__(self):
-        self._nodes: Dict[Address, Node] = {}
-        self._lock = threading.Lock()
-        self._partitioned: set = set()  # frozenset({a, b}) pairs or single addr
+        self._nodes: Dict[Address, Node] = {}  # guarded-by: _lock
+        self._lock = dbg_lock("loopback.network", 56)
+        # frozenset({a, b}) pairs or single addr
+        self._partitioned: set = set()  # guarded-by: _lock
 
     # -- membership ---------------------------------------------------------
     def register(self, node: Node) -> None:

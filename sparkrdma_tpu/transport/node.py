@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.utils.dbglock import dbg_condition, dbg_lock
 from sparkrdma_tpu.transport.channel import (
     BlockStore,
     Channel,
@@ -58,8 +59,8 @@ class _ServePool:
     def __init__(self, name: str, workers: int, credit_bytes: int,
                  init_fn=None):
         self._budget = max(int(credit_bytes), 1)
-        self._credits = self._budget
-        self._cv = threading.Condition()
+        self._credits = self._budget  # guarded-by: _cv
+        self._cv = dbg_condition("node.serve_credits", 50)
         self._queue: "queue.Queue" = queue.Queue()
         self._stopped = False
         self._m_depth = gauge("transport_serve_queue_depth")
@@ -146,18 +147,20 @@ class Node:
         # owning manager; TCP read responses land in pooled buffers)
         self.staging_pool = None
         self._receive_listener: Optional[ReceiveListener] = None
-        self._block_stores: Dict[int, BlockStore] = {}
-        self._block_store_lock = threading.Lock()
+        self._block_stores: Dict[int, BlockStore] = {}  # guarded-by: _block_store_lock
+        self._block_store_lock = dbg_lock("node.block_stores", 48)
         # active (locally initiated) channels keyed by (peer, type, slot)
         # — slots > 0 are the striped data lanes of a peer's channel
         # group (transport/stripe.py)
-        self._active: Dict[Tuple[Address, ChannelType, int], Channel] = {}
-        self._active_lock = threading.Lock()
+        self._active: Dict[
+            Tuple[Address, ChannelType, int], Channel
+        ] = {}  # guarded-by: _active_lock
+        self._active_lock = dbg_lock("node.active", 42)
         # per-peer striped read groups (lazy; share the channel cache)
-        self._read_groups: Dict[Address, object] = {}
-        self._read_groups_lock = threading.Lock()
-        self._passive: List[Channel] = []
-        self._passive_lock = threading.Lock()
+        self._read_groups: Dict[Address, object] = {}  # guarded-by: _read_groups_lock
+        self._read_groups_lock = dbg_lock("node.read_groups", 44)
+        self._passive: List[Channel] = []  # guarded-by: _passive_lock
+        self._passive_lock = dbg_lock("node.passive", 46)
         # completion/dispatch pool — the RdmaThread analog: completions and
         # inbound frames are delivered off the caller's thread.  When
         # conf dispatcherCpuList (legacy alias: spark.shuffle.rdma
@@ -175,7 +178,7 @@ class Node:
         # nor the channel reader loops, and its byte credits bound how
         # much registered memory concurrent serves pin
         self._serve_pool: Optional[_ServePool] = None
-        self._serve_lock = threading.Lock()
+        self._serve_lock = dbg_lock("node.serve_pool", 40)
         self._stopped = threading.Event()
 
     # -- dispatcher thread placement ----------------------------------------

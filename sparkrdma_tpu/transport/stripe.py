@@ -28,7 +28,6 @@ dead data channel surfaces as a prompt fetch failure, never a hang.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Sequence
 
 import numpy as np
@@ -39,6 +38,7 @@ from sparkrdma_tpu.transport.channel import (
     CompletionListener,
     FnCompletionListener,
 )
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -67,8 +67,11 @@ class _GroupRead:
         self.out = out
         self.rows = rows  # indices whose out[] entry is a dest row
         self.on_progress = on_progress
-        self.pending = pending
-        self.lock = threading.Lock()
+        self.pending = pending  # guarded-by: lock
+        self.lock = dbg_lock("stripe.group", 54)
+        # read UNLOCKED by progress() as a suppress hint (racy by
+        # design — a late progress report is harmless); writes stay
+        # under the lock
         self.finished = False
 
     def progress(self, n: int) -> None:
@@ -111,8 +114,8 @@ class ReadGroup:
         conf = node.conf
         self.num_stripes = conf.transport_num_stripes
         self.threshold = max(conf.transport_stripe_threshold, 1)
-        self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr = 0  # guarded-by: _rr_lock
+        self._rr_lock = dbg_lock("stripe.rr", 52)
         self._m_stripes = counter("transport_stripes_total")
         self._m_stripe_bytes = counter("transport_stripe_bytes_total")
         self._m_striped_reads = counter("transport_striped_reads_total")

@@ -46,6 +46,7 @@ from sparkrdma_tpu.transport.channel import (
     TransportError,
 )
 from sparkrdma_tpu.transport.node import Address, Node
+from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
 
 logger = logging.getLogger(__name__)
@@ -141,11 +142,11 @@ class TcpChannel(Channel):
             node.conf.transport_scatter_gather
             and hasattr(sock, "sendmsg")
         )
-        self._send_lock = threading.Lock()
-        self._next_req = 1
+        self._send_lock = dbg_lock("tcp.send", 70)
+        self._next_req = 1  # guarded-by: _reads_lock
         # req_id -> (count, listener, post time, dest, on_progress)
-        self._reads: Dict[int, Tuple] = {}
-        self._reads_lock = threading.Lock()
+        self._reads: Dict[int, Tuple] = {}  # guarded-by: _reads_lock
+        self._reads_lock = dbg_lock("tcp.reads", 68)
         self._reader: Optional[threading.Thread] = None
         self._m_bytes_sent = counter(
             "transport_bytes_sent_total", transport="tcp")
@@ -199,11 +200,17 @@ class TcpChannel(Channel):
         views = [v for v in map(_as_view, parts) if v.nbytes]
         length = sum(v.nbytes for v in views)
         hdr = _HDR.pack(opcode, length)
+        # blocking socket writes under _send_lock are THE POINT here:
+        # this per-channel mutex serializes whole frames onto the wire
+        # (interleaved sendmsg calls would shear frames).  It ranks
+        # last among the TRANSPORT locks (70) so no transport lock can
+        # be requested while a send is in flight (the 80+ ranks above
+        # it are memory/metrics leaves).
         with self._send_lock:
             if self._sg:
-                self._sendmsg_all([memoryview(hdr)] + views)
+                self._sendmsg_all([memoryview(hdr)] + views)  # noqa: CK02
             else:
-                self._send_concat(hdr, views)
+                self._send_concat(hdr, views)  # noqa: CK02
         self._m_msgs_sent.inc()
         self._m_bytes_sent.inc(_HDR.size + length)
 
@@ -478,8 +485,8 @@ class TcpNetwork:
         self.listen_backlog = listen_backlog
         self._listeners: Dict[
             Address, Tuple[socket.socket, threading.Thread, Node]
-        ] = {}
-        self._lock = threading.Lock()
+        ] = {}  # guarded-by: _lock
+        self._lock = dbg_lock("tcp.network", 57)
 
     # -- membership ---------------------------------------------------------
     def register(self, node: Node) -> None:

@@ -27,7 +27,7 @@ class Tracer:
         self.max_events = max_events
         self.dropped = 0
         self._events: List[Dict] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 92
         self._t0 = time.perf_counter()
 
     def _append(self, event: Dict) -> None:

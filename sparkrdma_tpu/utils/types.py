@@ -153,7 +153,7 @@ class ShuffleManagerId:
 
 
 _smid_cache: Dict[ShuffleManagerId, ShuffleManagerId] = {}
-_smid_lock = threading.Lock()
+_smid_lock = threading.Lock()  # lock-order: 94
 
 
 def get_cached_shuffle_manager_id(smid: ShuffleManagerId) -> ShuffleManagerId:

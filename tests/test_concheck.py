@@ -1,0 +1,346 @@
+"""Tier-1 wrapper + unit fixtures for the concurrency gate
+(tools/concheck.py): the real tree must be clean, and seeded
+violations must each produce exactly their CK finding."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_concheck():
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_concheck", REPO / "tools" / "concheck.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _analyze_src(tmp_path, src: str):
+    cc = _load_concheck()
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(src))
+    return cc.analyze([f], root=tmp_path)
+
+
+def _codes(findings):
+    return sorted({code for _rel, _line, code, _msg in findings})
+
+
+# -- tier-1: the real tree ----------------------------------------------------
+
+
+def test_library_is_concheck_clean():
+    cc = _load_concheck()
+    findings = cc.analyze([REPO / "sparkrdma_tpu"])
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {code} {msg}" for rel, line, code, msg in findings
+    )
+
+
+def test_library_every_lock_is_ranked():
+    """CK04-clean AND nonempty: the analyzer actually discovered the
+    lock population (a discovery regression would pass vacuously)."""
+    cc = _load_concheck()
+    an = cc.Analyzer()
+    an.analyze_paths([REPO / "sparkrdma_tpu"])
+    assert len(an.decls) >= 35, sorted(an.decls)
+    assert all(d.rank is not None for d in an.decls.values())
+
+
+# -- CK01: lock-order cycles --------------------------------------------------
+
+
+def test_ck01_seeded_lock_order_cycle(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()  # lock-order: 10
+                self._b = threading.Lock()  # lock-order: 20
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert _codes(findings) == ["CK01"], findings
+    # the inversion anchors at backward()'s inner acquisition
+    assert any(line == 15 for _r, line, _c, _m in findings), findings
+
+
+def test_ck01_nested_nonreentrant_lock(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()  # lock-order: 10
+
+            def deadlock(self):
+                with self._a:
+                    with self._a:
+                        pass
+    """)
+    assert _codes(findings) == ["CK01"], findings
+
+
+def test_ck01_through_self_call_closure(tmp_path):
+    """The nested-acquisition graph crosses same-class method calls."""
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()  # lock-order: 10
+                self._b = threading.Lock()  # lock-order: 20
+
+            def outer(self):
+                with self._b:
+                    self._helper()
+
+            def _helper(self):
+                with self._a:
+                    pass
+    """)
+    assert _codes(findings) == ["CK01"], findings
+
+
+def test_reentrant_rlock_is_not_a_cycle(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._r = threading.RLock()  # lock-order: 10
+
+            def reenter(self):
+                with self._r:
+                    self._helper()
+
+            def _helper(self):
+                with self._r:
+                    pass
+    """)
+    assert not findings, findings
+
+
+# -- CK02: blocking while locked ----------------------------------------------
+
+
+def test_ck02_sendall_under_lock(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self, sock):
+                self._lock = threading.Lock()  # lock-order: 10
+                self._sock = sock
+
+            def bad(self, data):
+                with self._lock:
+                    self._sock.sendall(data)
+
+            def fine(self, data):
+                self._sock.sendall(data)
+
+            def escaped(self, data):
+                with self._lock:
+                    self._sock.sendall(data)  # noqa: CK02
+    """)
+    assert _codes(findings) == ["CK02"], findings
+    assert len(findings) == 1 and findings[0][1] == 10, findings
+
+
+def test_ck02_condition_wait_on_different_lock(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()  # lock-order: 10
+                self._cv = threading.Condition()  # lock-order: 20
+
+            def bad(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait()
+
+            def fine(self):
+                with self._cv:
+                    self._cv.wait()
+    """)
+    # bad(): waiting on _cv releases only _cv while _lock stays held
+    assert "CK02" in _codes(findings), findings
+    ck02 = [f for f in findings if f[2] == "CK02"]
+    assert len(ck02) == 1 and ck02[0][1] == 11, findings
+
+
+def test_ck02_event_wait_and_queue_get_under_lock(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()  # lock-order: 10
+                self._ev = threading.Event()
+                self._q = queue.Queue()
+
+            def bad_wait(self):
+                with self._lock:
+                    self._ev.wait()
+
+            def bad_get(self):
+                with self._lock:
+                    return self._q.get()
+
+            def fine_nowait(self):
+                with self._lock:
+                    return self._q.get_nowait()
+    """)
+    assert _codes(findings) == ["CK02"], findings
+    assert sorted(f[1] for f in findings) == [12, 16], findings
+
+
+# -- CK03: guarded attributes -------------------------------------------------
+
+
+def test_ck03_guarded_attribute_escape(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()  # lock-order: 10
+                self._items = []  # guarded-by: _lock
+
+            def locked_ok(self):
+                with self._lock:
+                    self._items.append(1)
+
+            def init_exempt_is_only_for_init(self):
+                return len(self._items)
+
+            def escaped(self):
+                return list(self._items)  # noqa: CK03
+    """)
+    assert _codes(findings) == ["CK03"], findings
+    assert len(findings) == 1 and findings[0][1] == 13, findings
+
+
+def test_ck03_unknown_guard_lock_is_flagged(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._items = []  # guarded-by: _nope
+    """)
+    assert _codes(findings) == ["CK03"], findings
+
+
+# -- CK04: undeclared locks ---------------------------------------------------
+
+
+def test_ck04_unranked_lock(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """)
+    assert _codes(findings) == ["CK04"], findings
+
+
+def test_ck04_rank_via_dbg_call_and_mismatch(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        from sparkrdma_tpu.utils.dbglock import dbg_lock
+
+        class A:
+            def __init__(self):
+                self._ok = dbg_lock("a.ok", 42)
+
+        class B:
+            def __init__(self):
+                self._bad = dbg_lock("b.bad", 42)  # lock-order: 13
+    """)
+    assert _codes(findings) == ["CK04"], findings
+    assert len(findings) == 1 and "disagrees" in findings[0][3], findings
+
+
+def test_ck04_module_level_lock(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        _OK = threading.Lock()  # lock-order: 5
+        _BAD = threading.Lock()
+    """)
+    assert _codes(findings) == ["CK04"], findings
+    assert len(findings) == 1 and findings[0][1] == 4, findings
+
+
+def test_nested_class_methods_are_scanned(tmp_path):
+    """Classes nested in classes (and in functions) get the full
+    treatment — a lock gate that skips helper classes is no gate."""
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class Outer:
+            class Inner:
+                def __init__(self):
+                    self._lock = threading.Lock()  # lock-order: 10
+                    self._state = 0  # guarded-by: _lock
+
+                def deadlock(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+
+                def unguarded(self):
+                    return self._state
+
+        def factory():
+            class Local:
+                def __init__(self):
+                    self._l = threading.Lock()  # lock-order: 20
+                    self._v = []  # guarded-by: _l
+
+                def bad(self):
+                    self._v.append(1)
+            return Local
+    """)
+    assert _codes(findings) == ["CK01", "CK03"], findings
+    ck03_lines = sorted(l for _r, l, c, _m in findings if c == "CK03")
+    assert ck03_lines == [15, 24], findings
+
+
+def test_ck03_applies_to_closures_defined_in_init(tmp_path):
+    """A worker closure defined in __init__ runs on another thread —
+    the __init__ exemption must not leak into it."""
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()  # lock-order: 10
+                self._cache = {}  # guarded-by: _lock
+                self._t = threading.Thread(
+                    target=lambda: self._cache.clear()
+                )
+
+            def guarded(self):
+                with self._lock:
+                    self._cache.clear()
+    """)
+    assert _codes(findings) == ["CK03"], findings
+    assert findings[0][1] == 8, findings

@@ -149,3 +149,98 @@ def test_py10_flags_tcp_hot_path_concat(tmp_path):
         ("sparkrdma_tpu/transport/tcp.py", 4),
         ("sparkrdma_tpu/transport/tcp.py", 6),
     ], findings
+
+
+def test_noqa_is_code_scoped(tmp_path):
+    """# noqa: PYxx suppresses only PYxx; a scoped escape for one rule
+    can no longer blanket-silence an unrelated hot-path rule."""
+    lint = _load_lint()
+    lib = tmp_path / "sparkrdma_tpu"
+    (lib / "transport").mkdir(parents=True)
+    hot = lib / "transport" / "tcp.py"
+    hot.write_text(
+        "class C:\n"
+        "    def _send_msg(self, a, b):\n"
+        "        self._sock.sendall(a + b)  # noqa: PY05\n"
+        "        self._sock.sendall(a + b)  # noqa: PY10\n"
+        "        self._sock.sendall(a + b)  # noqa\n"
+        "        self._sock.sendall(a + b)  # noqa: PY02, PY10\n"
+    )
+    findings = []
+    lint.lint_python(hot, findings, root=tmp_path)
+    py10 = [line for _r, line, code, _m in findings if code == "PY10"]
+    # only line 3 survives: its escape names an unrelated code
+    assert py10 == [3], findings
+
+
+def test_py05_noqa_on_multiline_from_import(tmp_path):
+    """The escape is honored on the imported name's OWN line inside a
+    multi-line from-import, and on the statement's first line."""
+    lint = _load_lint()
+    (tmp_path / "tools").mkdir()
+    f = tmp_path / "tools" / "a.py"
+    f.write_text(
+        "from os import (\n"
+        "    getcwd,\n"
+        "    sep,  # noqa: PY05\n"
+        ")\n"
+        "from sys import (  # noqa: PY05\n"
+        "    argv,\n"
+        "    path,\n"
+        ")\n"
+    )
+    findings = []
+    lint.lint_python(f, findings, root=tmp_path)
+    py05 = [(line, msg) for _r, line, code, msg in findings
+            if code == "PY05"]
+    # getcwd (line 2) flags at its own line; sep escaped on its line;
+    # argv/path escaped by the statement-line noqa
+    assert py05 == [(2, "unused import: getcwd")], findings
+
+
+def test_py05_f401_alias_and_ast_usage(tmp_path):
+    """F401 (the flake8 code) suppresses PY05; string annotations and
+    __all__ exports count as real uses."""
+    lint = _load_lint()
+    (tmp_path / "tools").mkdir()
+    f = tmp_path / "tools" / "b.py"
+    f.write_text(
+        "import json  # noqa: F401\n"
+        "import os\n"
+        "import struct\n"
+        "import sys\n"
+        "__all__ = [\"os\"]\n"
+        "def g(x: \"struct.Struct\") -> None:\n"
+        "    return None\n"
+    )
+    findings = []
+    lint.lint_python(f, findings, root=tmp_path)
+    py05 = [msg for _r, _l, code, msg in findings if code == "PY05"]
+    # json: F401-aliased escape; os: __all__ export; struct: string
+    # annotation; sys: genuinely unused
+    assert py05 == ["unused import: sys"], findings
+
+
+def test_noqa_code_followed_by_justification_prose(tmp_path):
+    """The documented escape style '# noqa: CK02 <why>' scopes to the
+    leading code token(s); the prose does not widen or break it."""
+    lint = _load_lint()
+    assert lint._noqa_codes("x()  # noqa: PY10 frame serialization") \
+        == {"PY10"}
+    assert lint._noqa_codes("x()  # noqa: CK02, CK03 deliberate") \
+        == {"CK02", "CK03"}
+    assert lint._noqa_codes("x()  # noqa") == set()
+    assert lint._noqa_codes("x()") is None
+    lib = tmp_path / "sparkrdma_tpu"
+    (lib / "transport").mkdir(parents=True)
+    hot = lib / "transport" / "tcp.py"
+    hot.write_text(
+        "class C:\n"
+        "    def _send_msg(self, a, b):\n"
+        "        self._sock.sendall(a + b)  # noqa: PY10 serialized\n"
+        "        self._sock.sendall(a + b)  # noqa: PY05 wrong code\n"
+    )
+    findings = []
+    lint.lint_python(hot, findings, root=tmp_path)
+    py10 = [line for _r, line, code, _m in findings if code == "PY10"]
+    assert py10 == [4], findings

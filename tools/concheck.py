@@ -1,0 +1,837 @@
+#!/usr/bin/env python
+"""Concurrency lock-discipline analyzer — the deadlock/race gate.
+
+``make lint`` runs this next to tools/lint.py.  The library's threaded
+planes (per-peer stripe lanes, the bounded serve pool, double-buffered
+window assembly, heartbeat + dispatcher threads) hang off ~40
+Lock/RLock/Condition sites; this pass discovers every one of them in
+``sparkrdma_tpu/`` plus every ``with <lock>:`` region, and enforces:
+
+  CK01  lock-order violation: the nested-acquisition graph (built from
+        syntactic nesting AND one class's self-call closure) must be
+        acyclic and must agree with the declared ``# lock-order`` ranks
+        — an inner acquisition's rank must be strictly greater than
+        every held rank.  Nested re-acquisition of a non-reentrant
+        ``Lock`` is a guaranteed deadlock and flags immediately.
+  CK02  blocking while locked: socket ``sendall``/``sendmsg``/``recv``/
+        ``recv_into``/``accept``/``connect``, ``Thread.join``,
+        ``Event.wait``, ``queue.Queue.get`` (not ``get_nowait``),
+        ``subprocess.*``, or a ``Condition.wait`` on anything but the
+        innermost held lock, inside a held ``with`` region — directly
+        or through a same-class method call.  Deliberate cases carry a
+        code-scoped ``# noqa: CK02`` with a justification comment.
+  CK03  unguarded shared state: an attribute declared
+        ``self._x = ...  # guarded-by: _lock`` may only be read or
+        written inside a ``with <owner>._lock:`` region (or in
+        ``__init__``, before the object escapes its creating thread).
+  CK04  undeclared lock: every lock attribute must carry a rank — a
+        ``# lock-order: N`` comment on its creation line, or the rank
+        argument of a ``dbg_lock``/``dbg_rlock``/``dbg_condition`` call
+        (utils/dbglock.py validates the same ranks at runtime).
+
+Annotation grammar::
+
+    self._lock = threading.Lock()  # lock-order: 42
+    self._lock = dbg_lock("node.active", 42)        # rank from the call
+    self._cache = {}  # guarded-by: _lock
+
+Suppressions are code-scoped: ``# noqa: CK02`` silences only CK02 on
+that line; a bare ``# noqa`` silences everything (discouraged).
+
+Usage: ``python tools/concheck.py [paths...]`` (default: the library).
+Exit status 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+LIB = ROOT / "sparkrdma_tpu"
+
+THREADING_LOCKS = {"Lock": "Lock", "RLock": "RLock",
+                   "Condition": "Condition"}
+DBG_CTORS = {"dbg_lock": "Lock", "dbg_rlock": "RLock",
+             "dbg_condition": "Condition"}
+SOCKET_BLOCKING = {"sendall", "sendmsg", "recv", "recv_into", "accept",
+                   "connect", "create_connection"}
+
+RANK_RE = re.compile(r"#\s*lock-order:\s*(-?\d+)")
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+# ONE noqa grammar + suppression decision for both gates: tools/lint.py
+# owns the definition (code-scoped sets, bare-noqa = everything, alias
+# handling)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from lint import _suppressed as _lint_suppressed
+
+Finding = Tuple[object, int, str, str]  # (rel, line, code, message)
+LockId = Tuple[str, ...]
+
+
+class _Suppressor:
+    def __init__(self, lines: List[str]):
+        self._lines = lines
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        return _lint_suppressed(self._lines, lineno, code)
+
+
+class LockDecl:
+    __slots__ = ("lock_id", "kind", "rank", "line", "group", "name")
+
+    def __init__(self, lock_id: LockId, kind: str, rank: Optional[int],
+                 line: int, group: bool, name: str):
+        self.lock_id = lock_id
+        self.kind = kind
+        self.rank = rank
+        self.line = line
+        self.group = group
+        self.name = name
+
+
+class ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: Dict[str, LockDecl] = {}
+        self.events: Set[str] = set()
+        self.queues: Set[str] = set()
+        self.threads: Set[str] = set()
+        self.guarded: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
+        self.methods: Dict[str, ast.AST] = {}
+
+
+class ModuleInfo:
+    def __init__(self, rel: str, lines: List[str], tree: ast.Module):
+        self.rel = rel
+        self.lines = lines
+        self.tree = tree  # parsed once, shared by both passes
+        self.locks: Dict[str, LockDecl] = {}  # module-level, by name
+        self.classes: Dict[str, ClassInfo] = {}
+
+
+def _call_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _lock_ctor(node: ast.expr) -> Optional[Tuple[str, Optional[int]]]:
+    """(kind, dbg rank or None) when ``node`` constructs a lock."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "threading"
+            and f.attr in THREADING_LOCKS):
+        return THREADING_LOCKS[f.attr], None
+    name = _call_name(f)
+    if name in DBG_CTORS:
+        rank = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, int):
+            rank = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "rank" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                rank = kw.value.value
+        return DBG_CTORS[name], rank
+    return None
+
+
+def _lock_group_ctor(node: ast.expr) -> Optional[str]:
+    """Kind when ``node`` builds a list of locks (lock striping)."""
+    elts: List[ast.expr] = []
+    if isinstance(node, (ast.List, ast.Tuple)):
+        elts = list(node.elts)
+    elif isinstance(node, ast.ListComp):
+        elts = [node.elt]
+    for e in elts:
+        got = _lock_ctor(e)
+        if got is not None:
+            return got[0]
+    return None
+
+
+def _ctor_of(node: ast.expr, module: str, names: Set[str]) -> bool:
+    """``node`` is a call to module.name() or a bare name() in names."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == module and f.attr in names):
+        return True
+    return isinstance(f, ast.Name) and f.id in names
+
+
+# -- pass 1: declarations ----------------------------------------------------
+def _collect_module(rel: str, tree: ast.Module,
+                    lines: List[str], findings: List[Finding],
+                    sup: _Suppressor) -> ModuleInfo:
+    mod = ModuleInfo(rel, lines, tree)
+    for stmt in tree.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            target, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            target, value = stmt.target.id, stmt.value
+        if target is None:
+            continue
+        got = _lock_ctor(value)
+        if got is not None:
+            kind, dbg_rank = got
+            mod.locks[target] = _make_decl(
+                ("mod", rel, target), kind, dbg_rank, stmt.lineno,
+                False, target, lines, findings, sup, rel,
+                stmt.end_lineno,
+            )
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            mod.classes[stmt.name] = _collect_class(
+                rel, stmt, lines, findings, sup
+            )
+    # nested classes (e.g. helper classes defined inside functions) are
+    # rare; classes nested one level inside classes are picked up too
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.ClassDef) and stmt.name not in mod.classes:
+            mod.classes[stmt.name] = _collect_class(
+                rel, stmt, lines, findings, sup
+            )
+    return mod
+
+
+def _span_search(pattern: re.Pattern, lines: List[str], lineno: int,
+                 end_lineno: Optional[int]):
+    """Search a statement's whole line span (multi-line assignments
+    carry their trailing annotation comment on the LAST line)."""
+    for i in range(lineno, (end_lineno or lineno) + 1):
+        if i <= len(lines):
+            m = pattern.search(lines[i - 1])
+            if m is not None:
+                return m
+    return None
+
+
+def _make_decl(lock_id: LockId, kind: str, dbg_rank: Optional[int],
+               lineno: int, group: bool, name: str, lines: List[str],
+               findings: List[Finding], sup: _Suppressor,
+               rel: str, end_lineno: Optional[int] = None) -> LockDecl:
+    m = _span_search(RANK_RE, lines, lineno, end_lineno)
+    rank = int(m.group(1)) if m else None
+    if rank is not None and dbg_rank is not None and rank != dbg_rank:
+        if not sup.suppressed(lineno, "CK04"):
+            findings.append((rel, lineno, "CK04",
+                             f"lock {name}: # lock-order comment ({rank}) "
+                             f"disagrees with dbg rank ({dbg_rank})"))
+    if rank is None:
+        rank = dbg_rank
+    if rank is None and not sup.suppressed(lineno, "CK04"):
+        findings.append(
+            (rel, lineno, "CK04",
+             f"lock {name} has no rank — annotate its creation line "
+             f"with '# lock-order: N' (or create it via dbg_lock/"
+             f"dbg_rlock/dbg_condition with a rank argument)")
+        )
+    return LockDecl(lock_id, kind, rank, lineno, group, name)
+
+
+def _collect_class(rel: str, cls: ast.ClassDef, lines: List[str],
+                   findings: List[Finding],
+                   sup: _Suppressor) -> ClassInfo:
+    info = ClassInfo(cls.name)
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    for meth in info.methods.values():
+        for node in ast.walk(meth):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    target, value = tgt.attr, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Attribute) \
+                    and isinstance(node.target.value, ast.Name) \
+                    and node.target.value.id == "self" \
+                    and node.value is not None:
+                target, value = node.target.attr, node.value
+            if target is None:
+                continue
+            got = _lock_ctor(value)
+            group_kind = _lock_group_ctor(value) if got is None else None
+            if got is not None or group_kind is not None:
+                kind, dbg_rank = got if got is not None \
+                    else (group_kind, None)
+                info.locks[target] = _make_decl(
+                    ("attr", rel, cls.name, target), kind, dbg_rank,
+                    node.lineno, got is None, f"{cls.name}.{target}",
+                    lines, findings, sup, rel, node.end_lineno,
+                )
+                continue
+            if _ctor_of(value, "threading", {"Event"}):
+                info.events.add(target)
+            elif _ctor_of(value, "queue", {"Queue", "SimpleQueue",
+                                           "LifoQueue", "PriorityQueue"}):
+                info.queues.add(target)
+            elif _ctor_of(value, "threading", {"Thread", "Timer"}):
+                info.threads.add(target)
+            g = _span_search(GUARD_RE, lines, node.lineno,
+                             node.end_lineno)
+            if g is not None:
+                info.guarded[target] = (g.group(1), node.lineno)
+    return info
+
+
+# -- pass 2: per-function region analysis ------------------------------------
+class _Held:
+    __slots__ = ("key", "lock_id", "kind", "line")
+
+    def __init__(self, key, lock_id, kind, line):
+        self.key = key        # (receiver, attr) or ("", name)
+        self.lock_id = lock_id
+        self.kind = kind
+        self.line = line
+
+
+class _FnScan(ast.NodeVisitor):
+    """Scan one function body with a held-lock stack.  Nested function
+    and lambda bodies run on other threads/later — they are queued and
+    scanned as fresh contexts, never under the enclosing holds."""
+
+    def __init__(self, analyzer: "Analyzer", mod: ModuleInfo,
+                 cls: Optional[ClassInfo], fn_name: str):
+        self.an = analyzer
+        self.mod = mod
+        self.cls = cls
+        self.fn_name = fn_name
+        self.held: List[_Held] = []
+        self.direct_locks: Set[LockId] = set()
+        self.direct_blocking: List[Tuple[int, str]] = []
+        self.self_calls: List[Tuple[str, int, Tuple[LockId, ...]]] = []
+        self.local_locks: Set[str] = set()
+        self.local_events: Set[str] = set()
+        self.local_queues: Set[str] = set()
+        self.local_threads: Set[str] = set()
+        self.nested: List[ast.AST] = []
+
+    # -- resolution ---------------------------------------------------------
+    def _resolve_lock(self, expr: ast.expr):
+        """(key, decl-or-None) for a with-item that looks like a lock;
+        None when it is not lock-shaped at all."""
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            recv, attr = expr.value.id, expr.attr
+            decl = None
+            if self.cls is not None and attr in self.cls.locks:
+                decl = self.cls.locks[attr]
+            else:
+                owners = [
+                    c for c in self.mod.classes.values()
+                    if attr in c.locks
+                ]
+                if len(owners) == 1:
+                    decl = owners[0].locks[attr]
+            if decl is not None or attr.endswith("lock") \
+                    or attr.endswith("_cv"):
+                return (recv, attr), decl
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.mod.locks:
+                return ("", expr.id), self.mod.locks[expr.id]
+            if expr.id in self.local_locks:
+                return ("", expr.id), None
+        return None
+
+    # -- traversal ----------------------------------------------------------
+    def visit_ClassDef(self, node):
+        # nested classes are scanned separately under their OWN
+        # ClassInfo by _scan_functions' walk — descending here would
+        # scan their methods under the wrong class
+        pass
+
+    def visit_FunctionDef(self, node):
+        self.nested.append(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.nested.append(node)
+
+    def visit_Lambda(self, node):
+        self.nested.append(node)
+
+    def visit_Assign(self, node):
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if _lock_ctor(node.value) is not None:
+                self.local_locks.add(name)
+            elif _ctor_of(node.value, "threading", {"Event"}):
+                self.local_events.add(name)
+            elif _ctor_of(node.value, "queue", {"Queue", "SimpleQueue"}):
+                self.local_queues.add(name)
+            elif _ctor_of(node.value, "threading", {"Thread", "Timer"}):
+                self.local_threads.add(name)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        self._with(node)
+
+    def visit_AsyncWith(self, node):
+        self._with(node)
+
+    def _with(self, node):
+        pushed = 0
+        for item in node.items:
+            # the context expression itself is evaluated unlocked-first
+            self.visit(item.context_expr)
+            r = self._resolve_lock(item.context_expr)
+            if r is None:
+                continue
+            key, decl = r
+            lock_id = decl.lock_id if decl is not None else None
+            if lock_id is not None:
+                self.direct_locks.add(lock_id)
+                already = next(
+                    (h for h in self.held if h.lock_id == lock_id), None
+                )
+                if already is not None:
+                    if decl.kind == "Lock":
+                        self.an.emit(
+                            self.mod.rel, item.context_expr.lineno,
+                            "CK01",
+                            f"nested acquisition of non-reentrant lock "
+                            f"{decl.name} (held since line "
+                            f"{already.line}) — guaranteed deadlock",
+                        )
+                else:
+                    for h in self.held:
+                        if h.lock_id is not None:
+                            self.an.add_edge(
+                                h.lock_id, lock_id, self.mod.rel,
+                                item.context_expr.lineno,
+                            )
+            self.held.append(_Held(
+                key, lock_id, decl.kind if decl else None,
+                item.context_expr.lineno,
+            ))
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_Call(self, node):
+        # classify blocking calls unconditionally: direct_blocking
+        # feeds the caller-side closure check even when THIS function
+        # holds no lock; emit CK02 only when one is held here
+        self._check_blocking(node)
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and self.cls is not None \
+                and f.attr in self.cls.methods:
+            self.self_calls.append((
+                f.attr, node.lineno,
+                tuple(h.lock_id for h in self.held
+                      if h.lock_id is not None),
+            ))
+            if self.held:
+                self.an.held_self_calls.append((
+                    self.mod.rel, self.cls.name, f.attr, node.lineno,
+                    tuple(h.lock_id for h in self.held
+                          if h.lock_id is not None),
+                ))
+        self.generic_visit(node)
+
+    def _innermost(self) -> Optional[_Held]:
+        return self.held[-1] if self.held else None
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        f = node.func
+        line = node.lineno
+        hold = self._innermost()
+        holder = (
+            f"{'.'.join(k for k in hold.key if k)}"
+            if hold else "no lock"
+        )
+        if isinstance(f, ast.Attribute):
+            attr = f.attr
+            recv_name = f.value.id if isinstance(f.value, ast.Name) \
+                else None
+            recv_attr = (
+                f.value.attr if isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self" else None
+            )
+            if attr in SOCKET_BLOCKING and not isinstance(
+                    f.value, ast.Constant):
+                self._blocking(
+                    line,
+                    f"blocking socket call .{attr}() while holding "
+                    f"{holder}",
+                )
+                return
+            if recv_name == "subprocess" or (
+                isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "subprocess"
+            ):
+                self._blocking(
+                    line, f"subprocess call while holding {holder}"
+                )
+                return
+            target = recv_attr if recv_attr is not None else recv_name
+            if target is None:
+                return
+            cls = self.cls
+            is_self_attr = recv_attr is not None
+            if attr == "join":
+                threads = (cls.threads if cls and is_self_attr
+                           else self.local_threads)
+                if target in threads:
+                    self._blocking(
+                        line,
+                        f"Thread.join on {target} while holding {holder}",
+                    )
+            elif attr == "get":
+                queues = (cls.queues if cls and is_self_attr
+                          else self.local_queues)
+                if target in queues:
+                    self._blocking(
+                        line,
+                        f"queue.get() on {target} while holding "
+                        f"{holder} (use get_nowait or move it outside "
+                        f"the lock)",
+                    )
+            elif attr == "wait":
+                events = (cls.events if cls and is_self_attr
+                          else self.local_events)
+                if target in events:
+                    self._blocking(
+                        line,
+                        f"Event.wait on {target} while holding {holder}",
+                    )
+                    return
+                if cls and is_self_attr and target in cls.locks \
+                        and cls.locks[target].kind == "Condition":
+                    others = [h for h in self.held
+                              if h.key[1] != target]
+                    if others:
+                        held_names = ", ".join(
+                            ".".join(k for k in h.key if k)
+                            for h in others
+                        )
+                        self._blocking(
+                            line,
+                            f"Condition.wait on {target} while also "
+                            f"holding {held_names} — waiting releases "
+                            f"only {target}, everything else stays "
+                            f"held",
+                        )
+
+    def _blocking(self, line: int, msg: str) -> None:
+        self.direct_blocking.append((line, msg))
+        if self.held:
+            self.an.emit(self.mod.rel, line, "CK02", msg)
+
+    def visit_Attribute(self, node):
+        # CK03: guarded attribute access
+        if self.cls is not None and isinstance(node.value, ast.Name) \
+                and node.attr in self.cls.guarded \
+                and self.fn_name != "__init__":
+            recv = node.value.id
+            required, _decl_line = self.cls.guarded[node.attr]
+            ok = any(h.key == (recv, required) for h in self.held)
+            if not ok:
+                self.an.emit(
+                    self.mod.rel, node.lineno, "CK03",
+                    f"access to {recv}.{node.attr} outside "
+                    f"'with {recv}.{required}:' (declared guarded-by "
+                    f"{required})",
+                )
+        self.generic_visit(node)
+
+
+class Analyzer:
+    def __init__(self, root: pathlib.Path = ROOT):
+        self.root = root
+        self.findings: List[Finding] = []
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.decls: Dict[LockId, LockDecl] = {}
+        # edges: (outer, inner) -> first (rel, line) site
+        self.edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
+        self.held_self_calls: List[Tuple] = []
+        # (module, class, method) -> scan result
+        self.fn_scans: Dict[Tuple[str, str, str], _FnScan] = {}
+        self._sups: Dict[str, _Suppressor] = {}
+
+    def emit(self, rel: str, line: int, code: str, msg: str) -> None:
+        sup = self._sups.get(rel)
+        if sup is not None and sup.suppressed(line, code):
+            return
+        self.findings.append((rel, line, code, msg))
+
+    def add_edge(self, outer: LockId, inner: LockId, rel: str,
+                 line: int) -> None:
+        self.edges.setdefault((outer, inner), (rel, line))
+
+    # -- entry points --------------------------------------------------------
+    def analyze_paths(self, paths) -> List[Finding]:
+        files: List[pathlib.Path] = []
+        for p in paths:
+            p = pathlib.Path(p)
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            else:
+                files.append(p)
+        for f in files:
+            self._load(f)
+        for f in files:
+            self._scan_functions(f)
+        self._closure_checks()
+        self._graph_checks()
+        self.findings.sort(key=lambda x: (str(x[0]), x[1], x[2]))
+        return self.findings
+
+    def _rel(self, path: pathlib.Path) -> str:
+        try:
+            return str(path.relative_to(self.root))
+        except ValueError:
+            return str(path)
+
+    def _load(self, path: pathlib.Path) -> None:
+        rel = self._rel(path)
+        try:
+            text = path.read_text()
+            tree = ast.parse(text)
+        except (UnicodeDecodeError, SyntaxError):
+            return  # tools/lint.py owns PY01
+        lines = text.splitlines()
+        sup = self._sups[rel] = _Suppressor(lines)
+        mod = _collect_module(rel, tree, lines, self.findings, sup)
+        self.modules[rel] = mod
+        for cls in mod.classes.values():
+            for decl in cls.locks.values():
+                self.decls[decl.lock_id] = decl
+            for attr, (guard, line) in cls.guarded.items():
+                if guard not in cls.locks and guard not in mod.locks:
+                    self.emit(
+                        rel, line, "CK03",
+                        f"{cls.name}.{attr} declares guarded-by "
+                        f"{guard}, but {guard} is not a lock of "
+                        f"{cls.name}",
+                    )
+        for decl in mod.locks.values():
+            self.decls[decl.lock_id] = decl
+
+    def _scan_functions(self, path: pathlib.Path) -> None:
+        rel = self._rel(path)
+        mod = self.modules.get(rel)
+        if mod is None:
+            return
+        tree = mod.tree
+        # module-level functions
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(mod, None, stmt.name, stmt)
+        # EVERY class — top-level, class-in-class, class-in-function —
+        # scans its methods under its own ClassInfo (matching the
+        # ast.walk collection pass; _FnScan skips inner ClassDefs so
+        # nothing is scanned twice or under the wrong class)
+        for stmt in ast.walk(tree):
+            if isinstance(stmt, ast.ClassDef):
+                cls = mod.classes.get(stmt.name)
+                if cls is None:
+                    continue
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._scan_fn(mod, cls, item.name, item)
+
+    def _scan_fn(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+                 name: str, node) -> None:
+        scan = _FnScan(self, mod, cls, name)
+        body = node.body if hasattr(node, "body") else [node]
+        if isinstance(node, ast.Lambda):
+            scan.visit(node.body)
+        else:
+            for stmt in body:
+                scan.visit(stmt)
+        if cls is not None:
+            self.fn_scans[(mod.rel, cls.name, name)] = scan
+        # nested functions/lambdas run elsewhere: fresh held context,
+        # same class scope (closures over self)
+        queued = list(scan.nested)
+        seen = 0
+        while seen < len(queued):
+            inner = queued[seen]
+            seen += 1
+            # nested bodies run on other threads/later: they are NOT
+            # __init__ even when defined there, so the CK03 __init__
+            # exemption must not leak into them
+            sub = _FnScan(self, mod, cls, f"{name}.<nested>")
+            sub.local_locks = set(scan.local_locks)
+            sub.local_events = set(scan.local_events)
+            sub.local_queues = set(scan.local_queues)
+            sub.local_threads = set(scan.local_threads)
+            if isinstance(inner, ast.Lambda):
+                sub.visit(inner.body)
+            else:
+                for stmt in inner.body:
+                    sub.visit(stmt)
+            queued.extend(sub.nested)
+
+    # -- interprocedural closure ---------------------------------------------
+    def _closure_checks(self) -> None:
+        # transitive lock sets per (module, class, method)
+        all_locks: Dict[Tuple[str, str, str], Set[LockId]] = {
+            k: set(s.direct_locks) for k, s in self.fn_scans.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, scan in self.fn_scans.items():
+                mine = all_locks[k]
+                before = len(mine)
+                for callee, _line, _held in scan.self_calls:
+                    ck = (k[0], k[1], callee)
+                    if ck in all_locks:
+                        mine |= all_locks[ck]
+                if len(mine) != before:
+                    changed = True
+        # edges from self-calls made while holding locks
+        for rel, cls_name, callee, line, held in self.held_self_calls:
+            ck = (rel, cls_name, callee)
+            for inner in all_locks.get(ck, ()):
+                for outer in held:
+                    if outer != inner:
+                        self.add_edge(outer, inner, rel, line)
+                    else:
+                        decl = self.decls.get(inner)
+                        if decl is not None and decl.kind == "Lock":
+                            self.emit(
+                                rel, line, "CK01",
+                                f"call to self.{callee}() re-acquires "
+                                f"non-reentrant lock {decl.name} "
+                                f"already held here — guaranteed "
+                                f"deadlock",
+                            )
+        # CK02 through one-class call chains: a held self-call whose
+        # transitive callees block
+        blocking: Dict[Tuple[str, str, str], List[Tuple[int, str]]] = {
+            k: list(s.direct_blocking) for k, s in self.fn_scans.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, scan in self.fn_scans.items():
+                mine = blocking[k]
+                have = len(mine)
+                for callee, _line, _held in scan.self_calls:
+                    ck = (k[0], k[1], callee)
+                    for item in blocking.get(ck, ()):
+                        if item not in mine:
+                            mine.append(item)
+                if len(mine) != have:
+                    changed = True
+        for rel, cls_name, callee, line, held in self.held_self_calls:
+            ck = (rel, cls_name, callee)
+            items = blocking.get(ck, ())
+            if items:
+                bline, bmsg = items[0]
+                self.emit(
+                    rel, line, "CK02",
+                    f"call to self.{callee}() blocks while a lock is "
+                    f"held ({bmsg.split(' while holding')[0]} at line "
+                    f"{bline})",
+                )
+
+    # -- global graph checks --------------------------------------------------
+    def _graph_checks(self) -> None:
+        for (outer, inner), (rel, line) in sorted(
+            self.edges.items(), key=lambda kv: (kv[1][0], kv[1][1])
+        ):
+            do = self.decls.get(outer)
+            di = self.decls.get(inner)
+            if do is None or di is None:
+                continue
+            if do.rank is not None and di.rank is not None \
+                    and di.rank <= do.rank:
+                self.emit(
+                    rel, line, "CK01",
+                    f"lock-order inversion: {di.name} (rank {di.rank}) "
+                    f"acquired while holding {do.name} (rank "
+                    f"{do.rank}) — ranks must strictly increase inward",
+                )
+        # cycle detection over the acquisition graph
+        adj: Dict[LockId, List[LockId]] = {}
+        for (outer, inner) in self.edges:
+            adj.setdefault(outer, []).append(inner)
+        WHITE, GREY, BLACK = 0, 1, 2
+        color: Dict[LockId, int] = {}
+        stack: List[LockId] = []
+
+        def dfs(n: LockId) -> Optional[List[LockId]]:
+            color[n] = GREY
+            stack.append(n)
+            for m in adj.get(n, ()):
+                c = color.get(m, WHITE)
+                if c == GREY:
+                    return stack[stack.index(m):] + [m]
+                if c == WHITE:
+                    cyc = dfs(m)
+                    if cyc is not None:
+                        return cyc
+            stack.pop()
+            color[n] = BLACK
+            return None
+
+        for n in sorted(adj):
+            if color.get(n, WHITE) == WHITE:
+                cyc = dfs(n)
+                if cyc is not None:
+                    names = " -> ".join(
+                        self.decls[x].name if x in self.decls else str(x)
+                        for x in cyc
+                    )
+                    first_edge = (cyc[0], cyc[1])
+                    rel, line = self.edges.get(first_edge, ("?", 0))
+                    self.emit(
+                        rel, line, "CK01",
+                        f"lock acquisition cycle: {names} — a thread "
+                        f"pair interleaving these acquisitions "
+                        f"deadlocks",
+                    )
+                    break
+
+
+def analyze(paths, root: pathlib.Path = ROOT) -> List[Finding]:
+    return Analyzer(root=root).analyze_paths(paths)
+
+
+def main(argv) -> int:
+    paths = [pathlib.Path(a) for a in argv[1:]] or [LIB]
+    an = Analyzer()
+    findings = an.analyze_paths(paths)
+    for rel, line, code, msg in findings:
+        print(f"{rel}:{line}: {code} {msg}")
+    if findings:
+        print(f"concheck: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print(f"concheck: clean ({len(an.decls)} lock(s) ranked, "
+          f"acquisition graph acyclic)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

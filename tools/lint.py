@@ -6,13 +6,23 @@ phase with ``failsOnError=true`` (pom.xml:93-141); this is the
 same gate for the rebuild, implemented on the stdlib because the
 environment ships no third-party linter.  Rules:
 
+Suppressions are CODE-SCOPED: ``# noqa: PY10`` silences only PY10 on
+that line (comma-separate several codes); a bare ``# noqa`` still
+silences everything, but a scoped escape can never blanket-silence an
+unrelated hot-path rule.  ``F401`` is accepted as an alias for PY05
+(flake8 compatibility).
+
 Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
   PY01  file does not parse (SyntaxError)
   PY02  line longer than 88 characters
   PY03  tab character in indentation
   PY04  trailing whitespace
-  PY05  unused import (skipped in __init__.py re-export files; suppress
-        with a trailing ``# noqa`` on the import line)
+  PY05  unused import, via AST usage tracking (attribute roots,
+        decorators, string annotations, ``__all__`` exports all count
+        as uses; skipped in __init__.py re-export files; suppress on
+        the import statement line OR — for multi-line
+        ``from x import (a, b)`` statements — on the imported name's
+        own line)
   PY06  bare ``except:`` (use ``except BaseException:`` when you truly
         mean everything)
   PY07  ``print(`` in library code (sparkrdma_tpu/ only; benches, tests
@@ -46,11 +56,51 @@ from __future__ import annotations
 
 import ast
 import pathlib
+import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PY_MAX_LINE = 88
 CC_MAX_LINE = 100
+
+NOQA_RE = re.compile(r"#\s*noqa\b(?:\s*:\s*(?P<codes>[^#]*))?", re.I)
+_CODE_TOKEN_RE = re.compile(r"[A-Za-z]+\d+")
+# foreign linter codes accepted as aliases for ours
+_CODE_ALIASES = {"PY05": {"F401"}}
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _noqa_codes(line: str):
+    """None = no noqa on the line; empty set = bare ``# noqa``
+    (suppresses everything); else the set of named codes.  Code
+    tokens (letters+digits, comma/space separated) may be followed by
+    a justification — ``# noqa: CK02 serialized frame writes`` scopes
+    to CK02; prose with no leading code degrades to a bare noqa."""
+    m = NOQA_RE.search(line)
+    if m is None:
+        return None
+    spec = m.group("codes")
+    if spec is None:
+        return set()
+    codes = set()
+    for tok in re.split(r"[,\s]+", spec.strip()):
+        if _CODE_TOKEN_RE.fullmatch(tok):
+            codes.add(tok.upper())
+        else:
+            break  # justification prose starts here
+    return codes
+
+
+def _suppressed(lines, lineno: int, code: str) -> bool:
+    """Code-scoped noqa check for a finding at ``lineno``."""
+    if not (1 <= lineno <= len(lines)):
+        return False
+    codes = _noqa_codes(lines[lineno - 1])
+    if codes is None:
+        return False
+    if not codes:
+        return True  # bare noqa
+    return bool(codes & ({code} | _CODE_ALIASES.get(code, set())))
 
 PY_DIRS = ["sparkrdma_tpu", "tests", "benchmarks", "tools"]
 LIB_DIR = ROOT / "sparkrdma_tpu"
@@ -70,27 +120,70 @@ def cc_files():
 
 
 class _ImportUsage(ast.NodeVisitor):
-    """Collect imported names and every name/attribute root used."""
+    """Collect imported names and every use: plain names, attribute
+    roots (via the root Name leaf), decorators (ordinary expressions),
+    identifiers inside STRING annotations, and ``__all__`` exports."""
 
     def __init__(self):
-        self.imports = {}  # name -> (lineno, stmt is noqa-exempt?)
+        # name -> (name's own line, import statement's first line)
+        self.imports = {}
         self.used = set()
 
     def visit_Import(self, node):
         for a in node.names:
             name = (a.asname or a.name).split(".")[0]
-            self.imports[name] = node.lineno
+            self.imports[name] = (
+                getattr(a, "lineno", node.lineno), node.lineno
+            )
 
     def visit_ImportFrom(self, node):
         for a in node.names:
             if a.name == "*":
                 continue
-            self.imports[a.asname or a.name] = node.lineno
+            self.imports[a.asname or a.name] = (
+                getattr(a, "lineno", node.lineno), node.lineno
+            )
 
     def visit_Name(self, node):
         self.used.add(node.id)
 
     def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    # -- string annotations -------------------------------------------------
+    def _ann_strings(self, ann) -> None:
+        """Names inside a (possibly quoted) annotation count as used —
+        ``x: "np.ndarray"`` keeps its numpy import."""
+        if ann is None:
+            return
+        for n in ast.walk(ann):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                self.used.update(_IDENT_RE.findall(n.value))
+
+    def visit_FunctionDef(self, node):
+        # argument annotations are handled by visit_arg (generic_visit
+        # dispatches it per arg); only the return annotation is ours
+        self._ann_strings(node.returns)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_AnnAssign(self, node):
+        self._ann_strings(node.annotation)
+        self.generic_visit(node)
+
+    def visit_arg(self, node):
+        self._ann_strings(node.annotation)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # __all__ re-exports: the listed names are used by definition
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "__all__":
+                for e in ast.walk(node.value):
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        self.used.add(e.value)
         self.generic_visit(node)
 
 
@@ -192,41 +285,36 @@ def lint_python(path: pathlib.Path, findings: list,
         findings.append((rel, e.lineno or 0, "PY01", f"syntax error: {e.msg}"))
         return
 
+    out: list = []  # pre-suppression findings
+
     for i, line in enumerate(lines, 1):
         if len(line) > PY_MAX_LINE:
-            findings.append(
+            out.append(
                 (rel, i, "PY02", f"line too long ({len(line)} > {PY_MAX_LINE})")
             )
         stripped_nl = line.rstrip("\n")
         indent = stripped_nl[: len(stripped_nl) - len(stripped_nl.lstrip())]
         if "\t" in indent:
-            findings.append((rel, i, "PY03", "tab in indentation"))
+            out.append((rel, i, "PY03", "tab in indentation"))
         if stripped_nl != stripped_nl.rstrip():
-            findings.append((rel, i, "PY04", "trailing whitespace"))
+            out.append((rel, i, "PY04", "trailing whitespace"))
 
-    # unused imports (module-level only; __init__ files re-export)
+    # unused imports (AST usage tracking; __init__ files re-export)
     if path.name != "__init__.py":
         usage = _ImportUsage()
         usage.visit(tree)
-        # names in __all__ / string annotations count as used
-        for name in usage.imports:
+        for name, (lineno, stmt_lineno) in usage.imports.items():
             if name in usage.used or name == "annotations":
                 continue
-            lineno = usage.imports[name]
-            src_line = lines[lineno - 1] if lineno <= len(lines) else ""
-            if "# noqa" in src_line:
+            # honor the escape on the import statement's first line AND
+            # on the imported name's own line (multi-line from-imports)
+            if _suppressed(lines, stmt_lineno, "PY05"):
                 continue
-            if name in text.replace(f"import {name}", "", 1):
-                # crude but effective: referenced in a docstring/comment
-                # only counts if it appears outside the import stmt; a
-                # name used in type comments or __all__ strings passes
-                if f'"{name}"' in text or f"'{name}'" in text:
-                    continue
-            findings.append((rel, lineno, "PY05", f"unused import: {name}"))
+            out.append((rel, lineno, "PY05", f"unused import: {name}"))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(
+            out.append(
                 (rel, node.lineno, "PY06",
                  "bare except: (name the exception type)")
             )
@@ -236,7 +324,7 @@ def lint_python(path: pathlib.Path, findings: list,
             and isinstance(node.func, ast.Name)
             and node.func.id == "print"
         ):
-            findings.append(
+            out.append(
                 (rel, node.lineno, "PY07",
                  "print() in library code (use logging)")
             )
@@ -245,7 +333,7 @@ def lint_python(path: pathlib.Path, findings: list,
             and _is_perf_counter_call(node)
             and not _perf_counter_exempt(path, lib_dir)
         ):
-            findings.append(
+            out.append(
                 (rel, node.lineno, "PY08",
                  "time.perf_counter() in library code (metric timing "
                  "goes through metrics/ or utils/trace.py)")
@@ -254,12 +342,8 @@ def lint_python(path: pathlib.Path, findings: list,
             rel in HOT_PATHS
             and isinstance(node, ast.Call)
             and _is_hot_path_copy(node)
-            and "# noqa" not in (
-                lines[node.lineno - 1] if node.lineno <= len(lines)
-                else ""
-            )
         ):
-            findings.append(
+            out.append(
                 (rel, node.lineno, "PY09",
                  'per-block bytes materialization (.tobytes()/b"".join)'
                  " in an exchange hot path (stage into preallocated "
@@ -271,13 +355,8 @@ def lint_python(path: pathlib.Path, findings: list,
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue
-            src_line = (
-                lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-            )
-            if "# noqa" in src_line:
-                continue
             if _is_sendall_concat(node):
-                findings.append(
+                out.append(
                     (rel, node.lineno, "PY10",
                      "payload concatenation into sendall (send the "
                      "parts as one sendmsg iovec instead)")
@@ -287,11 +366,16 @@ def lint_python(path: pathlib.Path, findings: list,
                 and isinstance(node.func, ast.Name)
                 and node.func.id == "bytes"
             ):
-                findings.append(
+                out.append(
                     (rel, node.lineno, "PY10",
                      "per-frame bytes() materialization on a TCP hot "
                      "path (use buffer views / recv_into instead)")
                 )
+
+    # one code-scoped suppression gate for every rule
+    for rel_, lineno, code, msg in out:
+        if not _suppressed(lines, lineno, code):
+            findings.append((rel_, lineno, code, msg))
 
 
 def lint_cpp(path: pathlib.Path, findings: list) -> None:

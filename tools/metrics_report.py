@@ -12,6 +12,11 @@ reading) so one run's activity can be isolated from a warm process.
 
 Histograms print count/sum plus approximate p50/p95/p99 interpolated
 from the bucket counts, and the nonzero buckets.
+
+``lock_hold_us`` histograms (the lockDebug sanitizer's hold-time
+series, utils/dbglock.py) additionally render as one compact
+"lock hold times" table — one row per lock, sorted by total held time —
+so a snapshot diff shows exactly which locks a run leaned on.
 """
 
 from __future__ import annotations
@@ -64,13 +69,53 @@ def _percentile(edges, counts, total, q) -> float:
     return lo
 
 
+def _fmt_us(us: float) -> str:
+    """Human microseconds: 850us, 12.4ms, 1.07s."""
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us:.0f}us"
+
+
+def render_lock_holds(hists: list) -> list:
+    """Compact per-lock hold-time table over the ``lock_hold_us``
+    series (written by the lockDebug sanitizer): acquire count, total
+    held, mean and p99 hold — sorted by total held time so the
+    heaviest lock tops the diff."""
+    rows = []
+    for h in hists:
+        total = h["count"]
+        if total <= 0:
+            continue
+        name = (h.get("labels") or {}).get("lock", "?")
+        p50 = _percentile(h["edges"], h["counts"], total, 0.50)
+        p99 = _percentile(h["edges"], h["counts"], total, 0.99)
+        rows.append((h["sum"], name, total, p50, p99))
+    if not rows:
+        return []
+    rows.sort(reverse=True)
+    width = max(len(r[1]) for r in rows)
+    out = ["lock hold times (lock_hold_us)"]
+    for hsum, name, total, p50, p99 in rows:
+        out.append(
+            f"  {name:<{width}}  acquires={total:<8} "
+            f"held={_fmt_us(hsum):>8}  mean={_fmt_us(hsum / total):>8}  "
+            f"p50~{_fmt_us(p50):>8}  p99~{_fmt_us(p99):>8}"
+        )
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
         lines.append(title)
     counters = [c for c in snap.get("counters", [])]
     gauges = [g for g in snap.get("gauges", [])]
-    hists = [h for h in snap.get("histograms", [])]
+    all_hists = [h for h in snap.get("histograms", [])]
+    lock_hists = [h for h in all_hists if h["name"] == "lock_hold_us"]
+    hists = [h for h in all_hists if h["name"] != "lock_hold_us"]
+    lines.extend(render_lock_holds(lock_hists))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
     )
