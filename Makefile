@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck test bench dryrun clean
+.PHONY: all native lint concheck test bench bench-smoke dryrun clean
 
 all: native
 
@@ -25,6 +25,13 @@ test: native lint
 
 bench: native
 	python bench.py
+
+# tier-2 sanity gate: the reduce-loopback bench (record plane, striped
+# fetch, decode pipeline) in a tiny config — same code paths, seconds
+# not minutes, JSON written to /tmp so committed results stay intact
+bench-smoke:
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	python benchmarks/bench_reduce_loopback.py
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

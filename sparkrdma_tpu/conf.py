@@ -175,6 +175,43 @@ class TpuShuffleConf:
         return self._bool("lazyStaging", False)
 
     @property
+    def compress_frame_records(self) -> int:
+        """Records per compression frame (CompressedSerializer): one
+        frame is the unit of decode parallelism on the reduce side AND
+        the unit the 4 GiB frame-length field bounds — lower it when
+        individual records are huge (a FrameTooLargeError names this
+        knob)."""
+        return self._int_in_range(
+            "compressFrameRecords", 65536, 1, 1 << 24
+        )
+
+    @property
+    def decode_threads(self) -> int:
+        """Worker threads on the reduce-side decode pool
+        (shuffle/decode.py): blocks deserialize/decompress on workers
+        AS STRIPES LAND, overlapping fetch, decode and consumption.
+        0 keeps the legacy serial decode on the task thread.  Default:
+        ``min(4, cpus)`` on multi-core hosts; 0 on a single-core host
+        (decode workers would only timeslice against the task thread —
+        the ``bulkPipelineWindows`` convention)."""
+        ncpu = os.cpu_count() or 1
+        return self._int_in_range(
+            "decodeThreads", min(4, ncpu) if ncpu > 1 else 0, 0, 64
+        )
+
+    @property
+    def decode_ahead_bytes(self) -> int:
+        """Byte-credit budget of the decode pool: the total encoded
+        bytes of blocks decoding or decoded-but-not-yet-consumed is
+        capped here, bounding how far decode runs ahead of the task
+        thread (the maxBytesInFlight analog for the decode stage).  A
+        single block larger than the whole budget clamps to it and
+        decodes alone instead of deadlocking."""
+        return self._bytes_in_range(
+            "decodeAheadBytes", 32 << 20, 64 << 10, 1 << 40
+        )
+
+    @property
     def shuffle_spill_record_threshold(self) -> int:
         """Writer spill trigger: when a map task holds this many
         buffered records, serialize current buckets to a spill file and

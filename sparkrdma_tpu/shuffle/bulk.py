@@ -520,32 +520,82 @@ class WindowedShuffleReader:
                 return
 
     def read(self):
-        """fetch (window-by-window) → deserialize → aggregate → sort."""
+        """fetch (window-by-window) → deserialize → aggregate → sort.
+
+        With ``decodeThreads`` > 0 the windowed plane reuses the
+        manager's decode pool for its assembly-side deserialization:
+        a landed window's blocks fan out to the workers while the task
+        thread is still draining earlier windows (and while the pump's
+        next collective runs), through the same decode-ahead stream
+        the pull reader uses — serial fallback and output stay
+        bit-exact."""
+        from sparkrdma_tpu.shuffle.decode import (
+            iter_decoded_ahead,
+            open_decode_stream,
+        )
         from sparkrdma_tpu.shuffle.manager import ColumnarAggregator
         from sparkrdma_tpu.shuffle.reader import (
             postprocess_column_batches,
+            postprocess_record_runs,
             postprocess_records,
         )
 
         mgr = self.plane.manager
         agg = self.handle.aggregator
-        if getattr(mgr.serializer, "supports_columns", False) and (
-            agg is None or isinstance(agg, ColumnarAggregator)
-        ):
-            deser = mgr.serializer.deserialize_columns
+        columnar = getattr(
+            mgr.serializer, "supports_columns", False
+        ) and (agg is None or isinstance(agg, ColumnarAggregator))
+        stream = open_decode_stream(mgr, self.handle, columnar)
+
+        def _decoded_runs():
+            try:
+                for t in iter_decoded_ahead(
+                    stream, self._iter_block_bytes(),
+                    mgr.conf.decode_ahead_bytes,
+                ):
+                    t0 = time.monotonic()
+                    items, n = t.get()
+                    self.metrics.decode_wait_ms += (
+                        time.monotonic() - t0
+                    ) * 1000
+                    self.metrics.records_read += n
+                    yield items
+            finally:
+                stream.close()
+
+        if columnar:
             batches = []
-            for data in self._iter_block_bytes():
-                for b in deser(data):
-                    self.metrics.records_read += len(b)
-                    batches.append(b)
+            if stream is not None:
+                for items in _decoded_runs():
+                    batches.extend(items)
+            else:
+                deser = mgr.serializer.deserialize_columns
+                for data in self._iter_block_bytes():
+                    t0 = time.monotonic()
+                    got = list(deser(data))
+                    self.metrics.decode_wait_ms += (
+                        time.monotonic() - t0
+                    ) * 1000
+                    for b in got:
+                        self.metrics.records_read += len(b)
+                    batches.extend(got)
             return postprocess_column_batches(batches, self.handle)
+
+        if stream is not None:
+            return postprocess_record_runs(
+                _decoded_runs(), self.handle, presorted=True,
+            )
 
         def _records():
             deser = mgr.serializer.deserialize
             for data in self._iter_block_bytes():
-                for rec in deser(data):
-                    self.metrics.records_read += 1
-                    yield rec
+                t0 = time.monotonic()
+                recs = list(deser(data))
+                self.metrics.decode_wait_ms += (
+                    time.monotonic() - t0
+                ) * 1000
+                self.metrics.records_read += len(recs)
+                yield from recs
 
         return postprocess_records(_records(), self.handle)
 

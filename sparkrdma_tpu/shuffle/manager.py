@@ -252,7 +252,10 @@ class TpuShuffleManager:
                     f"unknown serializer {name!r} (want columnar|pickle)"
                 )
             self.serializer = (
-                CompressedSerializer(inner, codec=conf.compress_codec)
+                CompressedSerializer(
+                    inner, codec=conf.compress_codec,
+                    frame_records=conf.compress_frame_records,
+                )
                 if conf.compress else inner
             )
         self.stats = (
@@ -374,6 +377,12 @@ class TpuShuffleManager:
         # by the job layer (shared in-process session) or lazily built
         # by get_reader (one exchange per process on a multi-host mesh)
         self.windowed_plane = None
+        # reduce-side decode pool (shuffle/decode.py): lazily built on
+        # the first pipelined read when conf decodeThreads > 0; shared
+        # by every reader of this manager like the node's serve pool
+        # (same double-checked create: benign unlocked fast-path read)
+        self._decode_pool = None
+        self._decode_lock = dbg_lock("manager.decode_pool", 21)
 
         # heartbeat plane (driver side): last ack time per executor +
         # monitor thread — the CM DISCONNECTED/onBlockManagerRemoved
@@ -1317,6 +1326,33 @@ class TpuShuffleManager:
             self, handle, start_partition, end_partition, maps_by_host
         )
 
+    def get_decode_pool(self):
+        """Get-or-create the manager's shared decode pool — ``None``
+        when ``decodeThreads`` is 0 (serial fallback) or the manager
+        stopped.  Workers pin to ``dispatcherCpuList`` exactly like the
+        transport dispatcher and serve-pool threads."""
+        n = self.conf.decode_threads
+        if n <= 0 or self._stopped:
+            return None
+        pool = self._decode_pool
+        if pool is None:
+            from sparkrdma_tpu.shuffle.decode import DecodePool
+
+            with self._decode_lock:
+                if self._stopped:
+                    # re-checked under the lock: a create racing
+                    # manager.stop() must not resurrect a pool whose
+                    # stop already ran (leaked pinned workers)
+                    return None
+                if self._decode_pool is None:
+                    self._decode_pool = DecodePool(
+                        self.executor_id, n,
+                        self.conf.decode_ahead_bytes,
+                        init_fn=self.node._pin_worker_thread,
+                    )
+                pool = self._decode_pool
+        return pool
+
     def publish_map_output(
         self, shuffle_id: int, map_id: int, mto: MapTaskOutput
     ) -> None:
@@ -1363,6 +1399,7 @@ class TpuShuffleManager:
             remote_bytes=rm.remote_bytes,
             records_read=rm.records_read,
             fetch_wait_ms=rm.fetch_wait_ms,
+            decode_wait_ms=getattr(rm, "decode_wait_ms", 0.0),
         )
 
     def _telemetry_add(self, shuffle_id: int, **kv) -> None:
@@ -1605,6 +1642,10 @@ class TpuShuffleManager:
                 tracer.enabled = False
                 tracer.clear()
         logger.info("staging pool at stop: %s", self.staging_pool.stats())
+        with self._decode_lock:
+            decode_pool, self._decode_pool = self._decode_pool, None
+        if decode_pool is not None:
+            decode_pool.stop()
         if self._fetch_pool is not None:
             self._fetch_pool.shutdown(wait=False)
         self.resolver.stop()

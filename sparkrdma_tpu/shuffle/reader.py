@@ -63,7 +63,14 @@ class ReadMetrics:
     local_bytes: int = 0
     remote_bytes: int = 0
     records_read: int = 0
+    # the fetch-wait split: wire-wait is time the task thread blocked
+    # on bytes (the results queue / local backing-store reads);
+    # decode-wait is time it spent in — or blocked on — deserialize/
+    # decompress (inline on the serial path, ticket waits on the
+    # pipelined one).  fetch_wait_ms stays the wire-side series the
+    # pre-split consumers (stats, telemetry dashboards) already read.
     fetch_wait_ms: float = 0.0
+    decode_wait_ms: float = 0.0
 
 
 def flush_read_metrics(manager, shuffle_id: int, m: ReadMetrics,
@@ -81,6 +88,7 @@ def flush_read_metrics(manager, shuffle_id: int, m: ReadMetrics,
         m.remote_blocks)
     counter("shuffle_records_read_total").inc(m.records_read)
     counter("shuffle_fetch_wait_ms_total").inc(int(m.fetch_wait_ms))
+    counter("shuffle_decode_wait_ms_total").inc(int(m.decode_wait_ms))
     counter("shuffle_reduce_tasks_total").inc()
     manager.record_shuffle_read(shuffle_id, m)
 
@@ -134,7 +142,13 @@ class ShuffleReader:
         self._timers: List[threading.Timer] = []
         self._callback_ids: List[int] = []
         self._metrics_flushed = False
+        # decode-ahead stream (shuffle/decode.py): opened by read()
+        # when decodeThreads > 0; on_success then submits landed blocks
+        # to the pool and the consumer sees tickets instead of raw
+        # payloads.  None = the legacy serial task-thread decode.
+        self._decode_stream = None
         self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
+        self._m_local_read = histogram("shuffle_local_read_ms")
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
 
     # -- fetch machinery ----------------------------------------------------
@@ -211,20 +225,28 @@ class ShuffleReader:
                     host.host, self.handle.shuffle_id, str(e)))
 
         def _iter_local() -> Iterator:
-            # local_blocks/local_bytes count at CONSUMPTION: an
-            # abandoned iteration reports only what was actually
-            # read (remote counters behave the same — blocks left in
-            # the results queue at cleanup were never yielded)
+            # local_blocks/local_bytes count in _iter_block_bytes at
+            # CONSUMPTION — NOT here, where the decode-ahead wrapper
+            # pulls payloads up to decodeAheadBytes early: an abandoned
+            # iteration must report only what was actually read (remote
+            # counters behave the same — blocks left in the results
+            # queue at cleanup were never yielded)
             for mid in local_map_ids:
                 # one batched backing-store read per map output
                 # (device segments pay a host round-trip per
                 # Segment read; read_many fetches the union span)
+                t0 = time.monotonic()
                 blocks = self.manager.resolver.get_local_blocks(
                     self.handle.shuffle_id, mid, reduce_ids
                 )
+                # local payloads used to bypass fetch-wait/latency
+                # accounting entirely; the backing-store read is this
+                # path's wire time, so the wire-wait/decode-wait split
+                # stays honest on loopback-heavy reduces
+                dt_ms = (time.monotonic() - t0) * 1000
+                self.metrics.fetch_wait_ms += dt_ms
+                self._m_local_read.observe(dt_ms)
                 for data in blocks:
-                    self.metrics.local_blocks += 1
-                    self.metrics.local_bytes += len(data)
                     if len(data):  # ndarray views: no bool()
                         yield data
 
@@ -330,6 +352,13 @@ class ShuffleReader:
                 "shuffle.fetch.complete", host=fetch.host.host,
                 bytes=fetch.total_bytes, latency_ms=round(latency, 2),
             )
+            stream = self._decode_stream
+            if stream is not None:
+                # decode-ahead: landed payloads go to the pool NOW,
+                # while the task thread may still be consuming earlier
+                # results — the consumer receives tickets (len() keeps
+                # the byte accounting identical) in the same order
+                blocks = [stream.submit_block(b) for b in blocks]
             self._results.put(
                 _Result(blocks=blocks, host=fetch.host, latency_ms=latency)
             )
@@ -372,7 +401,23 @@ class ShuffleReader:
         copying."""
         try:
             local_payloads = self._start_remote_fetches()
-            yield from local_payloads
+            if self._decode_stream is not None:
+                # local payloads decode ahead too: the task thread
+                # submits up to decodeAheadBytes of blocks before
+                # consuming the first ticket, so local decode overlaps
+                # the remote fetches already in flight
+                from sparkrdma_tpu.shuffle.decode import iter_decoded_ahead
+
+                local_payloads = iter_decoded_ahead(
+                    self._decode_stream, local_payloads,
+                    self.manager.conf.decode_ahead_bytes,
+                )
+            for item in local_payloads:
+                # consumption-time accounting (tickets report the raw
+                # payload size via len()), mirroring the remote side
+                self.metrics.local_blocks += 1
+                self.metrics.local_bytes += len(item)
+                yield item
             while True:
                 with self._pending_lock:
                     if (
@@ -400,17 +445,45 @@ class ShuffleReader:
             self._cleanup()
 
     def _iter_raw(self) -> Iterator[Record]:
+        """Serial decode on the task thread (decodeThreads=0): blocks
+        materialize one at a time so the decode half of the wire-wait/
+        decode-wait split is measured (block-granular — payloads are
+        bounded by maxAggBlock)."""
         deser = self.manager.serializer.deserialize
         for data in self._iter_block_bytes():
-            for rec in deser(data):
-                self.metrics.records_read += 1
-                yield rec
+            t0 = time.monotonic()
+            recs = list(deser(data))
+            self.metrics.decode_wait_ms += (time.monotonic() - t0) * 1000
+            self.metrics.records_read += len(recs)
+            yield from recs
+
+    def _resolve_decoded(self, item):
+        """One pipelined block: wait for (or steal) its decode ticket;
+        returns the decoded item list.  Ticket wait time is the
+        decode-wait half of the fetch-wait split."""
+        t0 = time.monotonic()
+        items, n = item.get()
+        self.metrics.decode_wait_ms += (time.monotonic() - t0) * 1000
+        self.metrics.records_read += n
+        return items
+
+    def _iter_record_runs(self) -> Iterator[List[Record]]:
+        """Pipelined tuple plane: yields one decoded (and, under
+        key_ordering, worker-sorted) record list per block."""
+        for item in self._iter_block_bytes():
+            yield self._resolve_decoded(item)
 
     def _cleanup(self) -> None:
         for t in self._timers:
             t.cancel()
         for cb_id in self._callback_ids:
             self.manager.unregister_fetch_callback(cb_id)
+        if self._decode_stream is not None:
+            # poison in-flight decodes: queued tickets cancel, credits
+            # release — runs on normal exhaustion, FetchFailedError AND
+            # abandoned iteration, so no worker ever hangs on a dead
+            # reader
+            self._decode_stream.close()
         flush_read_metrics(self.manager, self.handle.shuffle_id,
                            self.metrics, self)
 
@@ -422,22 +495,41 @@ class ShuffleReader:
         the tuple plane's lists)."""
         deser = self.manager.serializer.deserialize_columns
         batches = []
-        for data in self._iter_block_bytes():
-            for b in deser(data):
-                self.metrics.records_read += len(b)
-                batches.append(b)
+        if self._decode_stream is not None:
+            for item in self._iter_block_bytes():
+                batches.extend(self._resolve_decoded(item))
+        else:
+            for data in self._iter_block_bytes():
+                t0 = time.monotonic()
+                got = list(deser(data))
+                self.metrics.decode_wait_ms += (
+                    time.monotonic() - t0
+                ) * 1000
+                for b in got:
+                    self.metrics.records_read += len(b)
+                batches.extend(got)
         return postprocess_column_batches(batches, self.handle)
 
     def read(self) -> Iterator[Record]:
-        """Full read path: fetch → deserialize → aggregate → sort
-        (RdmaShuffleReader.scala:43-113)."""
+        """Full read path: fetch → (decode-ahead) deserialize →
+        aggregate → sort/merge (RdmaShuffleReader.scala:43-113)."""
+        from sparkrdma_tpu.shuffle.decode import open_decode_stream
         from sparkrdma_tpu.shuffle.manager import ColumnarAggregator
 
         agg = self.handle.aggregator
-        if getattr(self.manager.serializer, "supports_columns", False) and (
-            agg is None or isinstance(agg, ColumnarAggregator)
-        ):
+        columnar = getattr(
+            self.manager.serializer, "supports_columns", False
+        ) and (agg is None or isinstance(agg, ColumnarAggregator))
+        self._decode_stream = open_decode_stream(
+            self.manager, self.handle, columnar
+        )
+        if columnar:
             return self._read_columnar()
+        if self._decode_stream is not None:
+            return postprocess_record_runs(
+                self._iter_record_runs(), self.handle,
+                presorted=True,  # workers sort per block (decode_fn)
+            )
         return postprocess_records(self._iter_raw(), self.handle)
 
 
@@ -449,7 +541,6 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
         concat_batches,
         group_columns,
         sorted_runs_order,
-        stable_key_order,
     )
 
     total = sum(len(b) for b in batches)
@@ -508,38 +599,75 @@ def postprocess_column_batches(batches, handle) -> Iterator[Record]:
             else sorted_runs_order(batches, cat),
         )
         return iter(zip(uk.tolist(), groups))
-    batch = concat_batches(batches)
     if handle.key_ordering:
-        order = sorted_runs_order(batches, batch)
-        if order is None:
-            order = stable_key_order(batch.keys)
-        return iter(zip(
-            batch.keys[order].tolist(), batch.vals[order].tolist()
-        ))
-    return iter(batch)
+        # streaming k-way merge over per-block sorted runs (unsorted
+        # stragglers sort once per block inside) — replaces the
+        # concat → global sort → whole-partition gather+tolist
+        from sparkrdma_tpu.utils.columns import iter_merged_sorted_batches
+
+        return iter_merged_sorted_batches(batches)
+    return iter(concat_batches(batches))
 
 
-def postprocess_records(records: Iterator[Record], handle) -> Iterator[Record]:
-    """The read-side aggregate → sort stage on plain record iterators
-    (RdmaShuffleReader.scala:82-113) — shared by the pull reader's
-    generic path and the bulk-exchange reader."""
+def postprocess_record_runs(runs, handle,
+                            presorted: bool = False) -> Iterator[Record]:
+    """The read-side aggregate → order stage over PER-BLOCK record
+    runs — the streaming replacement for materialize-then-sort
+    (Spark's ``ExternalSorter`` merge phase, reduce side): with
+    ``key_ordering`` and no aggregator the runs (each sorted — by the
+    decode workers on the pipelined path, map-side or here otherwise)
+    k-way heap-merge lazily, so peak residency is the per-block lists
+    plus the heap instead of a second whole-partition sorted copy.
+    Stable per-run sort + run-order-stable merge emits the exact
+    sequence a stable global sort of the concatenated runs would.
+    Aggregation keeps the streaming dict combine (arrival order —
+    identical to the serial path's)."""
+    import heapq
+
     agg = handle.aggregator
     if agg is not None:
         combined: Dict[Any, Any] = {}
         if handle.map_side_combine:
             # records are (key, combiner) pairs already
-            for k, c in records:
-                combined[k] = (
-                    agg.merge_combiners(combined[k], c)
-                    if k in combined else c
-                )
+            for run in runs:
+                for k, c in run:
+                    combined[k] = (
+                        agg.merge_combiners(combined[k], c)
+                        if k in combined else c
+                    )
         else:
-            for k, v in records:
-                combined[k] = (
-                    agg.merge_value(combined[k], v)
-                    if k in combined else agg.create_combiner(v)
-                )
-        records = iter(combined.items())
-    if handle.key_ordering:
-        records = iter(sorted(records, key=lambda kv: kv[0]))
-    return records
+            for run in runs:
+                for k, v in run:
+                    combined[k] = (
+                        agg.merge_value(combined[k], v)
+                        if k in combined else agg.create_combiner(v)
+                    )
+        records: Iterator[Record] = iter(combined.items())
+        if handle.key_ordering:
+            records = iter(sorted(records, key=lambda kv: kv[0]))
+        return records
+    if not handle.key_ordering:
+        return (rec for run in runs for rec in run)
+    run_lists: List[List[Record]] = []
+    for run in runs:
+        if not isinstance(run, list):
+            run = list(run)
+        elif not presorted:
+            run = list(run)  # never mutate a caller's list in place
+        if not presorted:
+            run.sort(key=lambda kv: kv[0])
+        if run:
+            run_lists.append(run)
+    if not run_lists:
+        return iter(())
+    if len(run_lists) == 1:
+        return iter(run_lists[0])
+    return heapq.merge(*run_lists, key=lambda kv: kv[0])
+
+
+def postprocess_records(records: Iterator[Record], handle) -> Iterator[Record]:
+    """The read-side aggregate → sort stage on one flat record iterator
+    (RdmaShuffleReader.scala:82-113) — the single-run adapter over
+    :func:`postprocess_record_runs`, shared by the serial pull path and
+    the bulk-exchange readers."""
+    return postprocess_record_runs([records], handle)

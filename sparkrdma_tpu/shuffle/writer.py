@@ -307,6 +307,20 @@ class ShuffleWriter:
                     self.spill()
         self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
 
+    def _ordered_bucket(self, bucket: List[Record]) -> List[Record]:
+        """Tuple-plane map-side ordering: with ``key_ordering`` (and no
+        aggregation) each committed/spilled bucket serializes key-
+        sorted, so reduce-side blocks are PRE-SORTED RUNS — the decode
+        pipeline's streaming k-way merge (and the serial path's
+        timsort, which gallops over runs) then merge instead of
+        re-sorting, the Spark ``ExternalSorter`` map-side-sort shape.
+        Stable, so the merged reduce output is bit-identical to sorting
+        unsorted blocks reduce-side (the columnar plane already ships
+        ``key_sorted`` batches)."""
+        if self.handle.key_ordering and self.handle.aggregator is None:
+            return sorted(bucket, key=lambda kv: kv[0])
+        return bucket
+
     # -- spill --------------------------------------------------------------
     def spill(self) -> None:
         """Serialize buffered buckets to the spill file and release the
@@ -347,7 +361,10 @@ class ShuffleWriter:
         elif self._combined is not None:
             sources = [d.items() if d else None for d in self._combined]
         else:
-            sources = [b if b else None for b in self._buckets]
+            sources = [
+                self._ordered_bucket(b) if b else None
+                for b in self._buckets
+            ]
         if self._spill_appenders is not None:
             # stream header + column VIEWS straight into the appender's
             # aligned buffers — no per-partition bytes join (each byte
@@ -550,7 +567,8 @@ class ShuffleWriter:
             ]
         else:
             finals = [
-                serializer.serialize(b) if b else b"" for b in self._buckets
+                serializer.serialize(self._ordered_bucket(b)) if b else b""
+                for b in self._buckets
             ]
         if self._spill_appenders is not None:
             return self._commit_spilled_files(finals, t0)

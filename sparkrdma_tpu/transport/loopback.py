@@ -203,21 +203,21 @@ class LoopbackChannel(Channel):
     def _post_read(self, locations, listener: CompletionListener,
                    dest=None, on_progress=None) -> None:
         # clock starts at POST time (like TcpChannel stamping t0 in
-        # _post_read): the dispatcher-queue wait is part of the RTT, so
-        # the tcp/loopback series stay comparable under load
+        # _post_read): the serve-queue wait is part of the RTT, so the
+        # tcp/loopback series stay comparable under load
         t0 = time.monotonic()
 
-        def deliver():
+        def fail(e: BaseException) -> None:
+            self._error(e)
+            self._fail(listener, e)
+            self._release_budget()
+
+        def land(data) -> None:
+            # receiver-side completion: the landing copy, progress and
+            # completion callbacks run INSIDE the serve (still under
+            # its byte credits), so a slow receiver back-pressures the
+            # responder exactly like TcpChannel's credit-held sendmsg
             try:
-                if self.network.is_partitioned(self.local.address, self.remote.address):
-                    raise TransportError(
-                        f"network partition to {self.remote.address}"
-                    )
-                if self.state != ChannelState.CONNECTED:
-                    raise TransportError("channel not connected")
-                # one-sided: read directly from the peer's registered
-                # memory, batched per backing segment
-                data = self.remote.read_local_blocks(locations)
                 if dest is not None:
                     # striped-reassembly parity with TcpChannel: each
                     # payload lands in its registered dest buffer and
@@ -235,16 +235,48 @@ class LoopbackChannel(Channel):
                         except BaseException:
                             pass
             except BaseException as e:
-                self._error(e)
-                self._fail(listener, e)
+                fail(e)
             else:
                 self._m_read_rtt.observe((time.monotonic() - t0) * 1000.0)
                 self._m_bytes_recv.inc(sum(len(b) for b in data))
                 self._complete(listener, data)
-            finally:
                 self._release_budget()
 
-        self.local.submit(deliver)
+        def serve() -> None:
+            # responder side: resolve the blocks from registered memory
+            # on the REMOTE node's bounded serve pool — off this node's
+            # dispatcher (a multi-MB loopback read must not head-of-
+            # line-block control frames), under the same byte-credit
+            # flow control the TCP read service carries (PR 3 parity;
+            # the serve holds its block views only while it owns
+            # credits)
+            try:
+                if self.network.is_partitioned(
+                    self.local.address, self.remote.address
+                ):
+                    raise TransportError(
+                        f"network partition to {self.remote.address}"
+                    )
+                if self.state != ChannelState.CONNECTED:
+                    raise TransportError("channel not connected")
+                data = self.remote.read_local_blocks(locations)
+            except BaseException as e:
+                fail(e)
+                return
+            land(data)
+
+        try:
+            self.remote.submit_serve(
+                serve, (), cost=sum(loc.length for loc in locations),
+            )
+        except BaseException as e:
+            # remote node stopped (serve pool refused): fail fast like
+            # a read against a dead peer, asynchronously so post-read
+            # keeps its completion-callback contract
+            try:
+                self.local.submit(fail, e)
+            except BaseException:
+                fail(e)
 
     def stop(self) -> None:
         # credit-waiting listeners are tracked in _outstanding, which

@@ -12,13 +12,35 @@ from __future__ import annotations
 
 import pickle
 import struct
-from typing import Any, Iterable, Iterator, List, Tuple
+from typing import Any, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 Record = Tuple[Any, Any]
 
 _LEN = struct.Struct("<I")
+
+
+class FrameTooLargeError(ValueError):
+    """A single compression frame's body exceeds the 4-byte framing
+    limit.  Structured: carries the offending sizes so callers (and the
+    error message) can say exactly which knob to turn instead of a
+    generic 'value too large'."""
+
+    def __init__(self, frame_bytes: int, record_count: int,
+                 frame_records: int, limit: int):
+        self.frame_bytes = int(frame_bytes)
+        self.record_count = int(record_count)
+        self.frame_records = int(frame_records)
+        self.limit = int(limit)
+        per_record = self.frame_bytes // max(self.record_count, 1)
+        super().__init__(
+            f"compressed frame body of {self.frame_bytes}B exceeds the "
+            f"{self.limit}B framing limit: {self.record_count} record(s) "
+            f"averaging ~{per_record}B serialized each — lower "
+            f"spark.shuffle.tpu.compressFrameRecords (currently "
+            f"{self.frame_records}) so one frame holds fewer records"
+        )
 
 
 def as_view(data) -> memoryview:
@@ -45,6 +67,16 @@ class Serializer:
 
     def deserialize(self, data) -> Iterator[Record]:  # pragma: no cover
         raise NotImplementedError
+
+    def frame_spans(self, data) -> List[Tuple[int, int]]:
+        """(start, end) byte spans of this serializer's self-contained
+        frames inside ``data`` — the frame-parallel decode entry point
+        (shuffle/decode.py): every serializer here frames
+        concatenation-safely, so any contiguous GROUP of spans
+        deserializes independently via ``deserialize(data[a:b])`` and
+        one large block can fan out across decode workers.  Base
+        serializers treat the whole payload as one frame."""
+        return [(0, len(as_view(data)))]
 
 
 class PickleSerializer(Serializer):
@@ -84,6 +116,25 @@ class PickleSerializer(Serializer):
             for rec in pickle.loads(view[off : off + n]):
                 yield rec
             off += n
+
+    def frame_spans(self, data) -> List[Tuple[int, int]]:
+        """One span per length-prefixed pickle batch."""
+        view = as_view(data)
+        spans: List[Tuple[int, int]] = []
+        off = 0
+        while off < len(view):
+            if off + _LEN.size > len(view):
+                raise ValueError(f"truncated batch header at offset {off}")
+            (n,) = _LEN.unpack_from(view, off)
+            end = off + _LEN.size + n
+            if end > len(view):
+                raise ValueError(
+                    f"truncated batch: need {n}B at {off + _LEN.size}, "
+                    f"have {len(view) - off - _LEN.size}B"
+                )
+            spans.append((off, end))
+            off = end
+        return spans
 
 
 class ColumnarSerializer(Serializer):
@@ -274,6 +325,42 @@ class ColumnarSerializer(Serializer):
         for item in self._iter_items(data):
             yield from item
 
+    def frame_spans(self, data) -> List[Tuple[int, int]]:
+        """One span per columnar/pickle frame: a header-only walk (no
+        column views built) so splitting a block across decode workers
+        costs O(frames), not O(bytes)."""
+        view = as_view(data)
+        spans: List[Tuple[int, int]] = []
+        off = 0
+        total = len(view)
+        while off < total:
+            start = off
+            if view[off] == self.MAGIC_PICKLE:
+                (n,) = _LEN.unpack_from(view, off + 1)
+                off += 1 + _LEN.size + n
+            elif view[off] == self.MAGIC:
+                off += 2  # magic + flags
+                nk = view[off]
+                kd = np.dtype(bytes(view[off + 1 : off + 1 + nk]).decode("ascii"))
+                off += 1 + nk
+                nv = view[off]
+                vd = np.dtype(bytes(view[off + 1 : off + 1 + nv]).decode("ascii"))
+                off += 1 + nv
+                (count,) = _LEN.unpack_from(view, off)
+                off += _LEN.size + count * (kd.itemsize + vd.itemsize)
+            else:
+                raise ValueError(
+                    f"bad columnar frame magic {view[off]:#x} at {off} "
+                    "(mixed-serializer stream?)"
+                )
+            if off > total:
+                raise ValueError(
+                    f"truncated columnar frame at {start}: frame ends at "
+                    f"{off}, stream holds {total}B"
+                )
+            spans.append((start, off))
+        return spans
+
 
 class CompressedSerializer(Serializer):
     """Compression wrapper over any serializer — the analog of the
@@ -298,44 +385,82 @@ class CompressedSerializer(Serializer):
     WIRE_FORMAT_VERSION = 2
     _RAW, _ZLIB, _LZMA = 0, 1, 2
 
+    # hard framing ceiling of the 4B length field (class attribute so
+    # the structured-error unit test can lower it without manufacturing
+    # a 4 GiB frame)
+    MAX_FRAME_BODY = (1 << 32) - 1
+
     def __init__(self, inner: Serializer = None, codec: str = "zlib",
-                 level: int = 1, min_size: int = 256):
+                 level: int = 1, min_size: int = 256,
+                 frame_records: Optional[int] = None):
         self.inner = inner or PickleSerializer()
         if codec not in ("zlib", "lzma"):
             raise ValueError(f"unknown codec: {codec!r}")
         self.codec = codec
         self.level = level
         self.min_size = min_size
+        if frame_records is not None:
+            self.frame_records = max(1, int(frame_records))
         self.supports_columns = getattr(self.inner, "supports_columns", False)
 
     # one frame per this many records: bounds frame bodies far below the
-    # 4B length field's 4 GiB ceiling for sane record sizes
+    # 4B length field's 4 GiB ceiling for sane record sizes, and sets
+    # the granularity of frame-parallel decode (conf
+    # spark.shuffle.tpu.compressFrameRecords overrides per manager)
     frame_records = 65536
 
     def serialize(self, records: Iterable[Record]) -> bytes:
         from sparkrdma_tpu.utils.columns import ColumnBatch
 
         if isinstance(records, ColumnBatch):
-            # columnar fast path: one frame per batch, no per-record walk
-            return self._frame(self.inner.serialize(records))
+            # columnar fast path: one frame per frame_records-sized
+            # sub-batch (zero-copy column views), no per-record walk —
+            # bounding frames keeps decompression splittable at frame
+            # boundaries (one giant batch would serialize into a
+            # single monolithic frame no decode worker can share)
+            out = bytearray()
+            for sub in self._iter_frame_batches(records):
+                out += self._frame(self.inner.serialize(sub), len(sub))
+            if not out:
+                out += self._frame(self.inner.serialize(records), 0)
+            return bytes(out)
         out = bytearray()
         batch: List[Record] = []
         for rec in records:
             if isinstance(rec, ColumnBatch):
                 if batch:
-                    out += self._frame(self.inner.serialize(batch))
+                    out += self._frame(self.inner.serialize(batch), len(batch))
                     batch = []
-                out += self._frame(self.inner.serialize(rec))
+                for sub in self._iter_frame_batches(rec):
+                    out += self._frame(self.inner.serialize(sub), len(sub))
                 continue
             batch.append(rec)
             if len(batch) >= self.frame_records:
-                out += self._frame(self.inner.serialize(batch))
+                out += self._frame(self.inner.serialize(batch), len(batch))
                 batch = []
         if batch or not out:
-            out += self._frame(self.inner.serialize(batch))
+            out += self._frame(self.inner.serialize(batch), len(batch))
         return bytes(out)
 
-    def _frame(self, raw: bytes) -> bytes:
+    def _iter_frame_batches(self, b):
+        """Slice one ColumnBatch into ≤ frame_records sub-batches —
+        column VIEWS, no copies; sortedness carries over."""
+        from sparkrdma_tpu.utils.columns import ColumnBatch
+
+        n = len(b)
+        fr = self.frame_records
+        if n == 0:
+            return
+        if n <= fr:
+            yield b
+            return
+        for lo in range(0, n, fr):
+            yield ColumnBatch(
+                b.keys[lo : lo + fr], b.vals[lo : lo + fr],
+                key_sorted=b.key_sorted,
+            )
+
+    def _frame(self, raw: bytes, record_count: int = -1) -> bytes:
         if len(raw) < self.min_size:
             tag, body = self._RAW, raw
         elif self.codec == "zlib":
@@ -346,13 +471,34 @@ class CompressedSerializer(Serializer):
             import lzma
 
             tag, body = self._LZMA, lzma.compress(raw)
-        if len(body) >= 1 << 32:
-            raise ValueError(
-                f"frame body of {len(body)}B exceeds the 4 GiB framing "
-                f"limit ({self.frame_records} records averaging "
-                ">64 KiB each) — lower frame_records for huge records"
+        if len(body) > self.MAX_FRAME_BODY:
+            raise FrameTooLargeError(
+                len(body), record_count, self.frame_records,
+                self.MAX_FRAME_BODY,
             )
         return bytes([tag]) + _LEN.pack(len(body)) + body
+
+    def frame_spans(self, data) -> List[Tuple[int, int]]:
+        """One span per ``tag + length + body`` frame — decompression
+        splits at these boundaries, so one large block's inflate fans
+        out across decode workers (each span group is decoded
+        independently through ``deserialize``/``deserialize_columns``)."""
+        view = as_view(data)
+        spans: List[Tuple[int, int]] = []
+        off = 0
+        while off < len(view):
+            if off + 1 + _LEN.size > len(view):
+                raise ValueError(f"truncated frame header at offset {off}")
+            (n,) = _LEN.unpack_from(view, off + 1)
+            end = off + 1 + _LEN.size + n
+            if end > len(view):
+                raise ValueError(
+                    f"truncated frame: need {n}B at {off + 1 + _LEN.size}, "
+                    f"have {len(view) - off - 1 - _LEN.size}B"
+                )
+            spans.append((off, end))
+            off = end
+        return spans
 
     def _iter_frames(self, data) -> Iterator[bytes]:
         view = as_view(data)
@@ -368,7 +514,10 @@ class CompressedSerializer(Serializer):
                     f"truncated frame: need {n}B at {off}, "
                     f"have {len(view) - off}B"
                 )
-            body = bytes(view[off : off + n])
+            # zero-copy: codecs and the inner deserializers all take
+            # buffer views — materializing ``bytes`` here would copy
+            # every compressed body once more on the decode hot path
+            body = view[off : off + n]
             off += n
             if tag == self._RAW:
                 yield body

@@ -1,0 +1,724 @@
+"""Decode-ahead reduce pipeline (shuffle/decode.py): bit-exact
+pipelined-vs-serial sweep across serializer modes, key-ordering
+guarantees, failure propagation with no hung workers, byte-credit
+bounding, and a lockDebug stress pass with the decode pool active."""
+
+import threading
+import time
+from collections import defaultdict
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+from sparkrdma_tpu.shuffle.decode import DecodePool
+from sparkrdma_tpu.shuffle.manager import (
+    ColumnarAggregator,
+    TpuShuffleManager,
+)
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.shuffle.reader import FetchFailedError
+from sparkrdma_tpu.transport import LoopbackNetwork
+from sparkrdma_tpu.utils.dbglock import get_lock_factory
+from sparkrdma_tpu.utils.serde import (
+    ColumnarSerializer,
+    CompressedSerializer,
+    FrameTooLargeError,
+    PickleSerializer,
+)
+
+BASE_PORT = 47100
+_NEXT_PORT = [BASE_PORT]
+
+# serializer conf fragments for the sweep modes
+MODES = {
+    "pickle": {},
+    "columnar": {"spark.shuffle.tpu.serializer": "columnar"},
+    "compressed": {"spark.shuffle.tpu.compress": True},
+    "compressed-columnar": {
+        "spark.shuffle.tpu.serializer": "columnar",
+        "spark.shuffle.tpu.compress": True,
+    },
+}
+
+
+def _ports(n=1):
+    p = _NEXT_PORT[0]
+    _NEXT_PORT[0] += 200
+    return p
+
+
+def _run_shuffle(extra_conf, records_per_map, num_parts=4,
+                 aggregator=None, map_side_combine=False,
+                 key_ordering=False, num_executors=2):
+    """One full write→publish→fetch→read cycle on a fresh loopback
+    cluster; returns the per-partition outputs in read order."""
+    base = _ports()
+    net = LoopbackNetwork()
+    conf_map = {
+        "spark.shuffle.tpu.driverPort": base,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "15s",
+    }
+    conf_map.update(extra_conf)
+    conf = TpuShuffleConf(conf_map)
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=base + 20 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(num_executors)
+    ]
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(len(e._peers) == num_executors for e in executors):
+                break
+            time.sleep(0.01)
+        part = HashPartitioner(num_parts)
+        handle = driver.register_shuffle(
+            7, len(records_per_map), part, aggregator=aggregator,
+            map_side_combine=map_side_combine, key_ordering=key_ordering,
+        )
+        maps_by_host = defaultdict(list)
+        for m, records in enumerate(records_per_map):
+            ex = executors[m % num_executors]
+            w = ex.get_writer(handle, m)
+            w.write(records)
+            w.stop(True)
+            maps_by_host[ex.local_smid].append(m)
+        out = []
+        for pid in range(num_parts):
+            reader = executors[pid % num_executors].get_reader(
+                handle, pid, pid + 1, dict(maps_by_host)
+            )
+            out.append(list(reader.read()))
+        return out
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+def _records(n, unique_keys=True, seed=0):
+    # int keys + int vals pack into columns and pickle alike
+    if unique_keys:
+        return [((i * 2654435761 + seed) % (10 * n), i) for i in range(n)]
+    return [((i * 31 + seed) % 61, i) for i in range(n)]
+
+
+# -- bit-exact pipelined-vs-serial sweep --------------------------------------
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_bitexact_sort_sweep(mode):
+    """key_ordering with unique keys: the fully-deterministic output —
+    decodeThreads 0 (legacy serial), 1 and 4 must produce EXACTLY the
+    same per-partition sequences (stable per-block sort + stable k-way
+    merge == stable global sort)."""
+    records_per_map = [_records(700, seed=m) for m in range(3)]
+    outs = {}
+    for threads in (0, 1, 4):
+        conf = dict(MODES[mode])
+        conf["spark.shuffle.tpu.decodeThreads"] = threads
+        outs[threads] = _run_shuffle(
+            conf, records_per_map, key_ordering=True
+        )
+    assert outs[1] == outs[0], f"{mode}: decodeThreads=1 diverged"
+    assert outs[4] == outs[0], f"{mode}: decodeThreads=4 diverged"
+    # and the output really is the key-sorted multiset of the input
+    per_part = defaultdict(list)
+    part = HashPartitioner(4)
+    for recs in records_per_map:
+        for k, v in recs:
+            per_part[part.partition(k)].append((k, v))
+    for pid in range(4):
+        assert outs[0][pid] == sorted(per_part[pid], key=lambda kv: kv[0])
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_bitexact_reduce_sweep(mode):
+    """Reducing aggregator (+ key ordering → deterministic sequence):
+    the decode workers pre-combine columnar batches; sums must match
+    the serial path exactly."""
+    agg = ColumnarAggregator.reduce("sum")
+    records_per_map = [
+        _records(600, unique_keys=False, seed=m) for m in range(3)
+    ]
+    outs = {}
+    for threads in (0, 1, 4):
+        conf = dict(MODES[mode])
+        conf["spark.shuffle.tpu.decodeThreads"] = threads
+        outs[threads] = _run_shuffle(
+            conf, records_per_map, aggregator=agg, key_ordering=True
+        )
+    assert outs[1] == outs[0], f"{mode}: decodeThreads=1 diverged"
+    assert outs[4] == outs[0], f"{mode}: decodeThreads=4 diverged"
+    expect = defaultdict(int)
+    for recs in records_per_map:
+        for k, v in recs:
+            expect[k] += v
+    got = {k: v for pout in outs[0] for k, v in pout}
+    assert {k: int(v) for k, v in got.items()} == dict(expect)
+
+
+def test_group_aggregation_pipelined_matches_serial():
+    """Columnar group_by_key through the decode pool: same groups,
+    same per-key value multisets."""
+    records_per_map = [
+        _records(400, unique_keys=False, seed=m) for m in range(3)
+    ]
+    outs = {}
+    for threads in (0, 4):
+        conf = dict(MODES["compressed-columnar"])
+        conf["spark.shuffle.tpu.decodeThreads"] = threads
+        out = _run_shuffle(
+            conf, records_per_map,
+            aggregator=ColumnarAggregator.group(),
+        )
+        outs[threads] = {
+            k: sorted(list(v) if hasattr(v, "__len__") else [v])
+            for pout in out for k, v in pout
+        }
+    assert outs[4] == outs[0]
+
+
+def test_bitexact_split_spilled_blocks():
+    """The composite-ticket merge regression: a SPILLED map output is a
+    byte-concatenation of independently sorted spill chunks, so a
+    >=1MiB block that splits at frame boundaries must MERGE its
+    fragment sorts (not concatenate them) to stay bit-exact with the
+    serial global sort."""
+    rng_vals = "x" * 46
+    records_per_map = [
+        [((i * 2654435761 + m) % (1 << 30), rng_vals + str(i))
+         for i in range(44_000)]
+        for m in range(2)
+    ]
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.reset()
+    GLOBAL_REGISTRY.enabled = True
+    try:
+        outs = {}
+        for threads in (0, 4):
+            conf = {
+                "spark.shuffle.tpu.decodeThreads": threads,
+                # several spill chunks per map → multi-run blocks
+                "spark.shuffle.tpu.shuffleSpillRecordThreshold": 9000,
+                "spark.shuffle.tpu.spillPartitionFiles": 0,
+            }
+            outs[threads] = _run_shuffle(
+                conf, records_per_map, num_parts=2, key_ordering=True
+            )
+        # the >=1MiB blocks must really have fanned out across workers
+        splits = [
+            inst for _k, inst in GLOBAL_REGISTRY.instruments()
+            if getattr(inst, "name", "")
+            == "shuffle_decode_block_splits_total"
+        ]
+        assert sum(s.value for s in splits) > 0, "split path not engaged"
+    finally:
+        GLOBAL_REGISTRY.enabled = prev
+        GLOBAL_REGISTRY.reset()
+    assert outs[4] == outs[0]
+    for pout in outs[4]:
+        keys = [k for k, _v in pout]
+        assert keys == sorted(keys)
+
+
+def test_ordering_guarantee_with_duplicate_keys():
+    """key_ordering holds under the pipelined path even with heavy key
+    duplication (merge correctness, not just the unique-key case)."""
+    for mode in ("pickle", "compressed-columnar"):
+        conf = dict(MODES[mode])
+        conf["spark.shuffle.tpu.decodeThreads"] = 4
+        out = _run_shuffle(
+            conf,
+            [_records(500, unique_keys=False, seed=m) for m in range(3)],
+            key_ordering=True,
+        )
+        for pout in out:
+            keys = [k for k, _v in pout]
+            assert keys == sorted(keys), mode
+
+
+# -- failure propagation ------------------------------------------------------
+
+def test_fetch_failure_mid_pipeline_no_hung_workers():
+    """A dead remote peer fails the pipelined read with
+    FetchFailedError, and the decode pool stays healthy afterwards
+    (poisoned stream: queued decodes cancel, credits release)."""
+    base = _ports()
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": base,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "3s",
+        "spark.shuffle.tpu.decodeThreads": 2,
+        "spark.shuffle.tpu.compress": True,
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=base + 20 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(2)
+    ]
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(len(e._peers) == 2 for e in executors):
+                break
+            time.sleep(0.01)
+        part = HashPartitioner(2)
+        handle = driver.register_shuffle(9, 2, part, key_ordering=True)
+        maps_by_host = {}
+        for m, ex in enumerate(executors):
+            w = ex.get_writer(handle, m)
+            w.write(_records(400, seed=m))
+            w.stop(True)
+            maps_by_host[ex.local_smid] = [m]
+        # cut the remote peer: executor 0's read of executor 1's block
+        # fails mid-pipeline (locations still resolve via the driver)
+        net.partition(executors[1].node.address)
+        reader = executors[0].get_reader(handle, 0, 1, maps_by_host)
+        with pytest.raises(FetchFailedError):
+            list(reader.read())
+        # the shared pool survived: a fresh stream still decodes
+        pool = executors[0].get_decode_pool()
+        assert pool is not None
+        stream = pool.stream(lambda d: (list(bytes(d)), len(d)))
+        t = stream.submit(b"\x01\x02\x03")
+        items, n = t.get()
+        assert items == [1, 2, 3] and n == 3
+        stream.close()
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+def test_decode_error_propagates_to_consumer():
+    """A decode_fn raising (corrupt frame) re-raises on the task
+    thread at get(), and close() leaves no worker stuck."""
+    pool = DecodePool("t", 2, 1 << 20)
+    try:
+        def boom(data):
+            raise ValueError("corrupt frame")
+
+        stream = pool.stream(boom)
+        t = stream.submit(b"x" * 128)
+        with pytest.raises(ValueError, match="corrupt frame"):
+            t.get()
+        stream.close()
+        ok = pool.stream(lambda d: (len(d), 1))
+        assert ok.submit(b"abc").get() == (3, 1)
+        ok.close()
+    finally:
+        pool.stop()
+
+
+def test_close_cancels_queued_and_releases_credits():
+    """close() on a stream with queued work: queued tickets cancel,
+    held credits return to the budget, workers stay serviceable."""
+    gate = threading.Event()
+
+    def slow(data):
+        gate.wait(5)
+        return data, len(data)
+
+    # budget fits ONE 1 KiB block: the rest queue behind the credits
+    pool = DecodePool("t", 2, 1024)
+    try:
+        stream = pool.stream(slow)
+        tickets = [stream.submit(bytes([i]) * 1024) for i in range(6)]
+        time.sleep(0.05)  # let a worker take the first credit
+        stream.close()
+        gate.set()
+        # every unconsumed ticket settles (cancelled or decoded); none
+        # hang, and the full budget is available again
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with pool._cv:
+                if pool._credits == pool._budget:
+                    break
+            time.sleep(0.01)
+        with pool._cv:
+            assert pool._credits == pool._budget
+        fresh = pool.stream(lambda d: (d, len(d)))
+        assert fresh.submit(b"ok").get()[1] == 2
+        fresh.close()
+        del tickets
+    finally:
+        pool.stop()
+
+
+def test_composite_error_discards_siblings_without_decoding():
+    """When one fragment of a split block fails, the remaining queued
+    fragments are DISCARDED (cancelled), not steal-decoded on the task
+    thread, and their credits return."""
+    calls = []
+
+    def decode(data):
+        calls.append(bytes(data[:1]))
+        if bytes(data[:1]) == b"\x00":
+            raise ValueError("bad fragment")
+        time.sleep(0.2)  # so the lone worker can't out-race the discard
+        return [bytes(data)], 1
+
+    # single worker + a gate-free pool: submit the composite parts
+    # directly so the first part fails before the rest are admitted
+    pool = DecodePool("t", 1, 1 << 20)
+    try:
+        from sparkrdma_tpu.shuffle.decode import _CompositeTicket
+
+        stream = pool.stream(decode)
+        # stall the worker on an unrelated slow ticket so the
+        # composite's parts stay queued when get() walks them
+        gate = threading.Event()
+        slow = pool.stream(lambda d: (gate.wait(5), 1))
+        blocker = slow.submit(b"z")
+        parts = [stream.submit(bytes([i]) * 64) for i in range(6)]
+        comp = _CompositeTicket(parts, 6 * 64)
+        gate.set()
+        with pytest.raises(ValueError, match="bad fragment"):
+            comp.get()
+        blocker.get()
+        # fragment 0 decoded (and failed); the later QUEUED fragments
+        # were cancelled without running decode
+        assert b"\x00" in calls
+        assert len(calls) < 6, f"siblings were steal-decoded: {calls}"
+        # an in-flight abandoned fragment settles at decode completion
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with pool._cv:
+                if pool._credits == pool._budget:
+                    break
+            time.sleep(0.01)
+        with pool._cv:
+            assert pool._credits == pool._budget
+        stream.close()
+        slow.close()
+    finally:
+        pool.stop()
+
+
+# -- credit bounding ----------------------------------------------------------
+
+def test_credit_bounding_without_deadlock():
+    """A budget far smaller than the submitted payload total cannot
+    deadlock: consumption in ticket order always drains (oversized
+    blocks clamp; unadmitted tickets steal-decode inline)."""
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.reset()
+    GLOBAL_REGISTRY.enabled = True
+    pool = DecodePool("t", 3, 2048)  # ~2 blocks of credit
+    try:
+        stream = pool.stream(lambda d: (len(d), 1))
+        tickets = [stream.submit(bytes(1024)) for _ in range(64)]
+        # an oversized single block clamps to the whole budget
+        tickets.append(stream.submit(bytes(1 << 20)))
+        done = []
+
+        def consume():
+            for t in tickets:
+                done.append(t.get())
+
+        c = threading.Thread(target=consume, daemon=True)
+        c.start()
+        c.join(timeout=20)
+        assert not c.is_alive(), "credit-bounded pipeline deadlocked"
+        assert [n for n, _one in done[:64]] == [1024] * 64
+        assert done[64][0] == 1 << 20
+        stream.close()
+        snap = GLOBAL_REGISTRY.snapshot()
+        names = {c["name"]: c["value"] for c in snap["counters"]}
+        assert names.get("shuffle_decode_tasks_total", 0) >= 65
+    finally:
+        pool.stop()
+        GLOBAL_REGISTRY.enabled = prev
+        GLOBAL_REGISTRY.reset()
+
+
+def test_frame_split_fans_out_and_preserves_framing():
+    """One large compressed block splits at frame boundaries across
+    workers; the composite ticket's concatenated result equals the
+    whole-block decode exactly."""
+    ser = CompressedSerializer(PickleSerializer(batch_size=64),
+                              frame_records=64)
+    records = _records(3000)
+    blob = ser.serialize(records)
+    assert len(blob) >= 1 << 20 or len(ser.frame_spans(blob)) > 4
+
+    def decode(data):
+        recs = list(ser.deserialize(data))
+        return recs, len(recs)
+
+    pool = DecodePool("t", 4, 64 << 20)
+    try:
+        stream = pool.stream(decode, ser.frame_spans)
+        t = stream.submit_block(blob)
+        items, n = t.get()
+        assert n == len(records)
+        assert items == records
+        stream.close()
+    finally:
+        pool.stop()
+
+
+# -- serde satellites ---------------------------------------------------------
+
+def test_frame_too_large_is_structured():
+    ser = CompressedSerializer(PickleSerializer(), min_size=1 << 30)
+    ser.MAX_FRAME_BODY = 64  # instance override: no 4 GiB allocation
+    with pytest.raises(FrameTooLargeError) as ei:
+        ser.serialize([(i, "x" * 50) for i in range(4)])
+    err = ei.value
+    assert err.frame_bytes > 64
+    assert err.record_count == 4
+    assert err.frame_records == ser.frame_records
+    assert "compressFrameRecords" in str(err)
+    assert str(err.record_count) in str(err)
+    # structured subclass of the old ValueError contract
+    assert isinstance(err, ValueError)
+
+
+def test_conf_frame_records_reaches_serializer():
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.compress": True,
+        "spark.shuffle.tpu.compressFrameRecords": 17,
+    })
+    net = LoopbackNetwork()
+    mgr = TpuShuffleManager(conf, is_driver=True, network=net,
+                            port=_ports())
+    try:
+        assert isinstance(mgr.serializer, CompressedSerializer)
+        assert mgr.serializer.frame_records == 17
+    finally:
+        mgr.stop()
+
+
+@pytest.mark.parametrize("make", [
+    lambda: PickleSerializer(batch_size=32),
+    lambda: ColumnarSerializer(),
+    lambda: CompressedSerializer(PickleSerializer(batch_size=32),
+                                 frame_records=32),
+    lambda: CompressedSerializer(ColumnarSerializer(), min_size=16),
+])
+def test_frame_spans_cover_and_decode_independently(make):
+    """frame_spans tile the payload contiguously and every span group
+    deserializes standalone to the same record slice."""
+    ser = make()
+    if isinstance(ser, CompressedSerializer) and getattr(
+        ser.inner, "supports_columns", False
+    ) or isinstance(ser, ColumnarSerializer):
+        from sparkrdma_tpu.utils.columns import ColumnBatch
+
+        blob = b"".join(
+            ser.serialize(ColumnBatch.from_records(_records(100, seed=s)))
+            for s in range(5)
+        )
+        expect = [kv for s in range(5) for kv in _records(100, seed=s)]
+    else:
+        blob = ser.serialize(_records(500))
+        expect = _records(500)
+    spans = ser.frame_spans(blob)
+    assert spans[0][0] == 0 and spans[-1][1] == len(blob)
+    for (a, b), (c, _d) in zip(spans, spans[1:]):
+        assert b == c, "spans must tile contiguously"
+    view = memoryview(blob)
+    got = []
+    for a, b in spans:
+        got.extend(ser.deserialize(view[a:b]))
+    assert got == expect
+
+
+# -- local accounting satellite ----------------------------------------------
+
+def test_local_reads_count_in_wait_split():
+    """Loopback-heavy (all-local) reduce: the wire-wait/decode-wait
+    split is populated even though no remote fetch ever runs."""
+    out_metrics = {}
+    base = _ports()
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": base,
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    try:
+        part = HashPartitioner(2)
+        handle = driver.register_shuffle(3, 1, part)
+        w = driver.get_writer(handle, 0)
+        w.write(_records(2000))
+        w.stop(True)
+        reader = driver.get_reader(
+            handle, 0, 2, {driver.local_smid: [0]}
+        )
+        out = list(reader.read())
+        assert len(out) == 2000
+        out_metrics = reader.metrics
+        assert out_metrics.local_blocks == 2
+        assert out_metrics.remote_blocks == 0
+        assert out_metrics.fetch_wait_ms > 0  # local backing-store read
+        assert out_metrics.decode_wait_ms > 0  # local decode time
+    finally:
+        driver.stop()
+
+
+# -- windowed plane reuses the pool -------------------------------------------
+
+def _windowed_outputs(devices, threads, base_port):
+    from sparkrdma_tpu.parallel.exchange import TileExchange
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+    from sparkrdma_tpu.shuffle.bulk import (
+        BulkShuffleSession,
+        WindowedReadPlane,
+    )
+
+    n_exec = 2
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": base_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "15s",
+        "spark.shuffle.tpu.bulkWindowMaps": "2",
+        "spark.shuffle.tpu.readPlane": "windowed",
+        "spark.shuffle.tpu.serializer": "columnar",
+        "spark.shuffle.tpu.compress": True,
+        "spark.shuffle.tpu.decodeThreads": threads,
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=base_port + 100 + i * 10, executor_id=str(i),
+            stage_to_device=False,
+        )
+        for i in range(n_exec)
+    ]
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(len(e._peers) == n_exec for e in executors):
+                break
+            time.sleep(0.01)
+        session = BulkShuffleSession(
+            TileExchange(make_mesh(n_exec), tile_bytes=1 << 12), n_exec,
+            timeout_s=conf.bulk_barrier_timeout_ms / 1000.0,
+        )
+        for e in executors:
+            e.windowed_plane = WindowedReadPlane(e, session=session)
+        num_maps, num_parts = 4, 4
+        part = HashPartitioner(num_parts)
+        handle = driver.register_shuffle(
+            12, num_maps, part, key_ordering=True
+        )
+        for m in range(num_maps):
+            w = executors[m % n_exec].get_writer(handle, m)
+            w.write(_records(300, seed=m))
+            w.stop(True)
+        results = {}
+        errors = {}
+
+        def reduce_task(pid):
+            try:
+                r = executors[pid % n_exec].get_reader(
+                    handle, pid, pid + 1, {}
+                )
+                results[pid] = list(r.read())
+                if threads > 0:
+                    assert r.metrics.decode_wait_ms >= 0
+            except BaseException as e:
+                errors[pid] = e
+
+        tasks = [
+            threading.Thread(target=reduce_task, args=(pid,), daemon=True)
+            for pid in range(num_parts)
+        ]
+        for t in tasks:
+            t.start()
+        for t in tasks:
+            t.join(timeout=60)
+        assert not errors, errors
+        return [results[p] for p in range(num_parts)]
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+def test_windowed_plane_decode_pipeline_parity(devices):
+    """The windowed device plane's reader through the decode pool:
+    same key-ordered output as its serial decode, with the pool
+    genuinely engaged."""
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.reset()
+    GLOBAL_REGISTRY.enabled = True
+    try:
+        serial = _windowed_outputs(devices, 0, _ports())
+        piped = _windowed_outputs(devices, 2, _ports())
+        assert piped == serial
+        for pout in piped:
+            keys = [k for k, _v in pout]
+            assert keys == sorted(keys)
+        decoded = [
+            inst for _k, inst in GLOBAL_REGISTRY.instruments()
+            if getattr(inst, "name", "") == "shuffle_decode_tasks_total"
+        ]
+        assert sum(d.value for d in decoded) > 0
+    finally:
+        GLOBAL_REGISTRY.enabled = prev
+        GLOBAL_REGISTRY.reset()
+
+
+# -- lockDebug stress ---------------------------------------------------------
+
+def test_lockdebug_stress_with_decode_pool():
+    """Concurrent pipelined reads under the runtime lock sanitizer:
+    zero rank violations with the decode pool active."""
+    factory = get_lock_factory()
+    prev = factory.enabled
+    prev_reg = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.reset()
+    try:
+        errors = []
+
+        def run(seed):
+            try:
+                conf = dict(MODES["compressed-columnar"])
+                conf.update({
+                    "spark.shuffle.tpu.decodeThreads": 2,
+                    "spark.shuffle.tpu.lockDebug": True,
+                    "spark.shuffle.tpu.metrics": True,
+                })
+                out = _run_shuffle(
+                    conf,
+                    [_records(500, seed=seed + m) for m in range(3)],
+                    key_ordering=True,
+                )
+                assert sum(len(p) for p in out) == 1500
+            except BaseException as e:  # surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(s,), daemon=True)
+            for s in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in threads), "stress hung"
+        assert not errors, errors
+        violations = [
+            inst for _k, inst in GLOBAL_REGISTRY.instruments()
+            if getattr(inst, "name", "") == "lock_rank_violations_total"
+        ]
+        assert sum(v.value for v in violations) == 0
+        # and the pool really ran (the sweep isn't trivially serial)
+        decoded = [
+            inst for _k, inst in GLOBAL_REGISTRY.instruments()
+            if getattr(inst, "name", "") == "shuffle_decode_tasks_total"
+        ]
+        assert sum(d.value for d in decoded) > 0
+    finally:
+        factory.enabled = prev
+        GLOBAL_REGISTRY.enabled = prev_reg
+        GLOBAL_REGISTRY.reset()

@@ -106,6 +106,45 @@ def render_lock_holds(hists: list) -> list:
     return out
 
 
+def render_decode_pipeline(counters: list) -> list:
+    """Compact view of the reduce-side decode pipeline's instruments
+    (shuffle/decode.py): decoded volume and time, the decode-ahead hit
+    rate (tickets already decoded when the task thread asked) vs
+    steals (task thread decoded inline because no worker had started),
+    credit waits, and the task thread's wire-wait vs decode-wait
+    split."""
+    vals = {}
+    for c in counters:
+        if c.get("labels"):
+            continue
+        vals[c["name"]] = c["value"]
+    tasks = vals.get("shuffle_decode_tasks_total", 0)
+    if not tasks:
+        return []
+    hits = vals.get("shuffle_decode_ahead_hits_total", 0)
+    steals = vals.get("shuffle_decode_steals_total", 0)
+    out = ["decode pipeline (shuffle/decode.py)"]
+    out.append(
+        f"  decoded {_fmt_num(vals.get('shuffle_decode_bytes_total', 0))}B "
+        f"in {tasks:,.0f} task(s), "
+        f"{_fmt_us(vals.get('shuffle_decode_us_total', 0))} decode time"
+    )
+    out.append(
+        f"  decode-ahead hits={hits:,.0f} ({hits / tasks:.0%})  "
+        f"inline steals={steals:,.0f}  "
+        f"credit waits={vals.get('shuffle_decode_credit_waits_total', 0):,.0f}  "
+        f"block splits={vals.get('shuffle_decode_block_splits_total', 0):,.0f}"
+    )
+    wire = vals.get("shuffle_fetch_wait_ms_total")
+    dec = vals.get("shuffle_decode_wait_ms_total")
+    if wire is not None or dec is not None:
+        out.append(
+            f"  task-thread wait split: wire={_fmt_us((wire or 0) * 1e3)} "
+            f"decode={_fmt_us((dec or 0) * 1e3)}"
+        )
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
@@ -116,6 +155,7 @@ def render(snap: dict, title: str = "") -> str:
     lock_hists = [h for h in all_hists if h["name"] == "lock_hold_us"]
     hists = [h for h in all_hists if h["name"] != "lock_hold_us"]
     lines.extend(render_lock_holds(lock_hists))
+    lines.extend(render_decode_pipeline(counters))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
     )
