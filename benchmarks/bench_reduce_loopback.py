@@ -53,7 +53,7 @@ DECODE_PARTS = 4
 DECODE_REPS = 1 if SMOKE else 3
 
 
-def _fetch_config(name, port, stripes, scatter_gather):
+def _fetch_config(name, port, stripes, scatter_gather, extra=None):
     """One measurement config: nodes+network over real sockets, a
     registered 32 MiB store, and the per-peer read group."""
     from sparkrdma_tpu.conf import TpuShuffleConf
@@ -61,11 +61,13 @@ def _fetch_config(name, port, stripes, scatter_gather):
     from sparkrdma_tpu.transport import TcpNetwork
     from sparkrdma_tpu.transport.node import Node
 
-    conf = TpuShuffleConf({
+    conf_map = {
         "spark.shuffle.tpu.transportNumStripes": stripes,
         "spark.shuffle.tpu.transportStripeThreshold": "256k",
         "spark.shuffle.tpu.transportScatterGather": scatter_gather,
-    })
+    }
+    conf_map.update(extra or {})
+    conf = TpuShuffleConf(conf_map)
     net = TcpNetwork()
     a = Node(("127.0.0.1", port), conf)
     b = Node(("127.0.0.1", port + 5), conf)
@@ -117,6 +119,48 @@ def _fetch_throughput(cfg, size):
         _read_once(cfg, size)
     dt = time.perf_counter() - t0
     return iters * size / dt / 1e9
+
+
+def _fetch_throughput_windowed(cfg, size, window=4):
+    """GB/s of WINDOWED whole-block fetches (``window`` reads in
+    flight, the reader's maxBytesInFlight pipelining shape) totalling
+    TARGET_MOVE — the workload the completion-driven transport core
+    exists for; sequential one-at-a-time reads are latency-bound and
+    measure per-read fixed hops instead."""
+    from sparkrdma_tpu.transport.channel import FnCompletionListener
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    iters = max(window, TARGET_MOVE // size)
+    sem = threading.BoundedSemaphore(window)
+    done = threading.Event()
+    left = [iters]
+    err = []
+    lk = threading.Lock()
+
+    def settle(e=None):
+        if e is not None:
+            err.append(e)
+        sem.release()
+        with lk:
+            left[0] -= 1
+            if left[0] == 0:
+                done.set()
+
+    _read_once(cfg, size)  # warmup (connects the lanes)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sem.acquire()
+        cfg["group"].read_blocks(
+            [BlockLocation(0, size, cfg["mkey"])],
+            FnCompletionListener(
+                lambda blocks: settle(), lambda e: settle(e)
+            ),
+        )
+    if not done.wait(180):
+        raise RuntimeError("windowed fetch hung")
+    if err:
+        raise err[0]
+    return iters * size / (time.perf_counter() - t0) / 1e9
 
 
 def _rpc_latency_under_bulk(cfg, bulk_size=None):
@@ -235,6 +279,149 @@ def striped_fetch_sweep():
                     "(pre-striping wire path)",
         "best": best,
         "rpc_p50_ms": {"baseline": base_rpc, "striped": rpc_striped},
+    }, out_dir=SMOKE_DIR)
+    GLOBAL_REGISTRY.enabled = False
+
+
+def async_transport_sweep():
+    """Async-dispatcher vs thread-per-lane A/B on the striped-fetch
+    data path, plus RPC echo p50 under concurrent bulk, plus the
+    transport thread census — writes BENCH_async_transport.json with
+    the threaded baseline embedded.  Absolute numbers on this host
+    drift run to run; the interleaved best-of ratios are the signal."""
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+
+    GLOBAL_REGISTRY.enabled = True
+    port = BASE_PORT + 900
+    stripes = 2
+    reps = 1 if SMOKE else 3
+    table = {"threaded": {}, "async": {}}
+    rpc = {}
+    census = {}
+    # INTERLEAVED reps, best-of: this 1-core bench host is noisy
+    # (run-to-run throughput swings ±20%), so each mode's number is the
+    # best of `reps` alternating measurements — the same denoising the
+    # decode sweep uses, applied A/B-fairly
+    import threading as _th
+
+    from sparkrdma_tpu.transport.node import TRANSPORT_THREAD_PREFIXES
+
+    for rep in range(reps):
+        for mode, flag in (("threaded", "off"), ("async", "on")):
+            # census by DELTA against the threads alive before this
+            # config: earlier reps leak lingering threaded-engine
+            # threads (a closed listener does not wake a blocked
+            # accept()), which would otherwise contaminate the async
+            # engine's count with the exact threads it exists to remove
+            pre = {t.ident for t in _th.enumerate()}
+            cfg = _fetch_config(
+                f"{mode} transport", port, stripes, "on",
+                {"spark.shuffle.tpu.transportAsyncDispatcher": flag},
+            )
+            try:
+                for size in SWEEP_SIZES:
+                    gbps = _fetch_throughput_windowed(cfg, size)
+                    table[mode][size] = max(
+                        table[mode].get(size, 0.0), gbps
+                    )
+                p50 = _rpc_latency_under_bulk(cfg)
+                rpc[mode] = min(rpc.get(mode, float("inf")), p50)
+                by_role = {}
+                for t in _th.enumerate():
+                    if t.ident in pre:
+                        continue
+                    for prefix in TRANSPORT_THREAD_PREFIXES:
+                        if t.name.startswith(prefix):
+                            role = prefix.rstrip("-")
+                            by_role[role] = by_role.get(role, 0) + 1
+                            break
+                census[mode] = {
+                    "transport_threads": sum(by_role.values()),
+                    "by_role": by_role,
+                }
+            finally:
+                _teardown_config(cfg)
+            port += 30
+    for mode in ("threaded", "async"):
+        for size in SWEEP_SIZES:
+            base = table["threaded"][size]
+            emit(
+                f"windowed striped fetch {size >> 20}MiB "
+                f"({mode} transport, stripes={stripes}, best of {reps})",
+                table[mode][size], "GB/s",
+                table[mode][size] / base if base else 1.0,
+            )
+        emit(
+            f"RPC echo p50 under concurrent bulk ({mode} transport, "
+            f"best of {reps})",
+            rpc[mode], "ms",
+            rpc["threaded"] / rpc[mode] if rpc[mode] else 1.0,
+        )
+    ratios = {
+        size: table["async"][size] / table["threaded"][size]
+        for size in SWEEP_SIZES
+    }
+    best_size = max(ratios, key=ratios.get)
+    emit(
+        f"best async-vs-threaded striped fetch ({best_size >> 20}MiB)",
+        table["async"][best_size], "GB/s", ratios[best_size],
+    )
+    # aggregate sweep throughput (total bytes / total best-case time):
+    # the single headline number the acceptance criterion reads
+    agg = {
+        m: sum(SWEEP_SIZES)
+        / sum(size / table[m][size] for size in SWEEP_SIZES)
+        for m in ("threaded", "async")
+    }
+    emit(
+        "aggregate windowed striped-fetch throughput (async, "
+        "size-weighted over sweep)",
+        agg["async"], "GB/s",
+        agg["async"] / agg["threaded"] if agg["threaded"] else 1.0,
+    )
+    from benchmarks.common import write_bench_json
+
+    write_bench_json("async_transport", extra={
+        "baseline": "transportAsyncDispatcher=off — the thread-per-"
+                    "lane blocking wire path (one reader thread per "
+                    "channel + accept thread + serve workers blocked "
+                    "through sends)",
+        "stripes": stripes,
+        "fetch_gbps": {
+            m: {f"{s >> 20}MiB": round(v, 4) for s, v in t.items()}
+            for m, t in table.items()
+        },
+        "fetch_ratio_async_vs_threaded": {
+            f"{s >> 20}MiB": round(r, 4) for s, r in ratios.items()
+        },
+        "fetch_window": 4,
+        "aggregate_gbps": {m: round(v, 4) for m, v in agg.items()},
+        "aggregate_ratio_async_vs_threaded": round(
+            agg["async"] / agg["threaded"], 4
+        ) if agg.get("threaded") else None,
+        "rpc_p50_ms": {m: round(v, 4) for m, v in rpc.items()},
+        "rpc_p50_ratio_threaded_over_async": round(
+            rpc["threaded"] / rpc["async"], 4
+        ) if rpc.get("async") else None,
+        "transport_census": census,
+        "host_note": (
+            f"bench host has {os.cpu_count()} CPU core(s) and its "
+            "absolute throughput drifts 1.5-2x between runs, so only "
+            "the interleaved best-of ratios are meaningful: this run "
+            "measured async/threaded fetch ratios of "
+            + ", ".join(
+                f"{s >> 20}MiB={ratios[s]:.2f}x" for s in SWEEP_SIZES
+            )
+            + f" (size-weighted aggregate "
+            f"{agg['async'] / agg['threaded']:.2f}x) and RPC p50 "
+            f"{rpc['async']:.3f} vs {rpc['threaded']:.3f} ms.  The "
+            "async engine runs the transport on one event-loop thread "
+            "+ bounded pools instead of O(peers x stripes) readers; "
+            "lane streaming gives busy lanes the threaded reader's "
+            "syscall shape, and the residual RPC delta is per-wake "
+            "loop machinery that stops timeslicing against the peers "
+            "once the host has >1 core"
+        ),
     }, out_dir=SMOKE_DIR)
     GLOBAL_REGISTRY.enabled = False
 
@@ -439,6 +626,8 @@ def main():
     striped_fetch_sweep()
     RESULTS.clear()
     decode_pipeline_sweep()
+    RESULTS.clear()
+    async_transport_sweep()
 
 
 if __name__ == "__main__":

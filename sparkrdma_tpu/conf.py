@@ -271,6 +271,99 @@ class TpuShuffleConf:
         return self._bool("transportScatterGather", True)
 
     @property
+    def transport_async_dispatcher(self) -> bool:
+        """Completion-driven transport core (transport/dispatcher.py):
+        one ``selectors`` event-loop thread per node owns every TCP
+        transport socket in non-blocking mode — sends post as
+        descriptors to a submission queue, receives run as partial
+        ``recv_into``/``sendmsg`` continuations, and batched completion
+        events dispatch to the striped/decode callbacks (the fabric-lib
+        / RAMC submission-queue + completion-queue idiom).  Thread
+        count per node drops from O(peers × stripes) reader threads to
+        O(1).  ``off`` restores the legacy thread-per-lane blocking
+        path for A/B and bit-exactness — the two speak the same wire
+        format and interoperate."""
+        return self._bool("transportAsyncDispatcher", True)
+
+    @property
+    def transport_socket_buffer_bytes(self) -> int:
+        """Explicit SO_SNDBUF/SO_RCVBUF on async-dispatcher sockets
+        (the registered-ring-size analog of the RDMA QP); the kernel
+        doubles the requested value and caps it at
+        ``net.core.{w,r}mem_max``.  ``0`` — the default — keeps kernel
+        autotuning: pinning at 4 MiB was A/B'd ~15% SLOWER than
+        autotune on the loopback bench (setting SO_RCVBUF freezes the
+        buffer where autotune keeps growing it with the BDP), so the
+        knob exists for real fabrics with known ring budgets, not as a
+        default."""
+        return self._bytes_in_range(
+            "transportSocketBufferBytes", 0, 0, 1 << 30
+        )
+
+    @property
+    def transport_recv_coalesce_bytes(self) -> int:
+        """Receive-wakeup coalescing on the async dispatcher (the
+        completion-moderation analog of NIC interrupt coalescing):
+        while a channel is mid-way through a large response body the
+        loop sets ``SO_RCVLOWAT`` to this value, so ``epoll`` wakes it
+        once per ~this many queued bytes instead of per arriving
+        skb — fewer loop iterations and GIL round-trips per MiB.
+        Headers and body tails drop the watermark back to 1 byte, and
+        EOF/errors always wake regardless (kernel semantics), so
+        dead-peer detection is unaffected.  ``0`` disables."""
+        return self._bytes_in_range(
+            "transportRecvCoalesceBytes", 1 << 20, 0, 64 << 20
+        )
+
+    @property
+    def transport_stream_offload_bytes(self) -> int:
+        """Lane streaming on the async dispatcher: when a bulk
+        channel has at least this many response bytes outstanding, its
+        whole recv machine moves to a completion-pool worker doing
+        BLOCKING ``recv`` with inline completion delivery (the
+        CQ-poller vs completion-worker split of fabric-lib) until the
+        lane drains idle, then returns to the event loop.  A busy lane
+        gets the threaded reader's exact syscall-and-delivery shape —
+        one handoff per burst — while idle lanes cost no thread at
+        all; at most a bounded number of lanes stream at a time and the
+        rest stay on-loop.  ``0`` disables (every landing stays on the
+        loop)."""
+        return self._bytes_in_range(
+            "transportStreamOffloadBytes", 1 << 20, 0, 1 << 40
+        )
+
+    @property
+    def transport_poll_spin_us(self) -> int:
+        """Adaptive busy-poll window (µs) on the async dispatcher loop:
+        after an iteration that did real work the loop re-polls the
+        selector non-blocking for this long before re-arming the
+        blocking ``select`` — the poll-mode progress engine of the
+        RDMA designs this core follows.  Back-to-back events (an RPC
+        pong chased by the next ping, successive chunks of a draining
+        stripe) are serviced at ``epoll_wait(0)`` cost with no
+        sleep/wake transition.  ``0`` disables (always block) — the
+        default on single-core hosts, where A/B showed the spin steals
+        the very core the peer and the serve workers need (RPC p50
+        DOUBLED spinning there); the decodeThreads/bulkPipelineWindows
+        single-core-fallback precedent."""
+        return self._int_in_range(
+            "transportPollSpinUs",
+            40 if (os.cpu_count() or 1) > 1 else 0, 0, 10000,
+        )
+
+    @property
+    def transport_send_backlog_bytes(self) -> int:
+        """Per-channel write backpressure on the async dispatcher: when
+        a channel's queued-but-unsent response bytes exceed this, the
+        loop stops READING that socket (new requests queue in the
+        kernel and eventually in the requester's TCP window) until the
+        backlog drains below half — so a requester that never drains
+        its responses throttles itself, not the node."""
+        return self._bytes_in_range(
+            "transportSendBacklogBytes", 16 << 20, 64 << 10, 1 << 40
+        )
+
+    @property
     def transport_serve_threads(self) -> int:
         """Worker threads on the node's read-serve pool (one-sided READ
         service).  Serving runs off the channel reader loops so one

@@ -45,6 +45,46 @@ Address = Tuple[str, int]
 # Frames arriving on a channel are handed to: (source_channel, frame_bytes)
 ReceiveListener = Callable[[Channel, bytes], None]
 
+#: thread-name prefixes of every transport/shuffle plane thread this
+#: library spawns — the census (and the scale tests) count by these
+TRANSPORT_THREAD_PREFIXES = (
+    "disp-",        # async dispatcher event loops
+    "tcp-",         # threaded-mode channel readers + accept loops
+    "serve-",       # bounded read-serve pool workers
+    "node-",        # completion/dispatch pool + teardown workers
+    "decode-",      # reduce-side decode pool workers
+)
+
+
+def transport_census() -> Dict[str, object]:
+    """Thread/fd census of the transport planes: live library threads
+    grouped by role prefix, total Python threads, and this process's
+    open fd count (Linux; -1 elsewhere).  Refreshes the
+    ``transport_threads`` gauge so scrapes see the census too.  The
+    async dispatcher's acceptance criterion — O(1) transport threads
+    per node regardless of peer × stripe fan-out — is asserted against
+    this (tests/test_dryrun_scale.py)."""
+    by_role: Dict[str, int] = {}
+    for t in threading.enumerate():
+        for prefix in TRANSPORT_THREAD_PREFIXES:
+            if t.name.startswith(prefix):
+                by_role[prefix.rstrip("-")] = (
+                    by_role.get(prefix.rstrip("-"), 0) + 1
+                )
+                break
+    n = sum(by_role.values())
+    try:
+        fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        fds = -1
+    gauge("transport_threads").set(n)
+    return {
+        "transport_threads": n,
+        "by_role": by_role,
+        "python_threads": threading.active_count(),
+        "open_fds": fds,
+    }
+
 
 class _ServePool:
     """Bounded read-serve pool: fixed worker threads drain a FIFO of
@@ -76,22 +116,51 @@ class _ServePool:
         for t in self._workers:
             t.start()
 
-    def submit(self, fn, args: tuple, cost: int) -> None:
-        """Never blocks the caller (channel reader loops post here)."""
+    def submit(self, fn, args: tuple, cost: int,
+               deferred: bool = False) -> None:
+        """Never blocks the caller (channel reader loops and the async
+        dispatcher post here).  ``deferred=True`` is the
+        completion-driven contract: the worker calls
+        ``fn(*args, release)`` and the CALLEE owns returning the
+        credits via the idempotent ``release()`` — typically from the
+        response's send-completion event — so credits keep bounding
+        resident serve memory without a worker blocked in the send."""
         if self._stopped:
             raise TransportError("serve pool stopped")
         self._m_depth.inc()
-        self._queue.put((fn, args, max(int(cost), 0)))
+        self._queue.put((fn, args, max(int(cost), 0), deferred))
+
+    def _make_release(self, cost: int):
+        """Idempotent credit return, safe from any thread."""
+        released = [False]  # guarded-by: _cv
+
+        def release() -> None:
+            with self._cv:
+                if released[0]:
+                    return
+                released[0] = True
+                self._credits += cost
+                self._cv.notify_all()
+
+        return release
 
     def _run(self, init_fn) -> None:
         if init_fn is not None:
             init_fn()
+        g = gauge("transport_threads", role="serve")
+        g.inc()
+        try:
+            self._drain(init_fn)
+        finally:
+            g.dec()
+
+    def _drain(self, _init_fn) -> None:
         while True:
             item = self._queue.get()
             if item is None:
                 return
             self._m_depth.dec()
-            fn, args, cost = item
+            fn, args, cost, deferred = item
             cost = min(cost, self._budget)
             with self._cv:
                 if self._credits < cost:
@@ -102,14 +171,18 @@ class _ServePool:
                     return
                 self._credits -= cost
             self._m_tasks.inc()
+            release = self._make_release(cost)
             try:
-                fn(*args)
+                if deferred:
+                    fn(*args, release)
+                else:
+                    fn(*args)
             except BaseException:
                 logger.exception("read serve failed")
+                release()
             finally:
-                with self._cv:
-                    self._credits += cost
-                    self._cv.notify_all()
+                if not deferred:
+                    release()
 
     def stop(self) -> None:
         with self._cv:
@@ -170,7 +243,7 @@ class Node:
         self._dispatcher = ThreadPoolExecutor(
             max_workers=4,
             thread_name_prefix=f"node-{address[0]}:{address[1]}",
-            initializer=self._pin_worker_thread,
+            initializer=self._init_pool_thread,
         )
         # the read service runs on its OWN bounded serve pool so
         # multi-MB block serves can never starve control-plane traffic
@@ -179,6 +252,12 @@ class Node:
         # much registered memory concurrent serves pin
         self._serve_pool: Optional[_ServePool] = None
         self._serve_lock = dbg_lock("node.serve_pool", 40)
+        # async transport core (transport/dispatcher.py): ONE selector
+        # event-loop thread owning every transport socket, created
+        # lazily by the first socket-backed registration under
+        # conf transportAsyncDispatcher
+        self._async_dispatcher = None
+        self._disp_lock = dbg_lock("node.disp", 41)
         self._stopped = threading.Event()
 
     # -- dispatcher thread placement ----------------------------------------
@@ -195,6 +274,10 @@ class Node:
         if not pins or pins == frozenset(range(ncpu)):
             return None
         return pins
+
+    def _init_pool_thread(self) -> None:
+        gauge("transport_threads", role="completion_pool").inc()
+        self._pin_worker_thread()
 
     def _pin_worker_thread(self) -> None:
         if not self._cpu_pins:
@@ -248,11 +331,16 @@ class Node:
         """Run fn on the dispatcher (async completion delivery)."""
         return self._dispatcher.submit(fn, *args)
 
-    def submit_serve(self, fn, args: tuple = (), cost: int = 0):
+    def submit_serve(self, fn, args: tuple = (), cost: int = 0,
+                     deferred: bool = False):
         """Run one read serve on the node's bounded serve pool (created
         on first use; workers pin to ``dispatcherCpuList`` like the
         dispatcher).  ``cost`` is the serve's requested byte total —
-        the pool's credit budget throttles admission on it."""
+        the pool's credit budget throttles admission on it.
+        ``deferred=True`` hands ``fn`` an idempotent ``release``
+        callable that returns the credits (the async dispatcher's
+        send-completion events release there instead of a worker
+        blocking through the send)."""
         if self._stopped.is_set():
             raise TransportError(f"{self}: stopped")
         pool = self._serve_pool
@@ -266,7 +354,29 @@ class Node:
                         init_fn=self._pin_worker_thread,
                     )
                 pool = self._serve_pool
-        pool.submit(fn, args, cost)
+        pool.submit(fn, args, cost, deferred)
+
+    def get_dispatcher(self):
+        """The node's async transport event loop (the submission/
+        completion-queue progress engine, transport/dispatcher.py) —
+        created lazily so loopback-only nodes never pay for it.
+        Completion batches dispatch onto this node's completion pool
+        (``submit``)."""
+        d = self._async_dispatcher
+        if d is not None:
+            return d
+        with self._disp_lock:
+            if self._async_dispatcher is None:
+                if self._stopped.is_set():
+                    raise TransportError(f"{self}: stopped")
+                from sparkrdma_tpu.transport.dispatcher import Dispatcher
+
+                self._async_dispatcher = Dispatcher(
+                    f"{self.address[0]}:{self.address[1]}",
+                    self.conf, self.submit,
+                    pin_fn=self._pin_worker_thread,
+                )
+            return self._async_dispatcher
 
     # -- block stores (registered memory domains) ---------------------------
     def register_block_store(self, mkey: int, store: BlockStore) -> None:
@@ -464,7 +574,17 @@ class Node:
                     "cannot block process exit)", self.address,
                     hung, budget,
                 )
+        # the async event loop stops AFTER channels (their _loop_close
+        # descriptors must drain) and BEFORE the completion pool (its
+        # teardown completion batch still needs an executor)
+        with self._disp_lock:
+            disp, self._async_dispatcher = self._async_dispatcher, None
+        if disp is not None:
+            disp.stop()
         self._dispatcher.shutdown(wait=True)
+        gauge("transport_threads", role="completion_pool").dec(
+            len(getattr(self._dispatcher, "_threads", ()))
+        )
         with self._serve_lock:
             serve, self._serve_pool = self._serve_pool, None
         if serve is not None:

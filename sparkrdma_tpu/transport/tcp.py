@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from sparkrdma_tpu.metrics import counter, histogram
+from sparkrdma_tpu.metrics import counter, gauge, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
     ChannelState,
@@ -106,6 +106,41 @@ def _as_view(buf) -> memoryview:
     if v.format != "B" or v.ndim != 1:
         v = v.cast("B")
     return v
+
+
+def build_read_response_parts(node, payload: bytes, peer) -> Optional[List]:
+    """Resolve one OP_READ_REQ into the scatter-gather response parts
+    (header + length prefixes + the resolved block VIEWS — registered
+    memory is never copied into an intermediate buffer), or the scoped
+    error reply.  Returns None when not even a req_id is parseable
+    (logged; the channel stays healthy).  Shared by the threaded serve
+    path and the async dispatcher's completion-driven one."""
+    try:
+        req_id, count = _REQ_HDR.unpack_from(payload, 0)
+    except Exception:
+        logger.warning(
+            "malformed read request from %s (%dB)", peer, len(payload),
+        )
+        return None
+    try:
+        locs = []
+        off = _REQ_HDR.size
+        for _ in range(count):
+            addr, length, mkey = _LOC.unpack_from(payload, off)
+            off += _LOC.size
+            locs.append(BlockLocation(addr, length, mkey))
+        blocks = node.read_local_blocks(locs)
+        parts: List = [_RESP_HDR.pack(req_id, 0)]
+        for b in blocks:
+            v = _as_view(b)
+            parts.append(_LEN.pack(v.nbytes))
+            parts.append(v)
+    except BaseException as e:
+        parts = [
+            _RESP_HDR.pack(req_id, 1),
+            str(e).encode("utf-8", "replace"),
+        ]
+    return parts
 
 
 def _req_cost(payload: bytes) -> int:
@@ -285,6 +320,8 @@ class TcpChannel(Channel):
 
     # -- receiving ----------------------------------------------------------
     def _read_loop(self) -> None:
+        g = gauge("transport_threads", role="tcp_reader")
+        g.inc()
         try:
             while True:
                 opcode, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
@@ -320,6 +357,8 @@ class TcpChannel(Channel):
             if self.state not in (ChannelState.STOPPED,):
                 self._error(e)
                 self._fail_outstanding(e)
+        finally:
+            g.dec()
 
     def _recv_read_resp(self, length: int) -> None:
         """Receive one read response.  Striped reads (``dest`` buffers
@@ -440,34 +479,11 @@ class TcpChannel(Channel):
         scatter-gather frame of header + length prefixes + the
         resolved block VIEWS — registered memory is never copied into
         an intermediate response buffer."""
-        try:
-            req_id, count = _REQ_HDR.unpack_from(payload, 0)
-        except Exception:
-            # not even a req_id to scope an error reply to — log and
-            # drop; the channel itself stays healthy
-            logger.warning(
-                "malformed read request from %s (%dB)",
-                self.peer, len(payload),
-            )
+        parts = build_read_response_parts(self.node, payload, self.peer)
+        if parts is None:
+            # not even a req_id to scope an error reply to — dropped
+            # (logged); the channel itself stays healthy
             return
-        try:
-            locs = []
-            off = _REQ_HDR.size
-            for _ in range(count):
-                addr, length, mkey = _LOC.unpack_from(payload, off)
-                off += _LOC.size
-                locs.append(BlockLocation(addr, length, mkey))
-            blocks = self.node.read_local_blocks(locs)
-            parts: List = [_RESP_HDR.pack(req_id, 0)]
-            for b in blocks:
-                v = _as_view(b)
-                parts.append(_LEN.pack(v.nbytes))
-                parts.append(v)
-        except BaseException as e:
-            parts = [
-                _RESP_HDR.pack(req_id, 1),
-                str(e).encode("utf-8", "replace"),
-            ]
         try:
             self._send_msg(OP_READ_RESP, parts)
         except BaseException:
@@ -479,12 +495,20 @@ class TcpChannel(Channel):
 
 
 class TcpNetwork:
-    """Listener + connector over real sockets (one instance per process)."""
+    """Listener + connector over real sockets (one instance per process).
+
+    ``transportAsyncDispatcher`` (per NODE, default on) decides which
+    engine a node's sockets run on: the completion-driven selector loop
+    (transport/dispatcher.py — the listener and every channel ride one
+    event-loop thread) or the legacy thread-per-channel blocking path.
+    The wire format is identical, so mixed-mode deployments
+    interoperate."""
 
     def __init__(self, listen_backlog: int = 128):
         self.listen_backlog = listen_backlog
+        # addr -> (server socket, accept thread | Acceptor | None, node)
         self._listeners: Dict[
-            Address, Tuple[socket.socket, threading.Thread, Node]
+            Address, Tuple[socket.socket, object, Node]
         ] = {}  # guarded-by: _lock
         self._lock = dbg_lock("tcp.network", 57)
 
@@ -499,6 +523,21 @@ class TcpNetwork:
             srv.close()
             raise TransportError(f"bind failed at {host}:{port}: {e}") from e
         srv.listen(self.listen_backlog)
+        if node.conf.transport_async_dispatcher:
+            # the listener rides the node's event loop — no accept thread
+            from sparkrdma_tpu.transport.dispatcher import Acceptor
+
+            srv.setblocking(False)
+            try:
+                disp = node.get_dispatcher()
+                acc = Acceptor(disp, node, srv)
+                disp.post(acc.loop_register)
+            except TransportError:
+                srv.close()
+                raise
+            with self._lock:
+                self._listeners[node.address] = (srv, acc, node)
+            return
         t = threading.Thread(
             target=self._accept_loop, args=(srv, node), daemon=True,
             name=f"tcp-accept-{host}:{port}",
@@ -511,14 +550,29 @@ class TcpNetwork:
         with self._lock:
             entry = self._listeners.pop(node.address, None)
         if entry is not None:
-            srv, _t, _n = entry
+            srv, owner, _n = entry
+            close_fn = getattr(owner, "request_close", None)
+            if close_fn is not None:
+                # async acceptor: the LOOP must unregister before the
+                # fd closes (a direct close here could let a reused fd
+                # number collide with the stale selector key)
+                close_fn()
+                return
             try:
                 srv.close()
             except OSError:
                 pass
 
-    # -- acceptor (the CM listener thread analog) ---------------------------
+    # -- acceptor (the CM listener thread analog; threaded mode only) -------
     def _accept_loop(self, srv: socket.socket, node: Node) -> None:
+        g = gauge("transport_threads", role="accept")
+        g.inc()
+        try:
+            self._accept_forever(srv, node)
+        finally:
+            g.dec()
+
+    def _accept_forever(self, srv: socket.socket, node: Node) -> None:
         while True:
             try:
                 sock, addr = srv.accept()
@@ -573,6 +627,10 @@ class TcpNetwork:
                 "transport_connect_failures_total", transport="tcp"
             ).inc()
             raise TransportError(f"connect to {peer} failed: {e}") from e
+        if src.conf.transport_async_dispatcher:
+            from sparkrdma_tpu.transport.dispatcher import AsyncTcpChannel
+
+            return AsyncTcpChannel.attach(channel_type, src, peer, sock)
         ch = TcpChannel(channel_type, src, peer, sock)
         ch._set_state(ChannelState.CONNECTED)
         ch.start_reader()

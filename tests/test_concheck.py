@@ -344,3 +344,93 @@ def test_ck03_applies_to_closures_defined_in_init(tmp_path):
     """)
     assert _codes(findings) == ["CK03"], findings
     assert findings[0][1] == 8, findings
+
+
+# -- CK05: blocking in on-loop (event-loop) code ------------------------------
+
+
+def test_ck05_direct_blocking_in_onloop_method(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import queue
+
+        class H:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def on_readable(self):  # on-loop
+                return self._q.get()
+    """)
+    assert _codes(findings) == ["CK05"], findings
+    assert findings[0][1] == 8, findings
+
+
+def test_ck05_transitive_same_class_blocking(tmp_path):
+    """An on-loop method calling an unmarked same-class helper that
+    blocks is flagged at the CALL site."""
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+        class H:
+            def __init__(self):
+                self._done = threading.Event()
+
+            def on_writable(self):  # on-loop
+                self._helper()
+
+            def _helper(self):
+                self._done.wait()
+    """)
+    assert _codes(findings) == ["CK05"], findings
+    assert findings[0][1] == 8, findings
+
+
+def test_ck05_sleep_and_condition_wait_flagged_on_loop_only(tmp_path):
+    """time.sleep and own-condition waits block an event loop (CK05)
+    but are NOT CK02 findings off-loop — pre-CK05 behavior kept."""
+    findings = _analyze_src(tmp_path, """\
+        import threading
+        import time
+
+        class H:
+            def __init__(self):
+                self._cv = threading.Condition()  # lock-order: 10
+
+            def on_readable(self):  # on-loop
+                time.sleep(0.1)
+
+            def on_writable(self):  # on-loop
+                with self._cv:
+                    self._cv.wait()
+
+            def worker(self):
+                time.sleep(0.1)
+                with self._cv:
+                    self._cv.wait()
+    """)
+    assert _codes(findings) == ["CK05"], findings
+    assert sorted(l for _r, l, _c, _m in findings) == [9, 13], findings
+
+
+def test_ck05_nonblocking_socket_ops_allowed_on_loop(tmp_path):
+    """recv_into/sendmsg/accept are the loop's job — no finding, and
+    a code-scoped noqa silences a deliberate violation."""
+    findings = _analyze_src(tmp_path, """\
+        import queue
+
+        class H:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def on_readable(self):  # on-loop
+                try:
+                    n = self._sock.recv_into(self._buf)
+                    self._sock.sendmsg([self._buf])
+                    self._sock.accept()
+                except BlockingIOError:
+                    n = 0
+                return n
+
+            def on_writable(self):  # on-loop
+                return self._q.get()  # noqa: CK05
+    """)
+    assert findings == [], findings

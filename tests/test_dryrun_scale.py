@@ -34,3 +34,116 @@ def test_dryrun_multichip_16_devices():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert f"DRYRUN{n} OK" in proc.stdout, proc.stdout
+
+
+def test_async_dispatcher_bounded_threads_fds_at_high_peer_count():
+    """Groundwork for the RDMAvisor-scale fabric (ROADMAP item 1): one
+    node under transportAsyncDispatcher=on serves MANY simulated peers
+    — raw sockets speaking the hello + OP_READ_REQ wire protocol — on
+    ONE event-loop thread.  Transport thread count must stay a small
+    constant (no per-connection readers, no accept thread) while fds
+    scale only with the open sockets themselves."""
+    import socket
+    import time
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.memory.arena import ArenaManager
+    from sparkrdma_tpu.transport import TcpNetwork
+    from sparkrdma_tpu.transport import tcp as wire
+    from sparkrdma_tpu.transport.channel import ChannelType
+    from sparkrdma_tpu.transport.node import Node, transport_census
+
+    import numpy as np
+
+    n_peers = int(os.environ.get("SPARKRDMA_SCALE_PEERS", "96"))
+    port = 27900
+    pattern = (np.arange(1 << 20, dtype=np.uint32) % 251).astype(np.uint8)
+
+    # drain reader threads left by earlier threaded-mode tests
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        before = transport_census()
+        if before["by_role"].get("tcp", 0) == 0:
+            break
+        time.sleep(0.05)
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportAsyncDispatcher": "on",
+        "spark.shuffle.tpu.transportServeThreads": 2,
+    })
+    net = TcpNetwork(listen_backlog=max(128, n_peers))
+    node = Node(("127.0.0.1", port), conf)
+    net.register(node)
+    arena = ArenaManager()
+    seg = arena.register(pattern, zero_copy_ok=True)
+    node.register_block_store(seg.mkey, arena)
+
+    type_idx = list(ChannelType).index(ChannelType.READ_REQUESTOR)
+    socks = []
+    try:
+        for i in range(n_peers):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(wire._HELLO.pack(wire._MAGIC, type_idx,
+                                       50000 + i, 0))
+            assert s.recv(1) == b"\x01", f"handshake {i} rejected"
+            s.settimeout(30)
+            socks.append(s)
+
+        # all peers post a read BEFORE any response is drained — the
+        # loop multiplexes every socket concurrently
+        blk = 4096
+        for i, s in enumerate(socks):
+            addr = (i * 7919) % (len(pattern) - blk)
+            payload = wire._REQ_HDR.pack(1, 1) + wire._LOC.pack(
+                addr, blk, seg.mkey
+            )
+            s.sendall(wire._HDR.pack(wire.OP_READ_REQ, len(payload))
+                      + payload)
+
+        def recv_exact(s, n):
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(n - len(buf))
+                assert chunk, "peer socket closed early"
+                buf += chunk
+            return buf
+
+        for i, s in enumerate(socks):
+            opcode, length = wire._HDR.unpack(
+                recv_exact(s, wire._HDR.size))
+            assert opcode == wire.OP_READ_RESP
+            body = recv_exact(s, length)
+            req_id, status = wire._RESP_HDR.unpack_from(body, 0)
+            assert status == 0, body[wire._RESP_HDR.size:]
+            (n,) = wire._LEN.unpack_from(body, wire._RESP_HDR.size)
+            assert n == blk
+            addr = (i * 7919) % (len(pattern) - blk)
+            got = body[wire._RESP_HDR.size + wire._LEN.size:]
+            assert got == pattern[addr:addr + blk].tobytes(), \
+                f"peer {i} payload corrupt"
+
+        census = transport_census()
+        # O(1) transport threads: 1 loop + ≤2 serve + ≤4 completion
+        # pool — NOT O(n_peers); and zero thread-per-channel readers
+        grown = (census["transport_threads"]
+                 - before["transport_threads"])
+        assert grown <= 8, (before, census)
+        assert census["by_role"].get("tcp", 0) == \
+            before["by_role"].get("tcp", 0), census
+        assert census["by_role"].get("disp", 0) == \
+            before["by_role"].get("disp", 0) + 1, census
+        # fds scale only with the sockets themselves — BOTH ends of
+        # every connection live in this one test process (client sock +
+        # accepted sock), plus small slack for the listener, wake pipe
+        # and selector
+        if before["open_fds"] > 0 and census["open_fds"] > 0:
+            assert census["open_fds"] - before["open_fds"] \
+                <= 2 * n_peers + 16, (before, census)
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        node.stop()
+        net.unregister(node)

@@ -17,7 +17,7 @@ from sparkrdma_tpu.transport.channel import FnCompletionListener
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.utils.types import BlockLocation
 
-BASE_PORT = 45100
+BASE_PORT = 25100
 
 _PATTERN = (np.arange(6 << 20, dtype=np.uint32) % 251).astype(np.uint8)
 

@@ -41,15 +41,15 @@ from sparkrdma_tpu.shuffle.reader import (
 from sparkrdma_tpu.transport import TcpNetwork
 from sparkrdma_tpu.utils.types import BlockManagerId, ShuffleManagerId
 
-BASE_PORT = 44200
+BASE_PORT = 24200
 N_EXEC = 3
 NUM_PARTS = 4
 ROWS_PER_MAP = 250
 VAL_BYTES = 2048
 
 
-def _conf(driver_port):
-    return TpuShuffleConf({
+def _conf(driver_port, extra=None):
+    d = {
         "spark.shuffle.tpu.driverPort": driver_port,
         # promptness must come from failure detection + connect errors,
         # not from generous timers
@@ -57,7 +57,9 @@ def _conf(driver_port):
         "spark.shuffle.tpu.connectTimeout": "5s",
         "spark.shuffle.tpu.heartbeatInterval": "300ms",
         "spark.shuffle.tpu.heartbeatTimeout": "2s",
-    })
+    }
+    d.update(extra or {})
+    return TpuShuffleConf(d)
 
 
 def _records(sid: int, map_id: int):
@@ -71,11 +73,12 @@ def _records(sid: int, map_id: int):
     ]
 
 
-def _executor_proc(idx, exec_id, driver_port, my_port, cmd_q, ack_q):
+def _executor_proc(idx, exec_id, driver_port, my_port, cmd_q, ack_q,
+                   extra_conf=None):
     """Child: one shuffle manager over its own TcpNetwork, driven by
     (op, ...) commands.  SIGKILL can land at ANY point here."""
     try:
-        conf = _conf(driver_port)
+        conf = _conf(driver_port, extra_conf)
         ex = TpuShuffleManager(
             conf, is_driver=False, network=TcpNetwork(),
             port=my_port, executor_id=exec_id, stage_to_device=False,
@@ -108,14 +111,17 @@ class _Cluster:
     """Parent-side handle on the executor processes, with SIGKILL and
     respawn-with-fresh-identity support."""
 
-    def __init__(self, ctx, driver_port):
+    def __init__(self, ctx, driver_port, n=N_EXEC, extra_conf=None,
+                 base_port=None):
         self.ctx = ctx
         self.driver_port = driver_port
-        self._next_port = BASE_PORT + 100
+        self.extra_conf = extra_conf
+        self._next_port = (base_port if base_port is not None
+                           else BASE_PORT + 100)
         self._next_id = 0
         self.procs = {}   # slot -> (proc, exec_id, port, cmd_q)
         self.ack_q = ctx.Queue()
-        for slot in range(N_EXEC):
+        for slot in range(n):
             self.spawn(slot)
 
     def spawn(self, slot):
@@ -127,7 +133,7 @@ class _Cluster:
         p = self.ctx.Process(
             target=_executor_proc,
             args=(slot, exec_id, self.driver_port, port, cmd_q,
-                  self.ack_q),
+                  self.ack_q, self.extra_conf),
             daemon=True,
         )
         p.start()
@@ -199,18 +205,32 @@ def _read_shuffle(driver, handle, maps_by_host, result):
     result["elapsed"] = time.monotonic() - t0
 
 
-def test_tcp_chaos_kill_data_channel_mid_striped_read():
+import pytest
+
+
+@pytest.mark.parametrize("async_mode,port_off", [
+    # offsets keep driver AND driver+50 executor ports inside 24xxx,
+    # clear of test_striped_transport (25100-25260) and below the
+    # kernel ephemeral range (32768+), so neither fixed-port tests nor
+    # lingering ephemeral peer connections can collide
+    ("on", 400),    # the completion-driven dispatcher loop
+    ("off", 500),   # the legacy thread-per-lane path
+])
+def test_tcp_chaos_kill_data_channel_mid_striped_read(async_mode,
+                                                      port_off):
     """Kill ONE data lane of a striped channel group while a multi-MB
-    block is mid-flight across it: the fetch must either complete
-    BIT-EXACT (the stripes raced home first) or surface a clean
-    stage-retriable FetchFailedError promptly — never hang.  Each
-    lane's _fail_outstanding covers its stripes and the group combiner
-    fans the first error to the whole fetch."""
+    block is mid-flight across it — on BOTH transport engines: the
+    fetch must either complete BIT-EXACT (the stripes raced home
+    first) or surface a clean stage-retriable FetchFailedError
+    promptly — never hang — and the engine must stay healthy for the
+    retry.  Each lane's _fail_outstanding covers its stripes and the
+    group combiner fans the first error to the whole fetch."""
     from sparkrdma_tpu.shuffle.manager import TpuShuffleManager as Mgr
 
-    driver_port = BASE_PORT + 900
+    driver_port = BASE_PORT + port_off
     conf_d = {
         "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.transportAsyncDispatcher": async_mode,
         "spark.shuffle.tpu.partitionLocationFetchTimeout": "10s",
         "spark.shuffle.tpu.connectTimeout": "5s",
         "spark.shuffle.tpu.transportNumStripes": 2,
@@ -400,6 +420,69 @@ def test_tcp_chaos_sigkill_sweep():
         # contract across the seeded schedule
         assert stats["retries"] >= 3, stats
         assert stats["exact"] >= 3, stats
+    finally:
+        cluster.stop()
+        driver.stop()
+
+
+def test_tcp_chaos_dead_peer_mid_striped_read_async():
+    """SIGKILL the serving executor PROCESS while a striped multi-MB
+    read is mid-flight, under transportAsyncDispatcher=on: the read
+    fails clean and stage-retriable (or completes exact if the bytes
+    raced home), and the reader's dispatcher loop stays healthy — a
+    freshly spawned executor serves a rewrite of the lost work
+    bit-exact over the SAME driver node."""
+    extra = {
+        "spark.shuffle.tpu.transportAsyncDispatcher": "on",
+        "spark.shuffle.tpu.transportNumStripes": 2,
+        "spark.shuffle.tpu.transportStripeThreshold": "64k",
+        "spark.shuffle.tpu.shuffleReadBlockSize": "32m",
+        "spark.shuffle.tpu.maxAggBlock": "32m",
+        "spark.shuffle.tpu.maxBytesInFlight": "64m",
+    }
+    ctx = multiprocessing.get_context("spawn")
+    driver_port = BASE_PORT + 1100
+    driver = TpuShuffleManager(
+        _conf(driver_port, extra), is_driver=True, network=TcpNetwork(),
+        port=driver_port, stage_to_device=False,
+    )
+    cluster = _Cluster(ctx, driver_port, n=1, extra_conf=extra,
+                       base_port=BASE_PORT + 1150)
+    part = HashPartitioner(NUM_PARTS)
+    try:
+        sid = 9100
+        handle = driver.register_shuffle(sid, 1, part)
+        cluster.order_write(0, sid, 1, [0])
+        cluster._await_ack("wrote", cluster.procs[0][1])
+        mbh = {cluster.smid(0): [0]}
+
+        res: dict = {}
+        t = threading.Thread(
+            target=_read_shuffle, args=(driver, handle, mbh, res),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.02)  # let the striped fetch get airborne
+        cluster.kill(0)
+        t.join(timeout=60)
+        assert not t.is_alive(), "read against SIGKILLed peer hung"
+        if "data" in res:
+            assert res["data"] == _oracle(sid, [0])
+        else:
+            assert isinstance(
+                res["error"], (FetchFailedError, MetadataFetchFailedError)
+            ), res["error"]
+            assert res["elapsed"] < 40, res["elapsed"]
+
+        # the dispatcher serves the respawned executor immediately:
+        # rewrite the lost work under a fresh shuffle id, read exact
+        cluster.spawn(0)
+        sid2 = sid + 1
+        handle2 = driver.register_shuffle(sid2, 1, part)
+        cluster.order_write(0, sid2, 1, [0])
+        res2: dict = {}
+        _read_shuffle(driver, handle2, {cluster.smid(0): [0]}, res2)
+        assert res2.get("data") == _oracle(sid2, [0]), res2.get("error")
     finally:
         cluster.stop()
         driver.stop()

@@ -28,12 +28,24 @@ Lock/RLock/Condition sites; this pass discovers every one of them in
         ``# lock-order: N`` comment on its creation line, or the rank
         argument of a ``dbg_lock``/``dbg_rlock``/``dbg_condition`` call
         (utils/dbglock.py validates the same ranks at runtime).
+  CK05  blocking on the event loop: a method marked ``# on-loop`` (it
+        runs on the async transport dispatcher's single event-loop
+        thread, transport/dispatcher.py) must never take a blocking
+        action — ``sendall``/``connect``/``create_connection``,
+        ``Thread.join``, ``Event.wait``, ``Condition.wait``,
+        ``queue.get`` (not ``get_nowait``), ``subprocess.*`` or
+        ``time.sleep`` — directly or through a same-class method call
+        (CK02's blocking analysis re-aimed at the loop's callback
+        plane).  Non-blocking socket data ops
+        (``recv``/``recv_into``/``sendmsg``/``accept``) are the loop's
+        job and stay allowed.
 
 Annotation grammar::
 
     self._lock = threading.Lock()  # lock-order: 42
     self._lock = dbg_lock("node.active", 42)        # rank from the call
     self._cache = {}  # guarded-by: _lock
+    def on_readable(self):  # on-loop
 
 Suppressions are code-scoped: ``# noqa: CK02`` silences only CK02 on
 that line; a bare ``# noqa`` silences everything (discouraged).
@@ -62,6 +74,17 @@ SOCKET_BLOCKING = {"sendall", "sendmsg", "recv", "recv_into", "accept",
 
 RANK_RE = re.compile(r"#\s*lock-order:\s*(-?\d+)")
 GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+ONLOOP_RE = re.compile(r"#\s*on-loop\b")
+
+# op tags CK02 never flags (sleep-under-lock predates the tagging;
+# waiting on one's OWN condition releases it — not a CK02 hold)
+CK02_EXCLUDED_OPS = {"sleep", "cond-wait-self"}
+# op tags that block an event loop no matter what is held; the
+# non-blocking-capable socket data ops (recv/recv_into/sendmsg/accept)
+# are exactly what on-loop code exists to call
+CK05_OPS = {"sendall", "connect", "create_connection", "subprocess",
+            "join", "queue-get", "event-wait", "cond-wait",
+            "cond-wait-self", "sleep"}
 
 # ONE noqa grammar + suppression decision for both gates: tools/lint.py
 # owns the definition (code-scoped sets, bare-noqa = everything, alias
@@ -316,8 +339,10 @@ class _FnScan(ast.NodeVisitor):
         self.cls = cls
         self.fn_name = fn_name
         self.held: List[_Held] = []
+        self.on_loop = False
         self.direct_locks: Set[LockId] = set()
-        self.direct_blocking: List[Tuple[int, str]] = []
+        # (line, message, op-tag) — op routes CK02 vs CK05 emission
+        self.direct_blocking: List[Tuple[int, str, str]] = []
         self.self_calls: List[Tuple[str, int, Tuple[LockId, ...]]] = []
         self.local_locks: Set[str] = set()
         self.local_events: Set[str] = set()
@@ -479,6 +504,12 @@ class _FnScan(ast.NodeVisitor):
                     line,
                     f"blocking socket call .{attr}() while holding "
                     f"{holder}",
+                    attr,
+                )
+                return
+            if attr == "sleep" and recv_name == "time":
+                self._blocking(
+                    line, f"time.sleep while holding {holder}", "sleep"
                 )
                 return
             if recv_name == "subprocess" or (
@@ -487,7 +518,8 @@ class _FnScan(ast.NodeVisitor):
                 and f.value.value.id == "subprocess"
             ):
                 self._blocking(
-                    line, f"subprocess call while holding {holder}"
+                    line, f"subprocess call while holding {holder}",
+                    "subprocess",
                 )
                 return
             target = recv_attr if recv_attr is not None else recv_name
@@ -502,6 +534,7 @@ class _FnScan(ast.NodeVisitor):
                     self._blocking(
                         line,
                         f"Thread.join on {target} while holding {holder}",
+                        "join",
                     )
             elif attr == "get":
                 queues = (cls.queues if cls and is_self_attr
@@ -512,6 +545,7 @@ class _FnScan(ast.NodeVisitor):
                         f"queue.get() on {target} while holding "
                         f"{holder} (use get_nowait or move it outside "
                         f"the lock)",
+                        "queue-get",
                     )
             elif attr == "wait":
                 events = (cls.events if cls and is_self_attr
@@ -520,6 +554,7 @@ class _FnScan(ast.NodeVisitor):
                     self._blocking(
                         line,
                         f"Event.wait on {target} while holding {holder}",
+                        "event-wait",
                     )
                     return
                 if cls and is_self_attr and target in cls.locks \
@@ -537,11 +572,22 @@ class _FnScan(ast.NodeVisitor):
                             f"holding {held_names} — waiting releases "
                             f"only {target}, everything else stays "
                             f"held",
+                            "cond-wait",
+                        )
+                    else:
+                        # waiting on one's own condition is fine under
+                        # a lock (it releases) but still parks the
+                        # thread — poison for on-loop code (CK05)
+                        self._blocking(
+                            line,
+                            f"Condition.wait on {target} while holding "
+                            f"{holder}",
+                            "cond-wait-self",
                         )
 
-    def _blocking(self, line: int, msg: str) -> None:
-        self.direct_blocking.append((line, msg))
-        if self.held:
+    def _blocking(self, line: int, msg: str, op: str) -> None:
+        self.direct_blocking.append((line, msg, op))
+        if self.held and op not in CK02_EXCLUDED_OPS:
             self.an.emit(self.mod.rel, line, "CK02", msg)
 
     def visit_Attribute(self, node):
@@ -571,8 +617,10 @@ class Analyzer:
         # edges: (outer, inner) -> first (rel, line) site
         self.edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
         self.held_self_calls: List[Tuple] = []
-        # (module, class, method) -> scan result
+        # (module, class-or-"", function) -> scan result
         self.fn_scans: Dict[Tuple[str, str, str], _FnScan] = {}
+        # transitive blocking sets, filled by _closure_checks
+        self._blocking_map: Dict[Tuple[str, str, str], List] = {}
         self._sups: Dict[str, _Suppressor] = {}
 
     def emit(self, rel: str, line: int, code: str, msg: str) -> None:
@@ -599,6 +647,7 @@ class Analyzer:
         for f in files:
             self._scan_functions(f)
         self._closure_checks()
+        self._onloop_checks()
         self._graph_checks()
         self.findings.sort(key=lambda x: (str(x[0]), x[1], x[2]))
         return self.findings
@@ -658,9 +707,22 @@ class Analyzer:
                                          ast.AsyncFunctionDef)):
                         self._scan_fn(mod, cls, item.name, item)
 
+    @staticmethod
+    def _fn_on_loop(mod: ModuleInfo, node) -> bool:
+        """True when the def-line span (signature lines, up to the
+        first body statement) carries an ``# on-loop`` marker."""
+        if isinstance(node, ast.Lambda) or not getattr(node, "body", None):
+            return False
+        end = max(node.lineno, node.body[0].lineno - 1)
+        for i in range(node.lineno, end + 1):
+            if i <= len(mod.lines) and ONLOOP_RE.search(mod.lines[i - 1]):
+                return True
+        return False
+
     def _scan_fn(self, mod: ModuleInfo, cls: Optional[ClassInfo],
                  name: str, node) -> None:
         scan = _FnScan(self, mod, cls, name)
+        scan.on_loop = self._fn_on_loop(mod, node)
         body = node.body if hasattr(node, "body") else [node]
         if isinstance(node, ast.Lambda):
             scan.visit(node.body)
@@ -669,6 +731,8 @@ class Analyzer:
                 scan.visit(stmt)
         if cls is not None:
             self.fn_scans[(mod.rel, cls.name, name)] = scan
+        else:
+            self.fn_scans[(mod.rel, "", name)] = scan
         # nested functions/lambdas run elsewhere: fresh held context,
         # same class scope (closures over self)
         queued = list(scan.nested)
@@ -728,7 +792,9 @@ class Analyzer:
                             )
         # CK02 through one-class call chains: a held self-call whose
         # transitive callees block
-        blocking: Dict[Tuple[str, str, str], List[Tuple[int, str]]] = {
+        blocking: Dict[
+            Tuple[str, str, str], List[Tuple[int, str, str]]
+        ] = {
             k: list(s.direct_blocking) for k, s in self.fn_scans.items()
         }
         changed = True
@@ -744,17 +810,51 @@ class Analyzer:
                             mine.append(item)
                 if len(mine) != have:
                     changed = True
+        self._blocking_map = blocking
         for rel, cls_name, callee, line, held in self.held_self_calls:
             ck = (rel, cls_name, callee)
-            items = blocking.get(ck, ())
+            items = [i for i in blocking.get(ck, ())
+                     if i[2] not in CK02_EXCLUDED_OPS]
             if items:
-                bline, bmsg = items[0]
+                bline, bmsg, _op = items[0]
                 self.emit(
                     rel, line, "CK02",
                     f"call to self.{callee}() blocks while a lock is "
                     f"held ({bmsg.split(' while holding')[0]} at line "
                     f"{bline})",
                 )
+
+    def _onloop_checks(self) -> None:
+        """CK05: ``# on-loop`` methods (dispatcher event-loop context)
+        must not block — directly or through same-class callees."""
+        for k, scan in self.fn_scans.items():
+            if not scan.on_loop:
+                continue
+            rel, cls_name, name = k
+            for line, msg, op in scan.direct_blocking:
+                if op in CK05_OPS:
+                    self.emit(
+                        rel, line, "CK05",
+                        f"{msg.split(' while holding')[0].split(' while also')[0]} "
+                        f"in on-loop code — {name}() runs on the "
+                        f"dispatcher event loop and must never block",
+                    )
+            for callee, line, _held in scan.self_calls:
+                ck = (rel, cls_name, callee)
+                callee_scan = self.fn_scans.get(ck)
+                if callee_scan is not None and callee_scan.on_loop:
+                    continue  # flagged at its own definition
+                items = [i for i in self._blocking_map.get(ck, ())
+                         if i[2] in CK05_OPS]
+                if items:
+                    bline, bmsg, _op = items[0]
+                    self.emit(
+                        rel, line, "CK05",
+                        f"call to self.{callee}() from on-loop code "
+                        f"blocks ({bmsg.split(' while holding')[0]} at "
+                        f"line {bline}) — {name}() runs on the "
+                        f"dispatcher event loop",
+                    )
 
     # -- global graph checks --------------------------------------------------
     def _graph_checks(self) -> None:
