@@ -45,6 +45,11 @@ SWEEP_SIZES = ((1 << 20,) if SMOKE
 TARGET_MOVE = (8 << 20) if SMOKE else (192 << 20)
 RPC_SAMPLES = 40 if SMOKE else 400
 
+# fabric-scale sweep (BENCH_fabric_scale.json)
+FABRIC_PEERS = (8, 32) if SMOKE else (8, 64, 256)
+FABRIC_BLOCK = 256 << 10
+FABRIC_CAP = 16
+
 # decode-pipeline sweep (BENCH_decode_pipeline.json)
 DECODE_THREADS = (0, 1, 2, 4)
 DECODE_RECORDS = 20_000 if SMOKE else 1_500_000
@@ -426,6 +431,134 @@ def async_transport_sweep():
     GLOBAL_REGISTRY.enabled = False
 
 
+def fabric_scale_sweep():
+    """Dry-run connect+fetch against {8, 64, 256} simulated peers
+    through the pooled fabric, bounded (transportMaxCachedChannels=16)
+    vs unbounded (=0, the pre-fabric behavior) — per point: sweep wall
+    time, fd/thread census, cached-channel occupancy, evictions.
+    Writes BENCH_fabric_scale.json."""
+    import threading as _th
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+    from sparkrdma_tpu.transport import TcpNetwork
+    from sparkrdma_tpu.transport.channel import FnCompletionListener
+    from sparkrdma_tpu.transport.node import Node, transport_census
+    from sparkrdma_tpu.transport.simfleet import SimPeerFleet
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    GLOBAL_REGISTRY.enabled = True
+    pattern = (np.arange(2 << 20, dtype=np.uint32) % 251).astype(np.uint8)
+    connect = TcpNetwork().connect
+    port = 47000
+    node_port = 46990
+    table = {}
+
+    def sweep(node, addresses, window=8):
+        """One striped fetch per peer, ``window`` peers in flight (the
+        reader's maxBytesInFlight shape — an unbounded burst would
+        just measure the tolerated-overflow path)."""
+        done_all = _th.Event()
+        left = [len(addresses)]
+        errs = []
+        lk = _th.Lock()
+        sem = _th.BoundedSemaphore(window)
+
+        def settle(e=None):
+            if e is not None:
+                errs.append(e)
+            sem.release()
+            with lk:
+                left[0] -= 1
+                if left[0] == 0:
+                    done_all.set()
+
+        t0 = time.perf_counter()
+        for i, peer in enumerate(addresses):
+            addr = (i * 7919) % (len(pattern) - FABRIC_BLOCK)
+            sem.acquire()
+            node.get_read_group(peer, connect).read_blocks(
+                [BlockLocation(addr, FABRIC_BLOCK, 1)],
+                FnCompletionListener(
+                    lambda blocks: settle(), lambda e: settle(e)
+                ),
+            )
+        if not done_all.wait(300):
+            raise RuntimeError("fabric sweep hung")
+        if errs:
+            raise errs[0]
+        return time.perf_counter() - t0
+
+    for n_peers in FABRIC_PEERS:
+        fleet = SimPeerFleet(n_peers, port, pattern)
+        port += n_peers + 16
+        for mode, cap in (("unbounded", 0), ("bounded", FABRIC_CAP)):
+            before = transport_census()
+            ev0 = GLOBAL_REGISTRY.counter(
+                "transport_channel_evictions_total").value
+            node = Node(("127.0.0.1", node_port), TpuShuffleConf({
+                "spark.shuffle.tpu.transportMaxCachedChannels": cap,
+                "spark.shuffle.tpu.transportLanePoolSize": 8,
+                "spark.shuffle.tpu.transportNumStripes": 2,
+                "spark.shuffle.tpu.transportStripeThreshold": "64k",
+            }))
+            node_port += 1
+            try:
+                cold = sweep(node, fleet.addresses)
+                warm = sweep(node, fleet.addresses)
+                census = transport_census()
+                with node._active_lock:
+                    cached = len(node._active)
+                point = {
+                    "cold_connect_fetch_s": round(cold, 4),
+                    "warm_fetch_s": round(warm, 4),
+                    "fetch_mb": round(
+                        n_peers * FABRIC_BLOCK / 1e6, 1),
+                    "cached_channels": cached,
+                    "evictions": GLOBAL_REGISTRY.counter(
+                        "transport_channel_evictions_total"
+                    ).value - ev0,
+                    "transport_threads_grown": (
+                        census["transport_threads"]
+                        - before["transport_threads"]),
+                    "open_fds_grown": (
+                        census["open_fds"] - before["open_fds"]
+                        if census["open_fds"] > 0
+                        and before["open_fds"] > 0 else None),
+                }
+                table.setdefault(n_peers, {})[mode] = point
+                emit(
+                    f"fabric {n_peers} peers {mode} "
+                    f"(cap={cap or 'off'}): cold connect+fetch sweep",
+                    cold, "s",
+                    1.0 if mode == "unbounded"
+                    else table[n_peers]["unbounded"][
+                        "cold_connect_fetch_s"] / cold,
+                )
+            finally:
+                node.stop()
+        fleet.close()
+    from benchmarks.common import write_bench_json
+
+    write_bench_json("fabric_scale", extra={
+        "baseline": "transportMaxCachedChannels=0 — the pre-fabric "
+                    "unbounded channel cache (every peer keeps its "
+                    "lanes forever)",
+        "block_bytes": FABRIC_BLOCK,
+        "cap": FABRIC_CAP,
+        "sweep": {str(k): v for k, v in table.items()},
+        "note": (
+            "per point: one striped 256KiB fetch per peer, cold "
+            "(connect+fetch) then warm; bounded mode holds cached "
+            "channels at the cap via LRU eviction while unbounded "
+            "grows O(peers x lanes) — the fd/thread census per point "
+            "is the scaling signal, the bounded-vs-unbounded sweep "
+            "time ratio is the (small) cost of paying reconnects"
+        ),
+    }, out_dir=SMOKE_DIR)
+    GLOBAL_REGISTRY.enabled = False
+
+
 def _decode_cluster(threads, mode_conf, base_port):
     """Driver + 2 executors on loopback with the decode-pipeline conf."""
     from collections import defaultdict
@@ -628,6 +761,8 @@ def main():
     decode_pipeline_sweep()
     RESULTS.clear()
     async_transport_sweep()
+    RESULTS.clear()
+    fabric_scale_sweep()
 
 
 if __name__ == "__main__":

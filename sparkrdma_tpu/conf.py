@@ -364,6 +364,35 @@ class TpuShuffleConf:
         )
 
     @property
+    def transport_max_cached_channels(self) -> int:
+        """Cap on the node's active-channel cache (``Node._active`` —
+        the RdmaNode channel-cache lineage, bounded): when a connect
+        would push the cache past this many live channels, the
+        idle-coldest cached channels (LRU by last use; never one with
+        in-flight ops) are evicted and their sockets closed.
+        ``get_channel`` transparently reconnects an evicted key on next
+        use, so at datacenter fan-out a node pays O(cap) sockets, not
+        O(peers × stripes) — the RDMAvisor bounded-channel design.
+        ``0`` disables the cap entirely (the pre-fabric unbounded
+        behavior, kept for A/B)."""
+        return self._int_in_range(
+            "transportMaxCachedChannels", 512, 0, 1 << 20
+        )
+
+    @property
+    def transport_lane_pool_size(self) -> int:
+        """Fixed per-node budget of borrowable data lanes: a striped
+        read borrows up to ``transportNumStripes`` lanes from this pool
+        for its duration and returns them at completion, so concurrent
+        stripe parallelism across ALL peers is bounded here instead of
+        costing ``transportNumStripes`` dedicated sockets per peer.
+        When the pool is empty a read falls back to the peer's
+        dedicated small-read lane, unstriped (correct, just narrower).
+        ``0`` disables the budget (every read stripes fully — the
+        pre-fabric behavior, kept for A/B)."""
+        return self._int_in_range("transportLanePoolSize", 32, 0, 4096)
+
+    @property
     def transport_serve_threads(self) -> int:
         """Worker threads on the node's read-serve pool (one-sided READ
         service).  Serving runs off the channel reader loops so one

@@ -215,7 +215,12 @@ class PublishMapTaskOutputMsg(RpcMsg):
     (reference: RdmaPublishMapTaskOutputRpcMsg, RdmaRpcMsg.scala:182-276).
 
     ``entries`` holds the raw 16-byte location entries for partitions
-    [first_reduce_id, last_reduce_id] inclusive.
+    [first_reduce_id, last_reduce_id] inclusive.  ``epoch`` tags which
+    publish generation of the map task's table this segment belongs to
+    (delta-sync: a republish after a location change ships only the
+    changed runs at a higher epoch, and the driver's per-entry epoch
+    guard keeps out-of-order segment application from resurrecting
+    stale locations — MapTaskOutput.put_range).
     """
 
     shuffle_manager_id: ShuffleManagerId
@@ -225,6 +230,7 @@ class PublishMapTaskOutputMsg(RpcMsg):
     first_reduce_id: int
     last_reduce_id: int
     entries: bytes
+    epoch: int = 0
 
     MSG_TYPE = 3
 
@@ -240,21 +246,22 @@ class PublishMapTaskOutputMsg(RpcMsg):
         buf = bytearray()
         self.shuffle_manager_id.write(buf)
         buf += struct.pack(
-            "<iiiii",
+            "<iiiiii",
             self.shuffle_id,
             self.map_id,
             self.total_num_partitions,
             self.first_reduce_id,
             self.last_reduce_id,
+            self.epoch,
         )
         buf += self.entries
         return bytes(buf)
 
     def _payload_size(self) -> int:
-        return self.shuffle_manager_id.serialized_length() + 20 + len(self.entries)
+        return self.shuffle_manager_id.serialized_length() + 24 + len(self.entries)
 
     def _split(self, max_payload: int) -> Sequence["PublishMapTaskOutputMsg"]:
-        fixed = self.shuffle_manager_id.serialized_length() + 20
+        fixed = self.shuffle_manager_id.serialized_length() + 24
         per_seg = max(1, (max_payload - fixed) // LOCATION_ENTRY_SIZE)
         parts: List[PublishMapTaskOutputMsg] = []
         first = self.first_reduce_id
@@ -271,6 +278,7 @@ class PublishMapTaskOutputMsg(RpcMsg):
                     first,
                     last,
                     self.entries[lo:hi],
+                    self.epoch,
                 )
             )
             first = last + 1
@@ -279,10 +287,13 @@ class PublishMapTaskOutputMsg(RpcMsg):
     @staticmethod
     def _decode_payload(view: memoryview) -> "PublishMapTaskOutputMsg":
         smid, off = ShuffleManagerId.read(view, 0)
-        shuffle_id, map_id, total, first, last = struct.unpack_from("<iiiii", view, off)
-        off += 20
+        shuffle_id, map_id, total, first, last, epoch = struct.unpack_from(
+            "<iiiiii", view, off
+        )
+        off += 24
         return PublishMapTaskOutputMsg(
-            smid, shuffle_id, map_id, total, first, last, bytes(view[off:])
+            smid, shuffle_id, map_id, total, first, last,
+            bytes(view[off:]), epoch,
         )
 
 

@@ -717,8 +717,10 @@ class BulkExchangeReader:
 
         cb_id = mgr.register_plan_callback(on_plan, on_failed)
         try:
-            mgr._send_msg(
-                mgr._driver_channel(),
+            # _send_driver_msg re-resolves once if the cached driver
+            # channel was evicted from the bounded cache between
+            # lookup and post
+            mgr._send_driver_msg(
                 FetchExchangePlanMsg(
                     mgr.local_smid, shuffle_id, cb_id, window=window
                 ),

@@ -31,6 +31,7 @@ from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.memory.arena import ArenaManager
 from sparkrdma_tpu.memory.staging import StagingPool
 from sparkrdma_tpu.metrics import (
+    counter,
     get_registry,
     write_json_snapshot,
     write_prometheus,
@@ -56,7 +57,12 @@ from sparkrdma_tpu.shuffle.partitioner import Partitioner
 from sparkrdma_tpu.shuffle.resolver import ShuffleBlockResolver
 from sparkrdma_tpu.shuffle.writer import ShuffleWriter
 from sparkrdma_tpu.stats import ShuffleReaderStats
-from sparkrdma_tpu.transport.channel import Channel, ChannelType, FnCompletionListener
+from sparkrdma_tpu.transport.channel import (
+    Channel,
+    ChannelType,
+    FnCompletionListener,
+    TransportError,
+)
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.utils.serde import (
     CompressedSerializer,
@@ -434,12 +440,41 @@ class TpuShuffleManager:
             )),
         )
 
+    def _send_via(self, addr: Tuple[str, int], channel_type: ChannelType,
+                  msg: RpcMsg, on_failure: Optional[Callable] = None,
+                  must_retry: bool = True) -> None:
+        """get_channel + send with ONE eviction-race retry: the node's
+        bounded channel cache may evict an RPC channel between the
+        cache lookup and the post (synchronous TransportError, listener
+        untouched) — the retried get_channel reconnects the evicted
+        key.  A genuinely dead peer still fails: the reconnect itself
+        raises, or the retried post's failure propagates."""
+        for attempt in (0, 1):
+            ch = self.node.get_channel(
+                addr, channel_type, self.network.connect,
+                must_retry=must_retry,
+            )
+            try:
+                self._send_msg(ch, msg, on_failure)
+                return
+            except TransportError:
+                if attempt:
+                    raise
+                counter("transport_channel_evict_races_total").inc()
+
+    def _send_driver_msg(self, msg: RpcMsg,
+                         on_failure: Optional[Callable] = None) -> None:
+        self._send_via(
+            (self.conf.driver_host, self.conf.driver_port),
+            ChannelType.RPC_REQUESTOR, msg, on_failure,
+        )
+
     def _say_hello(self) -> None:
         if self._hello_sent:
             return
         self._hello_sent = True
         msg = HelloMsg(self.local_smid, self.node.address[1])
-        self._send_msg(self._driver_channel(), msg)
+        self._send_driver_msg(msg)
 
     # -- receive dispatch ----------------------------------------------------
     def _receive(self, channel: Channel, frame: bytes) -> None:
@@ -498,17 +533,17 @@ class TpuShuffleManager:
                         self.remove_executor(smid)
                         continue
                     try:
-                        ch = self.node.get_channel(
+                        # _send_via retries once on the eviction race:
+                        # a cache-evicted (healthy) channel must not
+                        # read as a dead executor and trigger a prune
+                        self._send_via(
                             (smid.host, smid.port),
                             ChannelType.RPC_REQUESTOR,
-                            self.network.connect, must_retry=False,
-                        )
-                        self._send_msg(
-                            ch,
                             HeartbeatMsg(self.local_smid, self._hb_seq,
                                          False),
                             on_failure=lambda e, smid=smid:
                                 self._on_executor_send_failure(smid, e),
+                            must_retry=False,
                         )
                     except Exception as e:
                         self._on_executor_send_failure(smid, e)
@@ -591,11 +626,10 @@ class TpuShuffleManager:
         announce = AnnounceShuffleManagersMsg(members)
         for peer in members:
             try:
-                ch = self.node.get_channel(
+                self._send_via(
                     (peer.host, peer.port), ChannelType.RPC_REQUESTOR,
-                    self.network.connect,
+                    announce,
                 )
-                self._send_msg(ch, announce)
             except Exception:
                 logger.exception("driver: announce to %s failed", peer.host)
         # a bulk-plan barrier may be waiting on exactly this hello (a
@@ -614,9 +648,24 @@ class TpuShuffleManager:
                     self._peers.append(smid)
             peers = [p for p in self._peers if p != self.local_smid]
         # pre-connect the peer mesh in the background so the first fetch
-        # is hot (reference: RdmaShuffleManager.scala:111-118)
+        # is hot (reference: RdmaShuffleManager.scala:111-118) — but
+        # only up to the bounded cache's free room: warming past the
+        # cap would be pure connect/evict churn that also evicts
+        # genuinely hot channels (at 256-peer fan-out the mesh cannot
+        # be all-hot by definition; fetches connect lazily instead)
         def warm():
+            cap = self.node._max_cached
             for peer in peers:
+                if cap > 0:
+                    with self.node._active_lock:
+                        room = cap - len(self.node._active)
+                    if room <= 0:
+                        logger.info(
+                            "mesh pre-connect stopped at the channel-"
+                            "cache cap (%d): remaining peers connect "
+                            "lazily on first fetch", cap,
+                        )
+                        return
                 try:
                     self.node.get_channel(
                         (peer.host, peer.port), ChannelType.READ_REQUESTOR,
@@ -663,7 +712,10 @@ class TpuShuffleManager:
             msg.shuffle_id, msg.shuffle_manager_id, msg.map_id,
             msg.total_num_partitions,
         )
-        mto.put_range(msg.first_reduce_id, msg.last_reduce_id, msg.entries)
+        mto.put_range(
+            msg.first_reduce_id, msg.last_reduce_id, msg.entries,
+            epoch=msg.epoch,
+        )
         self._maybe_answer_plans(msg.shuffle_id)
 
     def _handle_fetch_status(self, msg: FetchMapStatusMsg, channel: Channel) -> None:
@@ -1355,18 +1407,54 @@ class TpuShuffleManager:
 
     def publish_map_output(
         self, shuffle_id: int, map_id: int, mto: MapTaskOutput
-    ) -> None:
-        """Executor → driver publish (RdmaWrapperShuffleWriter.scala:115-149)."""
+    ) -> Tuple[int, int, int]:
+        """Executor → driver publish (RdmaWrapperShuffleWriter.scala:115-149).
+
+        DELTA-SYNCED: only the entries changed since the table's last
+        publish ship, as epoch-tagged contiguous runs (the first
+        publish after commit is the whole table — everything is dirty).
+        A republish after relocating a few blocks therefore costs
+        O(changed) wire bytes, not O(partitions); the driver's
+        per-entry epoch guard makes out-of-order segment application
+        safe.  Returns (segments, entries, entry_bytes) published."""
         n = mto.num_partitions
-        msg = PublishMapTaskOutputMsg(
-            self.local_smid, shuffle_id, map_id, n, 0, n - 1,
-            mto.get_range_bytes(0, n - 1),
-        )
-        if self.is_driver:
-            # driver-local writer (local[*] mode): install directly
-            self._handle_publish(msg)
-        else:
-            self._send_msg(self._driver_channel(), msg)
+        epoch, runs = mto.take_delta()
+        entries = 0
+        nbytes = 0
+        for first, last, raw in runs:
+            msg = PublishMapTaskOutputMsg(
+                self.local_smid, shuffle_id, map_id, n, first, last,
+                raw, epoch,
+            )
+            if self.is_driver:
+                # driver-local writer (local[*] mode): install directly
+                self._handle_publish(msg)
+            else:
+                def requeue(e, first=first, last=last):
+                    # the dirty bits were consumed by take_delta: a
+                    # send lost AFTER the synchronous-retry window
+                    # must re-dirty its run or no later publish would
+                    # ever re-ship it (the pre-delta full publish
+                    # self-healed by always resending everything)
+                    logger.warning(
+                        "publish of shuffle %d map %d [%d,%d] failed "
+                        "(%s) — re-marked dirty for the next publish",
+                        shuffle_id, map_id, first, last, e,
+                    )
+                    mto.mark_dirty(first, last)
+
+                try:
+                    self._send_driver_msg(msg, on_failure=requeue)
+                except BaseException:
+                    mto.mark_dirty(first, last)
+                    raise
+            entries += last - first + 1
+            nbytes += len(raw)
+        if runs:
+            counter("shuffle_publish_segments_total").inc(len(runs))
+            counter("shuffle_publish_entries_total").inc(entries)
+            counter("shuffle_publish_entry_bytes_total").inc(nbytes)
+        return len(runs), entries, nbytes
 
     # -- per-shuffle telemetry (metrics/ tentpole) ---------------------------
     def record_shuffle_write(self, shuffle_id: int, wm) -> None:
@@ -1426,7 +1514,7 @@ class TpuShuffleManager:
             self._handle_shuffle_metrics(msg)
         else:
             try:
-                self._send_msg(self._driver_channel(), msg)
+                self._send_driver_msg(msg)
             except Exception:
                 logger.warning(
                     "shuffle %d telemetry publish failed", shuffle_id,

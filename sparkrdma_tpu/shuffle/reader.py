@@ -211,8 +211,11 @@ class ShuffleReader:
             )
             timer.start()
             try:
-                self.manager._send_msg(
-                    self.manager._driver_channel(), msg,
+                # _send_driver_msg retries once if the cached driver
+                # channel was evicted from the bounded cache between
+                # lookup and post (reconnects transparently)
+                self.manager._send_driver_msg(
+                    msg,
                     on_failure=lambda e, host=host: self._fail(
                         MetadataFetchFailedError(
                             host.host, self.handle.shuffle_id,
@@ -366,6 +369,13 @@ class ShuffleReader:
 
         def on_failure(err):
             settle()
+            # the peer's striped group just failed a read: drop its
+            # cached read group so the retried stage (or the next
+            # reader) rebuilds lanes from scratch instead of riding a
+            # group whose peer may be gone
+            self.manager.node.invalidate_read_group(
+                (fetch.host.host, fetch.host.port)
+            )
             self._fail(
                 FetchFailedError(
                     fetch.host.host, self.handle.shuffle_id, str(err)

@@ -176,6 +176,18 @@ class Channel:
                 listener,
             )
 
+    def in_flight(self) -> int:
+        """Operations posted but not yet completed (outstanding
+        listeners + budget-queued posts) — the refcount the node's LRU
+        channel cache consults before evicting: a channel with work in
+        flight is never torn out from under its listeners.  Both
+        engines route every op through the base-class listener
+        machinery, so this covers reads and RPC sends alike."""
+        with self._outstanding_lock:
+            n = len(self._outstanding)
+        with self._pending_lock:
+            return n + len(self._pending)
+
     def stop(self) -> None:
         """Teardown: fail every outstanding / pending listener
         (reference: RdmaChannel.java:788-869)."""

@@ -1847,6 +1847,10 @@ class AsyncTcpChannel(Channel):
         if self.state not in (ChannelState.STOPPED,):
             self._error(err)
         self._fail_outstanding(err)
+        # cache/passive/read-group cleanup, exactly like the threaded
+        # reader loop's exit path — a dead peer must not pin its cache
+        # slots until node teardown
+        self.node.on_channel_dead(self)
 
     def _loop_fail(self, err: BaseException) -> None:  # on-loop
         if self._closed:

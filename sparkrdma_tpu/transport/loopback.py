@@ -278,6 +278,15 @@ class LoopbackChannel(Channel):
             except BaseException:
                 fail(e)
 
+    def _error(self, err: BaseException) -> None:
+        # ERROR is sticky (the channel is dead for good): run the same
+        # cache/passive/read-group cleanup the TCP engines run on their
+        # teardown paths, so a partitioned/stopped loopback peer does
+        # not pin cache slots until node teardown (idempotent; no-op
+        # while the owning node is itself stopping)
+        super()._error(err)
+        self.local.on_channel_dead(self)
+
     def stop(self) -> None:
         # credit-waiting listeners are tracked in _outstanding, which
         # super().stop() fails exactly once — just drop the queue

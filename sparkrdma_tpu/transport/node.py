@@ -204,6 +204,50 @@ class _ServePool:
             t.join(timeout=2.0)
 
 
+class _LanePool:
+    """Fixed per-node budget of borrowable data lanes (the RDMAvisor /
+    fabric-lib bounded-channel idiom): a striped read borrows up to
+    ``transportNumStripes`` tokens for its duration and returns them on
+    completion, so concurrent stripe fan-out across ALL peers is capped
+    at ``transportLanePoolSize`` instead of every peer owning
+    ``transportNumStripes`` dedicated sockets.  Borrowing never blocks:
+    an empty pool means the read falls back to the peer's dedicated
+    small-read lane, unstriped (narrower, never wrong).  Size 0 is the
+    unbounded pre-fabric sentinel."""
+
+    def __init__(self, size: int):
+        self.size = max(int(size), 0)
+        self._free = self.size  # guarded-by: _lock
+        self._lock = dbg_lock("node.lane_pool", 45)
+        self._m_in_use = gauge("transport_lane_pool_in_use")
+        self._m_borrows = counter("transport_lane_borrows_total")
+        self._m_exhausted = counter("transport_lane_pool_exhausted_total")
+
+    def try_borrow(self, want: int) -> int:
+        """Take up to ``want`` lane tokens without blocking; returns
+        how many were granted (0 when the pool is dry)."""
+        if want <= 0:
+            return 0
+        if self.size == 0:
+            return want
+        with self._lock:
+            got = min(want, self._free)
+            self._free -= got
+        if got:
+            self._m_in_use.inc(got)
+            self._m_borrows.inc(got)
+        else:
+            self._m_exhausted.inc()
+        return got
+
+    def release(self, n: int) -> None:
+        if n <= 0 or self.size == 0:
+            return
+        with self._lock:
+            self._free = min(self.size, self._free + n)
+        self._m_in_use.dec(n)
+
+
 class Node:
     """One transport endpoint per process."""
 
@@ -229,6 +273,23 @@ class Node:
             Tuple[Address, ChannelType, int], Channel
         ] = {}  # guarded-by: _active_lock
         self._active_lock = dbg_lock("node.active", 42)
+        # LRU bookkeeping for the bounded channel cache: last-use
+        # sequence per key, keys evicted at least once (so a
+        # reconnect is countable), and the conf cap (0 = unbounded)
+        self._last_use: Dict[
+            Tuple[Address, ChannelType, int], int
+        ] = {}  # guarded-by: _active_lock
+        self._use_seq = 0  # guarded-by: _active_lock
+        self._evicted_keys: set = set()  # guarded-by: _active_lock
+        self._max_cached = self.conf.transport_max_cached_channels
+        # fixed borrowable data-lane budget for striped reads
+        # (transport/stripe.py borrows per read, releases on completion)
+        self.lane_pool = _LanePool(self.conf.transport_lane_pool_size)
+        self._m_cached = gauge("transport_cached_channels")
+        self._m_evictions = counter("transport_channel_evictions_total")
+        self._m_evict_refusals = counter(
+            "transport_channel_evict_refusals_total")
+        self._m_reconnects = counter("transport_channel_reconnects_total")
         # per-peer striped read groups (lazy; share the channel cache)
         self._read_groups: Dict[Address, object] = {}  # guarded-by: _read_groups_lock
         self._read_groups_lock = dbg_lock("node.read_groups", 44)
@@ -440,6 +501,16 @@ class Node:
         cached channels are replaced up to max_connection_attempts.
         ``slot`` distinguishes the parallel data lanes of a striped
         channel group — each slot is its own cached connection.
+
+        The cache is BOUNDED at ``transportMaxCachedChannels`` (0 =
+        unbounded): inserting past the cap evicts the idle-coldest
+        cached channels, and a key evicted earlier transparently
+        reconnects here (counted as a reconnect).  A caller that loses
+        the tiny race between receiving a cached channel and posting on
+        it sees a synchronous ``TransportError`` and simply calls
+        get_channel again — the evicted key is gone from the cache, so
+        the retry reconnects (transport/stripe.py and the manager's
+        control-plane send helpers do exactly that).
         """
         attempts = 0
         last_err: Optional[BaseException] = None
@@ -451,13 +522,18 @@ class Node:
                 counter("transport_connect_retries_total").inc()
             with self._active_lock:
                 ch = self._active.get(key)
-            if ch is not None and ch.is_connected():
-                return ch
+                if ch is not None and ch.is_connected():
+                    self._touch_locked(key)
+                    return ch
             try:
                 new_ch = connect(self, peer, channel_type)
             except BaseException as e:
                 last_err = e
-                time.sleep(min(0.05 * attempts, 0.5))
+                # backoff on the stop event, not time.sleep: node
+                # teardown mid-retry interrupts the wait immediately
+                # instead of blocking stop() up to 0.5s per attempt
+                if self._stopped.wait(min(0.05 * attempts, 0.5)):
+                    break
                 continue
             with self._active_lock:
                 cur = self._active.get(key)
@@ -466,28 +542,134 @@ class Node:
                 else:
                     self._active[key] = new_ch
                     winner, loser = new_ch, cur
+                self._touch_locked(key)
+                reconnected = (
+                    winner is new_ch and key in self._evicted_keys
+                )
+                if reconnected:
+                    self._evicted_keys.discard(key)
+                self._m_cached.set(len(self._active))
+            if reconnected:
+                self._m_reconnects.inc()
             if loser is not None:
                 loser.stop()
             if winner.is_connected():
+                if winner is new_ch:
+                    self._maybe_evict(keep=key)
                 return winner
             with self._active_lock:
                 if self._active.get(key) is winner:
                     del self._active[key]
+                    self._last_use.pop(key, None)
+                self._m_cached.set(len(self._active))
             # stop the dead winner: nothing else references it, and
             # skipping teardown would leak its outstanding listeners
             # and the active-channel gauge increment
             winner.stop()
             last_err = TransportError("channel died immediately after connect")
         counter("transport_connect_exhausted_total").inc()
+        # the peer is unreachable: a cached read group must not pin its
+        # lane bookkeeping (and gauge) for the node's lifetime
+        self.invalidate_read_group(peer)
         raise TransportError(
             f"{self}: could not connect to {peer} ({channel_type.name}) "
             f"after {attempts} attempts"
         ) from last_err
 
+    def _touch_locked(
+        self, key: Tuple[Address, ChannelType, int]
+    ) -> None:
+        """Record a cache use for LRU ordering — caller holds
+        ``_active_lock``."""
+        self._use_seq += 1  # noqa: CK03 - caller holds _active_lock
+        self._last_use[key] = self._use_seq  # noqa: CK03 - caller holds _active_lock
+
+    def _maybe_evict(self, keep=None) -> None:
+        """Shrink the channel cache back under the conf cap: victims
+        are the idle-coldest cached channels (LRU by last use), never
+        one with in-flight ops — the listener/descriptor machinery is
+        the refcount (``Channel.in_flight``) — and never ``keep`` (the
+        key whose channel the caller is about to hand out).  Victims
+        are stopped OUTSIDE the cache lock; a racing user that already
+        holds a victim sees a synchronous post error and re-resolves
+        through get_channel, which reconnects the evicted key."""
+        cap = self._max_cached
+        if cap <= 0:
+            return
+        victims: List[Tuple[Tuple[Address, ChannelType, int], Channel]] = []
+        with self._active_lock:
+            need = len(self._active) - cap
+            if need <= 0:
+                return
+            order = sorted(
+                self._active,
+                # the lambda runs inside this with-block (sorted is
+                # eager) — the analyzer just can't see through it
+                key=lambda k: self._last_use.get(k, 0),  # noqa: CK03
+            )
+            for k in order:
+                if need <= 0:
+                    break
+                if k == keep:
+                    continue
+                ch = self._active[k]
+                if ch.in_flight():
+                    self._m_evict_refusals.inc()
+                    continue
+                del self._active[k]
+                self._last_use.pop(k, None)
+                self._evicted_keys.add(k)
+                victims.append((k, ch))
+                need -= 1
+            live_peers = {k[0] for k in self._active}
+            self._m_cached.set(len(self._active))
+        if not victims:
+            return  # everything over cap is busy: tolerate overflow
+        self._m_evictions.inc(len(victims))
+        for _k, ch in victims:
+            try:
+                ch.stop()
+            except Exception:
+                logger.exception("evicted channel stop failed")
+        for p in {k[0] for k, _ch in victims} - live_peers:
+            # the peer's LAST cached channel left: its read group has
+            # nothing to multiplex over until a fetch recreates it
+            self.invalidate_read_group(p)
+
+    def on_channel_dead(self, channel: Channel) -> None:
+        """Death hook from the engines' channel-teardown paths (tcp
+        reader-loop failure, async loop death): drop the dead channel
+        from the caches it occupies so a dead peer does not pin cache
+        slots, passive-list entries, or a stale read group until node
+        teardown.  Idempotent and safe from any thread."""
+        if self._stopped.is_set():
+            return
+        peer: Optional[Address] = None
+        with self._active_lock:
+            for k, ch in self._active.items():
+                if ch is channel:
+                    del self._active[k]
+                    self._last_use.pop(k, None)
+                    peer = k[0]
+                    break
+            peer_live = peer is not None and any(
+                k[0] == peer for k in self._active
+            )
+            self._m_cached.set(len(self._active))
+        with self._passive_lock:
+            try:
+                self._passive.remove(channel)
+            except ValueError:
+                pass
+        if peer is not None and not peer_live:
+            self.invalidate_read_group(peer)
+
     def get_read_group(self, peer: Address, connect):
         """Get-or-create ``peer``'s striped read group (one small-read
-        lane + ``transportNumStripes`` data lanes over the channel
-        cache) — the bulk-fetch entry point for readers."""
+        lane + data lanes BORROWED per read from the node's fixed lane
+        pool, over the channel cache) — the bulk-fetch entry point for
+        readers.  Invalidated when the peer dies or its last cached
+        channel is evicted; the next fetch just recreates it."""
         with self._read_groups_lock:
             group = self._read_groups.get(peer)
             if group is None:
@@ -496,7 +678,20 @@ class Node:
                 group = self._read_groups[peer] = ReadGroup(
                     self, peer, connect
                 )
+                gauge("transport_read_groups").inc()
         return group
+
+    def invalidate_read_group(self, peer: Address) -> None:
+        """Drop ``peer``'s cached read group (dead peer / evicted
+        lanes): a group object already held by a reader keeps working —
+        it re-resolves channels through the cache per read — this only
+        stops a dead peer from pinning the cache entry and its gauge
+        for the node's lifetime."""
+        with self._read_groups_lock:
+            group = self._read_groups.pop(peer, None)
+        if group is not None:
+            gauge("transport_read_groups").dec()
+            counter("transport_read_group_invalidations_total").inc()
 
     def register_passive_channel(self, channel: Channel) -> None:
         if self._stopped.is_set():
@@ -521,6 +716,9 @@ class Node:
         with self._active_lock:
             actives = list(self._active.values())
             self._active.clear()
+            self._last_use.clear()
+            self._evicted_keys.clear()
+            self._m_cached.set(0)
         with self._passive_lock:
             passives = list(self._passive)
             self._passive.clear()
@@ -590,7 +788,10 @@ class Node:
         if serve is not None:
             serve.stop()
         with self._read_groups_lock:
+            n_groups = len(self._read_groups)
             self._read_groups.clear()
+        if n_groups:
+            gauge("transport_read_groups").dec(n_groups)
         with self._block_store_lock:
             self._block_stores.clear()
 

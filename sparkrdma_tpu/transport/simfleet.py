@@ -1,0 +1,243 @@
+"""Dry-run peer fleet: N wire-protocol peers on ONE selector thread.
+
+Scale tests and the fabric-scale bench need hundreds of fetchable peers
+without paying hundreds of real :class:`~sparkrdma_tpu.transport.node.Node`
+instances (each with its own dispatcher loop and pools — the very cost
+the bounded fabric exists to avoid paying per peer).  A
+:class:`SimPeerFleet` listens on ``n_peers`` consecutive ports and
+speaks just enough of the TCP wire protocol (transport/tcp.py framing)
+to serve the fetch path:
+
+- the 9-byte connect hello is acked (any channel type),
+- ``OP_READ_REQ`` frames are answered with ``OP_READ_RESP`` served
+  from one shared pattern buffer (``BlockLocation.address`` indexes
+  into it; ``mkey`` is ignored), so striped sub-range reads reassemble
+  bit-exactly,
+- ``OP_RPC`` frames are swallowed.
+
+Everything — all listeners and every accepted connection — runs on a
+single daemon thread with non-blocking sockets, so a 256-peer fleet
+costs one thread plus its sockets.  The node under test connects to
+``fleet.addresses[i]`` through the REAL engines (threaded or async);
+only the far side is simulated.
+"""
+
+from __future__ import annotations
+
+import logging
+import selectors
+import socket
+import threading
+from typing import List, Tuple
+
+from sparkrdma_tpu.transport import tcp as wire
+
+logger = logging.getLogger(__name__)
+
+_MAX_RX = 1 << 20
+
+
+class _Conn:
+    __slots__ = ("sock", "rx", "tx", "hello_done")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rx = bytearray()
+        self.tx = bytearray()
+        self.hello_done = False
+
+
+class SimPeerFleet:
+    """``n_peers`` fake wire-protocol peers on one selector thread."""
+
+    def __init__(self, n_peers: int, base_port: int, pattern,
+                 host: str = "127.0.0.1"):
+        self._pattern = memoryview(pattern).cast("B")
+        self.addresses: List[Tuple[str, int]] = []
+        self._sel = selectors.DefaultSelector()
+        self._listeners: List[socket.socket] = []
+        self._conns: dict = {}
+        self._stop = threading.Event()
+        # wake pipe so stop() interrupts a parked select
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        for i in range(n_peers):
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                srv.bind((host, base_port + i))
+                srv.listen(64)
+            except OSError:
+                srv.close()
+                self.close()
+                raise
+            srv.setblocking(False)
+            self._sel.register(srv, selectors.EVENT_READ, "accept")
+            self._listeners.append(srv)
+            self.addresses.append((host, base_port + i))
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="simfleet",
+        )
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+        for srv in self._listeners:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        for conn in list(self._conns.values()):
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        self._wake_r.close()
+        self._wake_w.close()
+
+    # -- event loop ----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            for key, events in self._sel.select(timeout=1.0):
+                if self._stop.is_set():
+                    return
+                if key.data == "wake":
+                    return
+                if key.data == "accept":
+                    self._accept(key.fileobj)
+                    continue
+                conn = key.data
+                try:
+                    if events & selectors.EVENT_READ:
+                        self._readable(conn)
+                    if (conn.sock in self._conns
+                            and events & selectors.EVENT_WRITE):
+                        self._flush(conn)
+                except Exception:
+                    logger.exception("simfleet connection failed")
+                    self._drop(conn)
+
+    def _accept(self, srv) -> None:
+        try:
+            sock, _addr = srv.accept()
+        except OSError:
+            return
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock)
+        self._conns[sock] = conn
+        self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _drop(self, conn: _Conn) -> None:
+        if conn.sock not in self._conns:
+            return
+        del self._conns[conn.sock]
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _readable(self, conn: _Conn) -> None:
+        try:
+            chunk = conn.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn)
+            return
+        if not chunk:
+            self._drop(conn)
+            return
+        conn.rx += chunk
+        self._process(conn)
+
+    def _process(self, conn: _Conn) -> None:
+        rx = conn.rx
+        if not conn.hello_done:
+            if len(rx) < wire._HELLO.size:
+                return
+            magic = wire._HELLO.unpack_from(rx, 0)[0]
+            del rx[:wire._HELLO.size]
+            if magic != wire._MAGIC:
+                self._drop(conn)
+                return
+            conn.hello_done = True
+            self._send(conn, b"\x01")
+        while len(rx) >= wire._HDR.size:
+            opcode, length = wire._HDR.unpack_from(rx, 0)
+            if len(rx) < wire._HDR.size + length:
+                if len(rx) > _MAX_RX + wire._HDR.size + length:
+                    self._drop(conn)
+                return
+            payload = bytes(rx[wire._HDR.size:wire._HDR.size + length])
+            del rx[:wire._HDR.size + length]
+            if opcode == wire.OP_READ_REQ:
+                self._serve_read(conn, payload)
+            # OP_RPC frames are swallowed: the fleet has no control plane
+
+    def _serve_read(self, conn: _Conn, payload: bytes) -> None:
+        req_id, count = wire._REQ_HDR.unpack_from(payload, 0)
+        parts = [wire._RESP_HDR.pack(req_id, 0)]
+        off = wire._REQ_HDR.size
+        try:
+            for _ in range(count):
+                addr, length, _mkey = wire._LOC.unpack_from(payload, off)
+                off += wire._LOC.size
+                if addr < 0 or addr + length > self._pattern.nbytes:
+                    raise ValueError(
+                        f"read [{addr},{addr + length}) outside the "
+                        f"{self._pattern.nbytes}B pattern"
+                    )
+                parts.append(wire._LEN.pack(length))
+                parts.append(self._pattern[addr:addr + length])
+        except Exception as e:
+            parts = [
+                wire._RESP_HDR.pack(req_id, 1),
+                str(e).encode("utf-8", "replace"),
+            ]
+        body = b"".join(bytes(p) for p in parts)
+        self._send(
+            conn, wire._HDR.pack(wire.OP_READ_RESP, len(body)) + body
+        )
+
+    def _send(self, conn: _Conn, data: bytes) -> None:
+        conn.tx += data
+        self._flush(conn)
+
+    def _flush(self, conn: _Conn) -> None:
+        while conn.tx:
+            try:
+                n = conn.sock.send(conn.tx)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._drop(conn)
+                return
+            del conn.tx[:n]
+        events = selectors.EVENT_READ
+        if conn.tx:
+            events |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+
+__all__ = ["SimPeerFleet"]

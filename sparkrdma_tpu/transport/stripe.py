@@ -1,4 +1,5 @@
-"""Striped multi-channel block reads: per-peer channel groups.
+"""Striped multi-channel block reads: per-peer groups over a shared
+lane pool.
 
 SparkRDMA's point-to-point perf trick was channel specialization: each
 peer pair keeps RPC channels separate from dedicated RDMA_READ
@@ -7,23 +8,36 @@ control traffic (RdmaChannel.java:41; our ``ChannelType`` mirrors the
 split but every peer previously shared ONE serialized socket per
 type).  This module extends the split with fabric-lib-style striping:
 
-- a :class:`ReadGroup` per peer owns one SMALL-read lane (slot 0) plus
-  ``transportNumStripes`` DATA lanes (slots 1..N) over the node's
-  slot-keyed channel cache;
+- a :class:`ReadGroup` per peer owns one SMALL-read lane (slot 0) and
+  BORROWS data lanes (slots 1..k) per read from the node's fixed
+  :class:`~sparkrdma_tpu.transport.node._LanePool`
+  (``transportLanePoolSize``), so concurrent stripe fan-out across all
+  peers is bounded node-wide instead of every peer owning
+  ``transportNumStripes`` dedicated sockets — idle peers cost zero
+  data-lane connections (their cached channels age out of the node's
+  LRU channel cache);
 - block reads larger than ``transportStripeThreshold`` are chunked and
-  issued round-robin across the data lanes as ordinary sub-range
+  issued round-robin across the borrowed lanes as ordinary sub-range
   one-sided reads (a stripe is just a ``BlockLocation`` at
   ``address + offset`` — the responder needs no special handling), each
   landing via ``recv_into`` DIRECTLY in its slice of one pooled
   destination row (``StagingPool.alloc_gc``) — reassembly happens in
   the kernel copy, with no intermediate buffers or joins;
 - small reads ride slot 0 whole, so metadata-sized fetches never queue
-  behind multi-MB stripes.
+  behind multi-MB stripes; when the lane pool is dry, bulk reads fall
+  back to slot 0 unstriped (narrower, never wrong).
+
+Lane channels come from the node's slot-keyed LRU channel cache, so an
+evicted lane transparently reconnects on the next read; a post that
+loses the eviction race (channel stopped between cache lookup and the
+post) re-resolves through the cache exactly once — see ``_post``.
 
 Failure contract: the first failing sub-read fails the WHOLE group
 read exactly once (each lane's ``_fail_outstanding`` covers its
 stripes; the combiner fans the first error out to the caller), so a
 dead data channel surfaces as a prompt fetch failure, never a hang.
+Borrowed lanes are returned exactly once, on the group's completion or
+first failure.
 """
 
 from __future__ import annotations
@@ -37,6 +51,7 @@ from sparkrdma_tpu.transport.channel import (
     ChannelType,
     CompletionListener,
     FnCompletionListener,
+    TransportError,
 )
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
@@ -56,13 +71,16 @@ def _alloc_row(pool, nbytes: int) -> np.ndarray:
 class _GroupRead:
     """Completion combiner for one group read: N sub-reads, one
     caller-facing listener.  First failure wins and suppresses further
-    progress reports; success fires once when every sub-read landed."""
+    progress reports; success fires once when every sub-read landed.
+    ``on_finish`` (borrowed-lane return) runs exactly once, on the
+    finished transition, before the caller's listener."""
 
     __slots__ = ("listener", "out", "rows", "on_progress", "pending",
-                 "lock", "finished")
+                 "lock", "finished", "on_finish")
 
     def __init__(self, listener: CompletionListener, out: list,
-                 rows: List[int], on_progress, pending: int):
+                 rows: List[int], on_progress, pending: int,
+                 on_finish=None):
         self.listener = listener
         self.out = out
         self.rows = rows  # indices whose out[] entry is a dest row
@@ -73,6 +91,16 @@ class _GroupRead:
         # design — a late progress report is harmless); writes stay
         # under the lock
         self.finished = False
+        self.on_finish = on_finish
+
+    def _finish(self) -> None:
+        # only the thread that made the finished transition gets here
+        cb, self.on_finish = self.on_finish, None
+        if cb is not None:
+            try:
+                cb()
+            except BaseException:
+                pass
 
     def progress(self, n: int) -> None:
         cb = self.on_progress
@@ -87,6 +115,7 @@ class _GroupRead:
             if self.pending:
                 return
             self.finished = True
+        self._finish()
         for i in self.rows:
             row = self.out[i]
             if isinstance(row, np.ndarray):
@@ -98,14 +127,16 @@ class _GroupRead:
             if self.finished:
                 return
             self.finished = True
+        self._finish()
         self.listener.on_failure(err)
 
 
 class ReadGroup:
-    """One peer's channel group: stripes bulk reads, keeps small reads
-    on their own lane.  Obtained via ``Node.get_read_group``; channels
-    come from the node's slot-keyed cache, so lane death/reconnect
-    rides the existing racy-create machinery."""
+    """One peer's channel group: stripes bulk reads over borrowed
+    lanes, keeps small reads on their own lane.  Obtained via
+    ``Node.get_read_group``; channels come from the node's slot-keyed
+    LRU cache, so lane death/eviction/reconnect rides the existing
+    racy-create machinery."""
 
     def __init__(self, node, peer, connect):
         self.node = node
@@ -119,6 +150,7 @@ class ReadGroup:
         self._m_stripes = counter("transport_stripes_total")
         self._m_stripe_bytes = counter("transport_stripe_bytes_total")
         self._m_striped_reads = counter("transport_striped_reads_total")
+        self._m_evict_races = counter("transport_channel_evict_races_total")
 
     def channel(self, slot: int = 0):
         return self.node.get_channel(
@@ -126,9 +158,30 @@ class ReadGroup:
         )
 
     def data_channels(self) -> List:
-        """The live data lanes (slots 1..N) — chaos tests reach in here
-        to kill one mid-read."""
+        """The full-width data lanes (slots 1..num_stripes) — chaos
+        tests reach in here to kill one mid-read."""
         return [self.channel(s) for s in range(1, self.num_stripes + 1)]
+
+    def _post(self, slot: int, locs, listener, dest=None,
+              on_progress=None) -> None:
+        """Post one lane's sub-read, re-resolving the channel exactly
+        once if the cached channel was evicted between the cache lookup
+        and the post (``read_blocks`` raises synchronously BEFORE
+        touching the listener, so a retry can never double-deliver)."""
+        for attempt in (0, 1):
+            ch = self.channel(slot)
+            try:
+                if dest is None and on_progress is None:
+                    ch.read_blocks(locs, listener)
+                else:
+                    ch.read_blocks(
+                        locs, listener, dest=dest, on_progress=on_progress
+                    )
+                return
+            except TransportError:
+                if attempt:
+                    raise
+                self._m_evict_races.inc()
 
     def read_blocks(
         self,
@@ -149,34 +202,67 @@ class ReadGroup:
              if loc.length > self.threshold]
             if scatter and self.num_stripes > 1 else []
         )
+        lanes_borrowed = 0
+        if striped:
+            # borrow this read's stripe width from the node-wide pool;
+            # a dry pool demotes the read to the small lane, unstriped
+            lanes_borrowed = self.node.lane_pool.try_borrow(
+                self.num_stripes
+            )
+            if lanes_borrowed == 0:
+                striped = []
         if not striped:
             if scatter and on_progress is not None:
-                ch0.read_blocks(locations, listener, on_progress=on_progress)
+                self._post(0, locations, listener, on_progress=on_progress)
             else:
-                ch0.read_blocks(locations, listener)
+                self._post(0, locations, listener)
             return
 
+        # ONE-SHOT release shared by every owner: the group state's
+        # finish transition AND the pre-state exception path below.  A
+        # plain release in both places would double-credit the pool
+        # when a caller's on_failure raises out of state.fail AFTER
+        # the finish transition already returned the tokens.
+        owed = [lanes_borrowed]
+
+        def release_lanes() -> None:
+            n, owed[0] = owed[0], 0
+            self.node.lane_pool.release(n)
+
+        try:
+            self._read_striped(
+                locations, striped, lanes_borrowed, listener, on_progress,
+                release_lanes,
+            )
+        except BaseException:
+            release_lanes()
+            raise
+
+    def _read_striped(self, locations, striped, width, listener,
+                      on_progress, release_lanes) -> None:
         striped_set = set(striped)
         small = [i for i in range(len(locations)) if i not in striped_set]
         out: list = [None] * len(locations)
-        # lane -> ([sub-locations], [dest views])
-        lanes = {s: ([], []) for s in range(1, self.num_stripes + 1)}
+        # lane -> ([sub-locations], [dest views]); slots 1..width so
+        # back-to-back reads reuse the same cached lane channels
+        lanes = {s: ([], []) for s in range(1, width + 1)}
         pool = getattr(self.node, "staging_pool", None)
         with self._rr_lock:
             rr = self._rr
             self._rr += sum(
-                self._num_chunks(locations[i].length) for i in striped
+                self._num_chunks(locations[i].length, width)
+                for i in striped
             )
         for i in striped:
             loc = locations[i]
             row = _alloc_row(pool, loc.length)
             out[i] = row
-            k = self._num_chunks(loc.length)
+            k = self._num_chunks(loc.length, width)
             base, extra = divmod(loc.length, k)
             off = 0
             for j in range(k):
                 n = base + (1 if j < extra else 0)
-                slot = 1 + (rr % self.num_stripes)
+                slot = 1 + (rr % width)
                 rr += 1
                 locs, dests = lanes[slot]
                 locs.append(BlockLocation(loc.address + off, n, loc.mkey))
@@ -190,6 +276,7 @@ class ReadGroup:
         state = _GroupRead(
             listener, out, striped, on_progress,
             pending=len(live_lanes) + (1 if small else 0),
+            on_finish=release_lanes,
         )
 
         def lane_listener():
@@ -204,25 +291,26 @@ class ReadGroup:
 
         try:
             if small:
-                self.channel(0).read_blocks(
-                    [locations[i] for i in small],
+                self._post(
+                    0, [locations[i] for i in small],
                     FnCompletionListener(small_done, state.fail),
                     on_progress=state.progress,
                 )
             for s in live_lanes:
                 locs, dests = lanes[s]
-                self.channel(s).read_blocks(
-                    locs, lane_listener(), dest=dests,
+                self._post(
+                    s, locs, lane_listener(), dest=dests,
                     on_progress=state.progress,
                 )
         except BaseException as e:
             state.fail(e)
 
-    def _num_chunks(self, length: int) -> int:
-        """Stripes for one block: every chunk stays above half the
-        threshold so tiny tail chunks never pay a full round trip."""
+    def _num_chunks(self, length: int, width: int) -> int:
+        """Stripes for one block across ``width`` borrowed lanes: every
+        chunk stays above half the threshold so tiny tail chunks never
+        pay a full round trip."""
         min_chunk = max(self.threshold // 2, 1)
-        return max(1, min(self.num_stripes, length // min_chunk))
+        return max(1, min(width, length // min_chunk))
 
 
 __all__ = ["ReadGroup"]

@@ -357,6 +357,17 @@ class TcpChannel(Channel):
             if self.state not in (ChannelState.STOPPED,):
                 self._error(e)
                 self._fail_outstanding(e)
+                # a peer-initiated close (e.g. the requester evicting
+                # its end) must not leak THIS end's fd until node
+                # teardown: the reader thread is the socket's only
+                # consumer, so it owns the close on its way out
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+            # and a dead channel must not pin cache slots, the passive
+            # list, or a stale read group for the node's lifetime
+            self.node.on_channel_dead(self)
         finally:
             g.dec()
 

@@ -36,6 +36,225 @@ def test_dryrun_multichip_16_devices():
     assert f"DRYRUN{n} OK" in proc.stdout, proc.stdout
 
 
+def test_fabric_256_peers_bounded_by_conf_on_both_engines():
+    """The pooled-fabric acceptance (ROADMAP item 1 / RDMAvisor
+    direction): ONE node fetches striped blocks from 256+ simulated
+    peers through the bounded fabric — fds, transport threads, and
+    cached channels must all stay bounded by CONF (cache cap / lane
+    pool / O(1) dispatcher), not O(peers × stripes), on BOTH transport
+    engines, with payloads bit-exact through the eviction churn."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+    from sparkrdma_tpu.transport import TcpNetwork
+    from sparkrdma_tpu.transport.channel import FnCompletionListener
+    from sparkrdma_tpu.transport.node import Node, transport_census
+    from sparkrdma_tpu.transport.simfleet import SimPeerFleet
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    n_peers = int(os.environ.get("SPARKRDMA_FABRIC_PEERS", "256"))
+    cap = 8
+    pattern = (np.arange(2 << 20, dtype=np.uint32) % 251).astype(np.uint8)
+    prev_metrics = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+
+    def read_one(node, connect, peer, loc, timeout=60):
+        done = threading.Event()
+        res = {}
+        node.get_read_group(peer, connect).read_blocks(
+            [loc],
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("ok", blocks), done.set()),
+                lambda e: (res.setdefault("error", e), done.set()),
+            ),
+        )
+        assert done.wait(timeout), f"fetch from {peer} hung"
+        assert "ok" in res, res.get("error")
+        got = res["ok"][0]
+        got = got if isinstance(got, np.ndarray) else np.frombuffer(
+            memoryview(got), np.uint8)
+        assert np.array_equal(
+            got, pattern[loc.address:loc.address + loc.length]
+        ), f"corrupt payload from {peer}"
+
+    try:
+        for engine, fleet_base, node_port in (
+            ("off", 28000, 28990),
+            ("on", 28000 + n_peers + 16, 28991),
+        ):
+            # settle threads left by the previous engine's teardown
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                before = transport_census()
+                if before["by_role"].get("tcp", 0) == 0:
+                    break
+                time.sleep(0.05)
+            fleet = SimPeerFleet(n_peers, fleet_base, pattern)
+            conf = TpuShuffleConf({
+                "spark.shuffle.tpu.transportAsyncDispatcher": engine,
+                "spark.shuffle.tpu.transportMaxCachedChannels": cap,
+                "spark.shuffle.tpu.transportLanePoolSize": 4,
+                "spark.shuffle.tpu.transportNumStripes": 2,
+                "spark.shuffle.tpu.transportStripeThreshold": "64k",
+            })
+            node = Node(("127.0.0.1", node_port), conf)
+            connect = TcpNetwork().connect
+            try:
+                ev0 = GLOBAL_REGISTRY.counter(
+                    "transport_channel_evictions_total").value
+                for i, peer in enumerate(fleet.addresses):
+                    addr = (i * 7919) % (len(pattern) - 300_000)
+                    read_one(node, connect,
+                             peer, BlockLocation(addr, 300_000, 1))
+                # reconnect an early (long-evicted) peer: transparent
+                read_one(node, connect, fleet.addresses[0],
+                         BlockLocation(5, 200_000, 1))
+                with node._active_lock:
+                    cached = len(node._active)
+                assert cached <= cap, (engine, cached)
+                assert GLOBAL_REGISTRY.counter(
+                    "transport_channel_evictions_total").value > ev0
+                # read groups don't accumulate per peer either: only
+                # peers with live cached channels keep one
+                assert len(node._read_groups) <= cap, (
+                    engine, len(node._read_groups))
+                # census ceilings: threads/fds bounded by conf, not by
+                # n_peers × stripes.  Evicted channels' reader threads
+                # (threaded engine) and fleet-side sockets drain
+                # asynchronously — poll to the bound.
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    census = transport_census()
+                    grown_threads = (census["transport_threads"]
+                                     - before["transport_threads"])
+                    grown_fds = census["open_fds"] - before["open_fds"]
+                    # threaded: ≤ cap readers + serve + completion
+                    # pool; async: 1 loop + pools
+                    if (grown_threads <= cap + 8
+                            and (before["open_fds"] < 0
+                                 or grown_fds <= n_peers + 4 * cap + 32)):
+                        break
+                    time.sleep(0.1)
+                assert grown_threads <= cap + 8, (
+                    engine, before, census)
+                if before["open_fds"] > 0 and census["open_fds"] > 0:
+                    # n_peers listener fds belong to the fleet; the
+                    # node's own sockets are bounded by the cache cap
+                    # (requester + fleet-accepted end per channel)
+                    assert grown_fds <= n_peers + 4 * cap + 32, (
+                        engine, before, census)
+                if engine == "on":
+                    assert census["by_role"].get("disp", 0) == \
+                        before["by_role"].get("disp", 0) + 1, census
+            finally:
+                node.stop()
+                fleet.close()
+    finally:
+        GLOBAL_REGISTRY.enabled = prev_metrics
+
+
+def test_delta_sync_republish_bytes_scale_with_change():
+    """Delta-synced block locations: after the initial full publish, a
+    republish following a few relocations ships O(changed) entry
+    bytes, not O(partitions) — and the driver's table reflects the new
+    locations despite segment reordering hazards (epoch guard)."""
+    import time
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+    from sparkrdma_tpu.rpc.messages import PublishMapTaskOutputMsg
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+    from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+    from sparkrdma_tpu.transport import LoopbackNetwork
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    num_parts = 4096
+    changed = 5
+    prev_metrics = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    net = LoopbackNetwork()
+    conf_d = {"spark.shuffle.tpu.driverPort": 28900}
+    driver = TpuShuffleManager(
+        TpuShuffleConf(dict(conf_d)), is_driver=True, network=net,
+        port=28900, stage_to_device=False,
+    )
+    ex = TpuShuffleManager(
+        TpuShuffleConf(dict(conf_d)), is_driver=False, network=net,
+        port=28910, executor_id="0", stage_to_device=False,
+    )
+    try:
+        driver.register_shuffle(77, 1, HashPartitioner(num_parts))
+        mto = MapTaskOutput(num_parts)
+        for p in range(num_parts):
+            mto.put(p, BlockLocation(p * 64, 64, 5))
+        c_bytes = GLOBAL_REGISTRY.counter(
+            "shuffle_publish_entry_bytes_total")
+        b0 = c_bytes.value
+        segs, entries, nbytes = ex.publish_map_output(77, 0, mto)
+        assert entries == num_parts
+        assert nbytes == num_parts * 16
+        assert c_bytes.value - b0 == nbytes
+
+        def driver_mto():
+            with driver._outputs_lock:
+                by_host = driver._outputs.get(77, {})
+                for by_map in by_host.values():
+                    if 0 in by_map:
+                        return by_map[0]
+            return None
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            d_mto = driver_mto()
+            if d_mto is not None and d_mto.is_complete:
+                break
+            time.sleep(0.02)
+        assert d_mto is not None and d_mto.is_complete, (
+            "full publish never completed on the driver")
+
+        # relocate a few blocks and republish: the wire cost is the
+        # changed entries, NOT another full table
+        moved = [7, 8, 9, 1000, 4000][:changed]
+        for p in moved:
+            mto.put(p, BlockLocation(1 << 20 | p, 128, 6))
+        b1 = c_bytes.value
+        segs, entries, nbytes = ex.publish_map_output(77, 0, mto)
+        assert entries == changed
+        assert nbytes == changed * 16
+        assert nbytes < num_parts * 16 // 100, (
+            "republish bytes did not scale with changed locations")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if d_mto.get_location(moved[-1]).length == 128:
+                break
+            time.sleep(0.02)
+        for p in moved:
+            assert d_mto.get_location(p) == BlockLocation(1 << 20 | p,
+                                                          128, 6)
+        # a straggling duplicate of the ORIGINAL full publish (epoch 0)
+        # must not clobber the relocated entries on the driver
+        orig = MapTaskOutput(num_parts)
+        for p in range(num_parts):
+            orig.put(p, BlockLocation(p * 64, 64, 5))
+        stale = PublishMapTaskOutputMsg(
+            ex.local_smid, 77, 0, num_parts, 0, num_parts - 1,
+            orig.get_range_bytes(0, num_parts - 1), 0,
+        )
+        driver._handle_publish(stale)
+        for p in moved:
+            assert d_mto.get_location(p) == BlockLocation(1 << 20 | p,
+                                                          128, 6)
+    finally:
+        ex.stop()
+        driver.stop()
+        GLOBAL_REGISTRY.enabled = prev_metrics
+
+
 def test_async_dispatcher_bounded_threads_fds_at_high_peer_count():
     """Groundwork for the RDMAvisor-scale fabric (ROADMAP item 1): one
     node under transportAsyncDispatcher=on serves MANY simulated peers

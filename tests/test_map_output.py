@@ -71,3 +71,59 @@ def test_duplicate_fills_do_not_fake_completion():
     mto.put(1, BlockLocation(1, 1, 1))
     mto.put(2, BlockLocation(2, 1, 1))
     assert mto.is_complete
+
+
+def test_take_delta_first_publish_is_whole_table():
+    mto = MapTaskOutput(16)
+    for p in range(16):
+        mto.put(p, BlockLocation(p * 100, p + 1, 7))
+    epoch, runs = mto.take_delta()
+    assert epoch == 0
+    assert runs == [(0, 15, mto.get_range_bytes(0, 15))]
+    # nothing changed since: the next delta is empty and the epoch
+    # does not advance
+    assert mto.take_delta() == (1, [])
+    assert mto.take_delta() == (1, [])
+
+
+def test_take_delta_returns_only_changed_runs():
+    mto = MapTaskOutput(64)
+    for p in range(64):
+        mto.put(p, BlockLocation(p * 100, p + 1, 7))
+    mto.take_delta()  # publish 0: everything
+    # relocate two disjoint runs
+    mto.put(5, BlockLocation(9999, 6, 8))
+    mto.put(6, BlockLocation(10005, 7, 8))
+    mto.put(40, BlockLocation(20000, 41, 8))
+    epoch, runs = mto.take_delta()
+    assert epoch == 1
+    assert [(f, l) for f, l, _raw in runs] == [(5, 6), (40, 40)]
+    assert sum(len(raw) for _f, _l, raw in runs) == 3 * LOCATION_ENTRY_SIZE
+    assert runs[0][2] == mto.get_range_bytes(5, 6)
+
+
+def test_put_range_epoch_guard_rejects_stale_segments():
+    """Segments of different publish generations may apply out of
+    order (the receive dispatcher is a pool): a stale full-range
+    epoch-0 segment must not clobber entries a later epoch-1 delta
+    already installed."""
+    src = MapTaskOutput(8)
+    for p in range(8):
+        src.put(p, BlockLocation(p * 100, p + 1, 9))
+    stale_full = src.get_range_bytes(0, 7)
+    src.put(3, BlockLocation(7777, 4, 10))  # the relocation
+    fresh_delta = src.get_range_bytes(3, 3)
+
+    dst = MapTaskOutput(8)
+    dst.put_range(3, 3, fresh_delta, epoch=1)   # delta lands FIRST
+    dst.put_range(0, 7, stale_full, epoch=0)    # stale full publish
+    assert dst.is_complete
+    assert dst.get_location(3) == BlockLocation(7777, 4, 10)
+    for p in (0, 1, 2, 4, 5, 6, 7):
+        assert dst.get_location(p) == BlockLocation(p * 100, p + 1, 9)
+    # in-order application converges to the same table
+    dst2 = MapTaskOutput(8)
+    dst2.put_range(0, 7, stale_full, epoch=0)
+    dst2.put_range(3, 3, fresh_delta, epoch=1)
+    for p in range(8):
+        assert dst2.get_location(p) == dst.get_location(p)
