@@ -27,11 +27,14 @@ bench: native
 	python bench.py
 
 # tier-2 sanity gate: the reduce-loopback bench (record plane, striped
-# fetch, decode pipeline) in a tiny config — same code paths, seconds
-# not minutes, JSON written to /tmp so committed results stay intact
+# fetch, decode pipeline) plus the out-of-core tier sweep, in tiny
+# configs — same code paths, seconds not minutes, JSON written to /tmp
+# so committed results stay intact
 bench-smoke:
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_reduce_loopback.py
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	python benchmarks/bench_terasort.py --out-of-core
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

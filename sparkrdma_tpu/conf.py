@@ -235,6 +235,50 @@ class TpuShuffleConf:
         hatch.  0 disables (all commits stay in memory/HBM)."""
         return self._bytes_in_range("fileBackedCommitBytes", 0, 0, 1 << 44)
 
+    # -- memory tiering / out-of-core prefetch (memory/tier.py) -------------
+    @property
+    def tier_hot_bytes(self) -> int:
+        """Byte budget of the tiered block store's HOT tier: promoted
+        blocks of file-backed map outputs live in pooled staging rows
+        up to this total; promotion past it demotes the LRU unpinned
+        blocks back to their cold (on-disk) tier.  The serve path never
+        fails on a full hot tier — a block that cannot be promoted is
+        served straight from disk.  0 = unbounded (every touched block
+        stays hot — the pre-tier behavior for working sets that fit)."""
+        return self._bytes_in_range("tierHotBytes", 256 << 20, 0, 1 << 44)
+
+    @property
+    def tier_prefetch(self) -> bool:
+        """Predictive promotion into the hot tier: serve-side
+        sequential readahead plus reader-sent PrefetchHintMsg warming
+        (the RdmaMappedFile ODP-prefetch sweep, RdmaMappedFile.java:
+        158-168, re-aimed at the disk tier).  ``off`` keeps the tier a
+        plain demand cache — every cold block pays its disk read on
+        the serve path (the A/B the out-of-core bench measures).
+        Default: enabled on multi-core hosts; on a single core the
+        warm work only timeslices against the serves it is meant to
+        hide (measured net-negative there — the ``decodeThreads`` /
+        ``bulkPipelineWindows`` single-core-fallback precedent).  An
+        explicit setting always wins."""
+        return self._bool("tierPrefetch", (os.cpu_count() or 1) > 1)
+
+    @property
+    def tier_prefetch_blocks(self) -> int:
+        """Serve-side readahead depth: a (promoting) read of block i
+        schedules async promotion of blocks i+1..i+this of the same
+        map output through the serve pool — the request-stream signal
+        (shuffle reads are near-sequential per segment)."""
+        return self._int_in_range("tierPrefetchBlocks", 2, 0, 64)
+
+    @property
+    def tier_hint_blocks(self) -> int:
+        """Reader-side prefetch-hint depth: before issuing a grouped
+        fetch, the reader sends the serving peer a PrefetchHintMsg
+        listing up to this many upcoming block locations from its
+        fetch plan, so the responder warms them through its serve-pool
+        credits before the read RPCs arrive.  0 disables hints."""
+        return self._int_in_range("tierHintBlocks", 16, 0, 4096)
+
     # -- transport striping / scatter-gather / read serving -----------------
     @property
     def transport_num_stripes(self) -> int:

@@ -264,6 +264,26 @@ class ArenaManager(BlockStore):
         self._m_bytes.inc(nbytes)
         return seg
 
+    def register_external(self, seg):
+        """Register a segment whose storage this arena does NOT manage
+        (the tiered block store's file-backed segments, memory/tier.py):
+        assigns the mkey, tracks the bytes in the ``file_bytes`` stat
+        (never the arena byte budget — the data lives on disk / in
+        pooled hot rows the tier itself budgets), and dispatches reads
+        to the segment like any other.  ``seg`` must duck-type
+        DeviceSegment (nbytes / shuffle_id / budgeted=False /
+        read / read_many / _release_keepalive)."""
+        with self._lock:
+            mkey = self._next_mkey
+            self._next_mkey += 1
+            seg.mkey = mkey
+            self._segments[mkey] = seg
+            self._file_bytes += seg.nbytes
+            self._registered_ever += 1
+        self._m_registered.inc()
+        self._m_bytes.inc(seg.nbytes)
+        return seg
+
     def register_arena_span(self, span, shuffle_id: Optional[int] = None
                             ) -> ArenaSpanSegment:
         """Register an allocated device-arena span as a readable

@@ -76,7 +76,7 @@ class MappedFile:
 
     def __init__(self, chunks, directory: Optional[str] = None,
                  prefix: str = "sparkrdma_tpu_shuffle_",
-                 direct_write: bool = True):
+                 direct_write: bool = True, defer_map: bool = False):
         if isinstance(chunks, (bytes, bytearray, memoryview)):
             chunks = (chunks,)
         directory = directory or tempfile.gettempdir()
@@ -84,7 +84,15 @@ class MappedFile:
         fd, self.path = tempfile.mkstemp(prefix=prefix, dir=directory)
         try:
             total = self._write_chunks(fd, chunks, directory, direct_write)
-            self._map(total)
+            if defer_map:
+                # tiered commits (memory/tier.py) defer the read-only
+                # mapping until a span is actually resolved/prefetched:
+                # an output whose partitions are never read costs the
+                # data file alone, no VMA and no faulted pages
+                self.array = None
+                self._length = total
+            else:
+                self._map(total)
         except BaseException:
             self._unlink()
             raise
@@ -185,7 +193,8 @@ class MappedFile:
         return arr
 
     @classmethod
-    def from_path(cls, path: str, length: int) -> "MappedFile":
+    def from_path(cls, path: str, length: int,
+                  defer_map: bool = False) -> "MappedFile":
         """Adopt an EXISTING data file (e.g. a per-partition spill file
         written through the O_DIRECT appender) as a registered mapped
         segment — the zero-copy commit: spilled bytes are never
@@ -194,12 +203,30 @@ class MappedFile:
         mf = cls.__new__(cls)
         mf.path = path
         try:
-            mf._map(length)
+            if defer_map:
+                mf.array = None
+                mf._length = length
+            else:
+                mf._map(length)
         except BaseException:
             mf._unlink()
             raise
         mf._freed = False
         return mf
+
+    def ensure_mapped(self) -> np.ndarray:
+        """Create the deferred read-only mapping on first use (the
+        per-span registration step of the tiered store's cold reads
+        when O_DIRECT preads are unavailable).  Racy-create is benign:
+        two mappers of the same file both get valid views; one VMA
+        wins the attribute slot.  Returns the mapped uint8 array."""
+        arr = self.array
+        if arr is None:
+            if self._freed:
+                raise ValueError(f"mapped file {self.path} already freed")
+            self._map(self._length)
+            arr = self.array
+        return arr
 
     def _map(self, length: int) -> None:
         """Shared read-only mapping setup (serves get_local_block /

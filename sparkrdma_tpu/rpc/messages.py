@@ -33,6 +33,7 @@ type  class                                  direction
  8    FetchExchangePlanMsg                   executor → driver
  9    ExchangePlanMsg                        driver → executor
  10   PublishShuffleMetricsMsg               executor → driver
+ 11   PrefetchHintMsg                        reader → serving executor
 ====  =====================================  ===========================
 
 Types 8-9 carry the BULK-SYNCHRONOUS collective shuffle plan: after the
@@ -583,6 +584,57 @@ class PublishShuffleMetricsMsg(RpcMsg):
 
 
 @dataclass(frozen=True)
+class PrefetchHintMsg(RpcMsg):
+    """Reader → serving peer: the next block locations this reader's
+    fetch plan will request, so the responder's tiered block store
+    (memory/tier.py) can promote them from disk through its serve-pool
+    credits BEFORE the read RPCs arrive — the reader-side half of the
+    RdmaMappedFile ODP-prefetch sweep (RdmaMappedFile.java:158-168),
+    inverted: the requester knows the plan, the responder owns the
+    residency.  Purely advisory: a dropped/failed hint costs nothing
+    but the hidden disk latency, and unknown mkeys are ignored."""
+
+    shuffle_id: int
+    locations: Tuple[BlockLocation, ...]
+
+    MSG_TYPE = 11
+
+    def __init__(self, shuffle_id: int, locations):
+        object.__setattr__(self, "shuffle_id", shuffle_id)
+        object.__setattr__(self, "locations", tuple(locations))
+
+    def _payload(self) -> bytes:
+        buf = bytearray(
+            struct.pack("<ii", self.shuffle_id, len(self.locations))
+        )
+        for loc in self.locations:
+            loc.write(buf)
+        return bytes(buf)
+
+    def _payload_size(self) -> int:
+        return 8 + LOCATION_ENTRY_SIZE * len(self.locations)
+
+    def _split(self, max_payload: int) -> Sequence["PrefetchHintMsg"]:
+        per_seg = max(1, (max_payload - 8) // LOCATION_ENTRY_SIZE)
+        return [
+            PrefetchHintMsg(
+                self.shuffle_id, self.locations[i : i + per_seg]
+            )
+            for i in range(0, len(self.locations), per_seg)
+        ]
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "PrefetchHintMsg":
+        shuffle_id, n = struct.unpack_from("<ii", view, 0)
+        off = 8
+        locs = []
+        for _ in range(n):
+            locs.append(BlockLocation.read(view, off))
+            off += LOCATION_ENTRY_SIZE
+        return PrefetchHintMsg(shuffle_id, locs)
+
+
+@dataclass(frozen=True)
 class ExchangePlanMsg(RpcMsg):
     """The driver's bulk-exchange plan: the canonical host order, the
     full (src × dst) stream-length matrix every host must agree on, and
@@ -689,5 +741,6 @@ MSG_TYPES: Dict[int, Type[RpcMsg]] = {
         FetchExchangePlanMsg,
         ExchangePlanMsg,
         PublishShuffleMetricsMsg,
+        PrefetchHintMsg,
     )
 }

@@ -47,6 +47,7 @@ from sparkrdma_tpu.rpc.messages import (
     FetchMapStatusResponseMsg,
     HeartbeatMsg,
     HelloMsg,
+    PrefetchHintMsg,
     PublishMapTaskOutputMsg,
     PublishShuffleMetricsMsg,
     RpcMsg,
@@ -306,6 +307,20 @@ class TpuShuffleManager:
                 args=(conf.max_agg_prealloc, conf.max_agg_block),
                 daemon=True,
             ).start()
+        # tiered residency for file-backed commits (memory/tier.py):
+        # hot blocks in budgeted pooled rows, cold blocks on disk with
+        # prefetch promotion riding the node's serve-pool credits
+        from sparkrdma_tpu.memory.tier import TieredBlockStore
+
+        self.tier_store = TieredBlockStore(
+            staging_pool=self.staging_pool,
+            hot_bytes=conf.tier_hot_bytes,
+            prefetch_blocks=(
+                conf.tier_prefetch_blocks if conf.tier_prefetch else 0
+            ),
+            submitter=self.node.submit_serve,
+        )
+        self.node.tier_store = self.tier_store
         self.resolver = ShuffleBlockResolver(
             self.arena, self.node,
             stage_to_device=stage_to_device and not conf.lazy_staging,
@@ -315,6 +330,7 @@ class TpuShuffleManager:
             lazy_staging=conf.lazy_staging,
             write_block_size=conf.shuffle_write_block_size,
             direct_io=conf.direct_io,
+            tier_store=self.tier_store,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)
@@ -503,6 +519,8 @@ class TpuShuffleManager:
             self._handle_exchange_plan(msg)
         elif isinstance(msg, PublishShuffleMetricsMsg):
             self._handle_shuffle_metrics(msg)
+        elif isinstance(msg, PrefetchHintMsg):
+            self._handle_prefetch_hint(msg)
 
     # -- heartbeat / failure detection ---------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -1272,6 +1290,38 @@ class TpuShuffleManager:
         except Exception:
             logger.exception("plan failure reply failed")
 
+    # -- prefetch hints (memory/tier.py) -------------------------------------
+    def _handle_prefetch_hint(self, msg: PrefetchHintMsg) -> None:
+        """A reader announced the blocks it is about to request: warm
+        them through the serve pool so the disk reads finish before
+        the read RPCs arrive.  Advisory — any failure is swallowed."""
+        try:
+            n = self.node.warm_blocks(msg.locations)
+        except Exception:
+            logger.warning("prefetch hint handling failed", exc_info=True)
+            return
+        if n:
+            counter("tier_hint_blocks_total").inc(n)
+
+    def send_prefetch_hint(self, host: ShuffleManagerId, shuffle_id: int,
+                           locations) -> None:
+        """Reader-side: ship the next-N fetch-plan locations to the
+        peer that will serve them (local hints short-circuit to our
+        own node).  Best-effort — a hint must never fail a fetch."""
+        msg = PrefetchHintMsg(shuffle_id, locations)
+        counter("tier_hint_msgs_total").inc()
+        if host == self.local_smid:
+            self._handle_prefetch_hint(msg)
+            return
+        try:
+            self._send_via(
+                (host.host, host.port), ChannelType.RPC_REQUESTOR, msg,
+                must_retry=False,
+            )
+        except Exception:
+            logger.debug("prefetch hint to %s dropped", host.host,
+                         exc_info=True)
+
     # -- executor handlers ---------------------------------------------------
     def _handle_fetch_response(self, msg: FetchMapStatusResponseMsg) -> None:
         with self._callbacks_lock:
@@ -1730,6 +1780,7 @@ class TpuShuffleManager:
                 tracer.enabled = False
                 tracer.clear()
         logger.info("staging pool at stop: %s", self.staging_pool.stats())
+        logger.info("tier store at stop: %s", self.tier_store.stats())
         with self._decode_lock:
             decode_pool, self._decode_pool = self._decode_pool, None
         if decode_pool is not None:
@@ -1740,4 +1791,7 @@ class TpuShuffleManager:
         self.node.stop()
         self.network.unregister(self.node)
         self.arena.stop()
+        # entries normally drain via segment release above; sweep any
+        # stragglers (adoption racing teardown) before the pool closes
+        self.tier_store.stop()
         self.staging_pool.close()

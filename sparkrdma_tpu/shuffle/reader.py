@@ -139,6 +139,14 @@ class ShuffleReader:
         # hosts whose locations are unresolved
         self._awaiting_hosts = 0  # guarded-by: _pending_lock
         self._failed: Optional[FetchFailedError] = None
+        # (host, mkey, address) triples already hinted to their serving
+        # peer — each upcoming block is announced at most once, and the
+        # key MUST carry the host: every executor's arena numbers mkeys
+        # from 1 and symmetric outputs land at identical offsets, so a
+        # host-less key would collide across peers and silently
+        # suppress their hints (memory/tier.py prefetch;
+        # guarded-by: _pending_lock)
+        self._hinted: set = set()
         self._timers: List[threading.Timer] = []
         self._callback_ids: List[int] = []
         self._metrics_flushed = False
@@ -291,6 +299,10 @@ class ShuffleReader:
             self._outstanding_blocks += nonempty
             self._pending.extend(new_fetches)
             self._awaiting_hosts -= 1
+        # announce the head of this host's fetch plan before the first
+        # read is even issued — the responder's tier warms those blocks
+        # off disk while the RPCs are still in flight
+        self._send_hint(host)
         # deliver a wake-up marker even if everything was empty so the
         # consumer can re-check its termination condition
         self._results.put(_Result(blocks=[], host=host))
@@ -314,7 +326,47 @@ class ShuffleReader:
                 self._bytes_in_flight += fetch.total_bytes
             self._issue(fetch)
 
+    def _send_hint(self, host: ShuffleManagerId) -> None:
+        """Announce the next blocks of THIS host's fetch plan so its
+        tiered store can warm them off disk before the read RPCs land
+        (PrefetchHintMsg — the reader knows its whole plan, the
+        responder owns the residency).  Each block is hinted once;
+        hints are advisory and never fail the fetch."""
+        conf = self.manager.conf
+        n = conf.tier_hint_blocks
+        if n <= 0 or not conf.tier_prefetch:
+            return
+        # bounded scan: _pending is plan-ordered and shrinks as fetches
+        # issue, so the next unhinted blocks live near its head — give
+        # up after examining a few hint-windows' worth rather than
+        # sweeping the whole remaining plan under the hot-path lock on
+        # every issue (the window advances as the head drains)
+        scan_budget = 4 * n
+        with self._pending_lock:
+            fresh: List[BlockLocation] = []
+            for pf in self._pending:
+                if pf.host != host:
+                    continue
+                for loc in pf.locations:
+                    scan_budget -= 1
+                    key = (host, loc.mkey, loc.address)
+                    if key not in self._hinted:
+                        self._hinted.add(key)
+                        fresh.append(loc)
+                    if len(fresh) >= n or scan_budget <= 0:
+                        break
+                if len(fresh) >= n or scan_budget <= 0:
+                    break
+        if fresh:
+            self.manager.send_prefetch_hint(
+                host, self.handle.shuffle_id, fresh
+            )
+
     def _issue(self, fetch: _PendingFetch) -> None:
+        # warm the blocks we will ask for NEXT while this fetch is on
+        # the wire — the disk reads overlap the transfer instead of
+        # serializing behind it
+        self._send_hint(fetch.host)
         t0 = time.monotonic()
         progressed = [0]
         settled = [False]

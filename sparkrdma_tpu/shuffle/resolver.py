@@ -75,10 +75,16 @@ class ShuffleBlockResolver:
                  spill_dir: Optional[str] = None,
                  lazy_staging: bool = False,
                  write_block_size: int = 8 << 20,
-                 direct_io: str = "auto"):
+                 direct_io: str = "auto",
+                 tier_store=None):
         self.arena = arena
         self.node = node
         self.stage_to_device = stage_to_device
+        # residency manager for file-backed commits (memory/tier.py):
+        # when wired, those commits register lazily per span and serve
+        # through the hot/cold tiers; None keeps the eager whole-output
+        # mmap registration
+        self.tier_store = tier_store
         # conf directIO: "off" keeps file-backed READS on the page-
         # cache mmap path too (O_DIRECT bypasses the cache; repeated
         # reads of one block would hit disk every time)
@@ -444,40 +450,55 @@ class ShuffleBlockResolver:
         partition_bytes: Sequence, total: int,
     ) -> MapTaskOutput:
         """Large-output commit: stream the map task's partitions into
-        one data file and register its read-only mmap as the segment
-        (the RdmaMappedFile mmap+register path; file unlinked on
-        release).  Streamed chunk-by-chunk, and NOT debited against the
-        arena byte budget — the whole point is holding shuffles larger
-        than the in-memory arena, and the pages live in the OS cache."""
+        one data file and serve it through the tiered block store
+        (memory/tier.py) when one is wired — the file stays UNMAPPED
+        until a span is resolved or prefetched, hot blocks live in
+        budgeted pooled rows, cold reads hit the disk.  Without a tier
+        store, the legacy eager path registers the whole read-only
+        mmap up front (the RdmaMappedFile mmap+register shape).
+        Streamed chunk-by-chunk either way, and NOT debited against
+        the arena byte budget — the whole point is holding shuffles
+        larger than the in-memory arena."""
         from sparkrdma_tpu.memory.mapped_file import MappedFile
 
+        tiered = self.tier_store is not None
         mf = MappedFile(
             (chunk for b in partition_bytes for chunk in _payload_chunks(b)),
             directory=self.spill_dir,
             direct_write=self.direct_io != "off",
+            defer_map=tiered,
         )
         mf.direct_read_enabled = self.direct_io != "off"
+        spans: List[Tuple[int, int]] = []
+        off = 0
+        for b in partition_bytes:
+            n = _payload_len(b)
+            spans.append((off, n))
+            off += n
         try:
-            # mmap reads may serve views: MappedFile.free defers closing
-            # the mapping while views are exported (BufferError path)
-            seg = self.arena.register(
-                mf.array, shuffle_id=shuffle_id, keepalive=mf,
-                budgeted=False, zero_copy_ok=True,
-            )
+            if tiered:
+                seg = self.tier_store.adopt(
+                    mf, spans, max(total, 1), shuffle_id, self.arena
+                )
+            else:
+                # mmap reads may serve views: MappedFile.free defers
+                # closing the mapping while views are exported
+                # (BufferError path)
+                seg = self.arena.register(
+                    mf.array, shuffle_id=shuffle_id, keepalive=mf,
+                    budgeted=False, zero_copy_ok=True,
+                )
         except BaseException:
             mf.free()
             raise
         if self.node is not None:
             self.node.register_block_store(seg.mkey, self.arena)
         mto = MapTaskOutput(len(partition_bytes))
-        off = 0
-        for pid, b in enumerate(partition_bytes):
-            n = _payload_len(b)
+        for pid, (off, n) in enumerate(spans):
             if n == 0:
                 mto.put(pid, BlockLocation.EMPTY)
             else:
                 mto.put(pid, BlockLocation(off, n, seg.mkey))
-            off += n
         self._install(sd, map_id, mto, seg)
         return mto
 
@@ -511,13 +532,23 @@ class ShuffleBlockResolver:
                     except OSError:
                         pass
                     continue
-                mf = MappedFile.from_path(path, length)
+                tiered = self.tier_store is not None
+                mf = MappedFile.from_path(path, length, defer_map=tiered)
                 mf.direct_read_enabled = self.direct_io != "off"
                 try:
-                    seg = self.arena.register(
-                        mf.array, shuffle_id=shuffle_id, keepalive=mf,
-                        budgeted=False, zero_copy_ok=True,
-                    )
+                    if tiered:
+                        # one block per spill file: residency (and the
+                        # lazy per-span registration) managed by the
+                        # tier store like any file-backed commit
+                        seg = self.tier_store.adopt(
+                            mf, [(0, length)], length, shuffle_id,
+                            self.arena,
+                        )
+                    else:
+                        seg = self.arena.register(
+                            mf.array, shuffle_id=shuffle_id, keepalive=mf,
+                            budgeted=False, zero_copy_ok=True,
+                        )
                 except BaseException:
                     mf.free()
                     raise

@@ -263,6 +263,10 @@ class Node:
         # optional pooled-buffer source for bulk receives (set by the
         # owning manager; TCP read responses land in pooled buffers)
         self.staging_pool = None
+        # optional tiered block store (memory/tier.py, set by the
+        # owning manager): prefetch hints warm its cold blocks through
+        # the serve pool before the read RPCs arrive (warm_blocks)
+        self.tier_store = None
         self._receive_listener: Optional[ReceiveListener] = None
         self._block_stores: Dict[int, BlockStore] = {}  # guarded-by: _block_store_lock
         self._block_store_lock = dbg_lock("node.block_stores", 48)
@@ -416,6 +420,31 @@ class Node:
                     )
                 pool = self._serve_pool
         pool.submit(fn, args, cost, deferred)
+
+    def warm_blocks(self, locations) -> int:
+        """Serve-side warm-before-read: promote the hinted block spans
+        into the tier store's hot rows through the bounded serve pool —
+        each warm is byte-credited exactly like a real serve, so a
+        prefetch storm queues behind (and can never starve or out-pin)
+        the serves it is trying to accelerate.  Returns warms
+        submitted; a no-op without a tier store or for non-tiered
+        mkeys."""
+        tier = self.tier_store
+        if tier is None:
+            return 0
+        n = 0
+        for loc in locations:
+            if loc.is_empty or not tier.would_warm(loc.mkey):
+                continue
+            try:
+                self.submit_serve(
+                    tier.warm, (loc.mkey, loc.address, loc.length),
+                    cost=loc.length,
+                )
+            except TransportError:
+                break  # node stopping: drop the remaining hints
+            n += 1
+        return n
 
     def get_dispatcher(self):
         """The node's async transport event loop (the submission/

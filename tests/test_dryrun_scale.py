@@ -366,3 +366,142 @@ def test_async_dispatcher_bounded_threads_fds_at_high_peer_count():
                 pass
         node.stop()
         net.unregister(node)
+
+
+def test_fabric_and_tier_budget_hold_ceilings_together():
+    """ISSUE 8 acceptance: a node fetching striped blocks from a
+    256-peer fabric through the bounded channel cache while ITS OWN
+    tiered block store churns an out-of-core dataset through a tiny
+    hot budget — fds and transport threads stay bounded by conf (cache
+    cap / lane pool / O(1) dispatcher) AND the tier's resident hot
+    bytes never exceed ``tierHotBytes``, together, under concurrent
+    load."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from sparkrdma_tpu.conf import TpuShuffleConf
+    from sparkrdma_tpu.memory.arena import ArenaManager
+    from sparkrdma_tpu.memory.mapped_file import MappedFile
+    from sparkrdma_tpu.memory.tier import TieredBlockStore
+    from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+    from sparkrdma_tpu.transport import TcpNetwork
+    from sparkrdma_tpu.transport.channel import FnCompletionListener
+    from sparkrdma_tpu.transport.node import Node, transport_census
+    from sparkrdma_tpu.transport.simfleet import SimPeerFleet
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    n_peers = int(os.environ.get("SPARKRDMA_FABRIC_PEERS", "256"))
+    cap = 8
+    block = 32 << 10
+    n_blocks = 64
+    budget = 8 * block
+    pattern = (np.arange(2 << 20, dtype=np.uint32) % 251).astype(np.uint8)
+    prev_metrics = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    before = transport_census()
+    fleet = SimPeerFleet(n_peers, 28700, pattern)
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportMaxCachedChannels": cap,
+        "spark.shuffle.tpu.transportLanePoolSize": 4,
+        "spark.shuffle.tpu.transportNumStripes": 2,
+        "spark.shuffle.tpu.transportStripeThreshold": "64k",
+        "spark.shuffle.tpu.transportServeThreads": 2,
+    })
+    node = Node(("127.0.0.1", 28690), conf)
+    connect = TcpNetwork().connect
+    # the node's own out-of-core dataset: 64 x 32 KiB blocks behind an
+    # 8-block hot budget, readahead riding the node's serve pool
+    tier = TieredBlockStore(
+        hot_bytes=budget, prefetch_blocks=2,
+        submitter=node.submit_serve,
+    )
+    arena = ArenaManager()
+    rng = np.random.default_rng(11)
+    tier_pat = rng.integers(0, 256, n_blocks * block, dtype=np.uint8)
+    mf = MappedFile(tier_pat.tobytes(), direct_write=False,
+                    defer_map=True)
+    seg = tier.adopt(
+        mf, [(i * block, block) for i in range(n_blocks)],
+        n_blocks * block, 0, arena,
+    )
+    peak = [0]
+    churn_errs = []
+    stop_churn = threading.Event()
+
+    def churn():
+        order = list(range(n_blocks))
+        rng2 = np.random.default_rng(13)
+        try:
+            while not stop_churn.is_set():
+                rng2.shuffle(order)
+                for i in order:
+                    got = seg.read(i * block, block - 64)  # promoting
+                    if not np.array_equal(
+                        got, tier_pat[i * block : i * block + block - 64]
+                    ):
+                        raise AssertionError(f"tier block {i} corrupt")
+                    peak[0] = max(peak[0], tier.stats()["hot_bytes"])
+                    if stop_churn.is_set():
+                        return
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            churn_errs.append(e)
+
+    churner = threading.Thread(target=churn, daemon=True)
+    churner.start()
+
+    def read_one(peer, loc, timeout=60):
+        done = threading.Event()
+        res = {}
+        node.get_read_group(peer, connect).read_blocks(
+            [loc],
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("ok", blocks), done.set()),
+                lambda e: (res.setdefault("error", e), done.set()),
+            ),
+        )
+        assert done.wait(timeout), f"fetch from {peer} hung"
+        assert "ok" in res, res.get("error")
+        got = res["ok"][0]
+        got = got if isinstance(got, np.ndarray) else np.frombuffer(
+            memoryview(got), np.uint8)
+        assert np.array_equal(
+            got, pattern[loc.address:loc.address + loc.length]
+        ), f"corrupt payload from {peer}"
+
+    try:
+        for i, peer in enumerate(fleet.addresses):
+            addr = (i * 7919) % (len(pattern) - 300_000)
+            read_one(peer, BlockLocation(addr, 300_000, 1))
+        with node._active_lock:
+            cached = len(node._active)
+        assert cached <= cap, cached
+        stop_churn.set()
+        churner.join(timeout=30)
+        assert not churner.is_alive(), "tier churn wedged"
+        assert not churn_errs, churn_errs
+        # the ceilings hold TOGETHER: bounded fabric AND bounded tier
+        assert peak[0] <= budget, (peak[0], budget)
+        assert tier.stats()["hot_bytes"] <= budget
+        assert GLOBAL_REGISTRY.counter("tier_demotes_total").value > 0
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            census = transport_census()
+            grown_threads = (census["transport_threads"]
+                             - before["transport_threads"])
+            grown_fds = census["open_fds"] - before["open_fds"]
+            if (grown_threads <= cap + 8
+                    and (before["open_fds"] < 0
+                         or grown_fds <= n_peers + 4 * cap + 32)):
+                break
+            time.sleep(0.1)
+        assert grown_threads <= cap + 8, (before, census)
+        if before["open_fds"] > 0 and census["open_fds"] > 0:
+            assert grown_fds <= n_peers + 4 * cap + 32, (before, census)
+    finally:
+        stop_churn.set()
+        node.stop()
+        fleet.close()
+        arena.stop()
+        GLOBAL_REGISTRY.enabled = prev_metrics

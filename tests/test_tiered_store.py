@@ -1,0 +1,374 @@
+"""Tiered block store (memory/tier.py): out-of-core residency for
+file-backed map outputs — hot pooled rows over cold mapped files, LRU +
+pinned eviction, and hint/readahead prefetch — exercised from the unit
+level (blocks, pins, budget) up through bit-exact e2e shuffles under
+forced demotion/promotion churn on every transport engine."""
+
+import gc
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.memory.arena import ArenaManager
+from sparkrdma_tpu.memory.mapped_file import MappedFile
+from sparkrdma_tpu.memory.tier import TieredBlockStore
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.shuffle.resolver import ShuffleBlockResolver
+from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+
+BASE_PORT = 29500
+
+
+@pytest.fixture(autouse=True)
+def registry_on():
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    yield GLOBAL_REGISTRY
+    GLOBAL_REGISTRY.enabled = prev
+
+
+def _counter(name):
+    return GLOBAL_REGISTRY.counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# store-level units
+# ---------------------------------------------------------------------------
+
+def _make_entry(store, arena, n_blocks=8, block=8192, seed=7):
+    """One adopted output of ``n_blocks`` equal blocks with a
+    deterministic pattern; returns (segment, pattern)."""
+    rng = np.random.default_rng(seed)
+    pattern = rng.integers(0, 256, n_blocks * block, dtype=np.uint8)
+    mf = MappedFile(pattern.tobytes(), direct_write=False, defer_map=True)
+    spans = [(i * block, block) for i in range(n_blocks)]
+    seg = store.adopt(mf, spans, n_blocks * block, 0, arena)
+    return seg, pattern
+
+
+def _expect(seg, pattern, off, ln):
+    got = seg.read(off, ln)
+    arr = got if isinstance(got, np.ndarray) else np.frombuffer(
+        memoryview(got), np.uint8)
+    assert np.array_equal(arr, pattern[off : off + ln]), (off, ln)
+
+
+def test_lazy_mapping_and_basic_tiers():
+    """A fresh adoption maps nothing; reads serve bit-exact from cold;
+    a warmed block serves hot (hit counter) as a zero-copy view."""
+    store = TieredBlockStore(hot_bytes=64 << 10)
+    arena = ArenaManager()
+    seg, pattern = _make_entry(store, arena)
+    assert seg.entry.mf.array is None  # deferred: nothing mapped yet
+    h0, m0 = _counter("tier_hits_total"), _counter("tier_misses_total")
+    _expect(seg, pattern, 0, 8192)          # whole-block cold read
+    assert _counter("tier_misses_total") == m0 + 1
+    assert store.warm(seg.mkey, 8192, 8192) == 1
+    _expect(seg, pattern, 8192, 8192)       # now a hot hit
+    assert _counter("tier_hits_total") == h0 + 1
+    assert store.stats()["hot_blocks"] == 1
+    arena.release(seg.mkey)
+    assert store.stats() == {
+        "entries": 0, "hot_blocks": 0, "hot_bytes": 0,
+        "hot_budget": 64 << 10,
+    }
+
+
+def test_subrange_read_promotes_whole_block():
+    """The striped serve shape: a sub-range read promotes its WHOLE
+    block (one disk read serves every stripe), sibling sub-ranges hit
+    hot, and concurrent sub-ranges of one cold block share a single
+    promotion via the loading event."""
+    store = TieredBlockStore(hot_bytes=64 << 10)
+    arena = ArenaManager()
+    seg, pattern = _make_entry(store, arena, n_blocks=2, block=32768)
+    p0 = _counter("tier_promotes_total")
+    _expect(seg, pattern, 100, 1000)
+    assert _counter("tier_promotes_total") == p0 + 1
+    assert store.stats()["hot_blocks"] == 1
+    h0 = _counter("tier_hits_total")
+    _expect(seg, pattern, 8000, 9000)       # sibling stripe: hot
+    _expect(seg, pattern, 0, 32768)         # whole block: hot
+    assert _counter("tier_hits_total") == h0 + 2
+    # concurrent cold sub-ranges: exactly one more promotion
+    p1 = _counter("tier_promotes_total")
+    errs = []
+
+    def rd(off, ln):
+        try:
+            _expect(seg, pattern, off, ln)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=rd, args=(32768 + i * 4096, 4096))
+          for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not errs, errs
+    assert _counter("tier_promotes_total") == p1 + 1
+
+
+def test_eviction_never_tears_inflight_serve():
+    """The PR 7 wedged-serve shape: a serve-pool worker holds a hot
+    view mid-serve while promotion pressure wants its block's budget —
+    the pinned block is REFUSED eviction (counted), the view stays
+    bit-exact, and once the serve completes (view collected) the block
+    demotes normally."""
+    from sparkrdma_tpu.transport.node import Node
+
+    block = 8192
+    store = TieredBlockStore(hot_bytes=2 * block)
+    arena = ArenaManager()
+    seg, pattern = _make_entry(store, arena, n_blocks=8, block=block)
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportServeThreads": 1,
+    })
+    node = Node(("127.0.0.1", BASE_PORT + 90), conf)
+    gate = threading.Event()
+    served = threading.Event()
+    res = {}
+
+    def wedged_serve():
+        # sub-range read: promotes block 0 and pins the hot view
+        res["view"] = seg.read(0, block - 512)
+        served.set()
+        gate.wait(30)  # serve stays in flight, view live
+
+    try:
+        node.submit_serve(wedged_serve, (), cost=block)
+        assert served.wait(10)
+        r0 = _counter("tier_evict_refusals_total")
+        # budget holds 2 blocks; promoting 4 more must evict — but
+        # never the pinned block 0
+        for i in range(1, 5):
+            store.warm(seg.mkey, i * block, block)
+        assert _counter("tier_evict_refusals_total") > r0
+        assert store.stats()["hot_bytes"] <= 2 * block
+        assert np.array_equal(res["view"], pattern[: block - 512])
+        gate.set()
+        del res["view"]
+        gc.collect()
+        # unpinned now: the next promotion may take block 0's budget
+        d0 = _counter("tier_demotes_total")
+        store.warm(seg.mkey, 5 * block, block)
+        store.warm(seg.mkey, 6 * block, block)
+        assert _counter("tier_demotes_total") > d0
+        assert store.stats()["hot_bytes"] <= 2 * block
+    finally:
+        gate.set()
+        node.stop()
+
+
+def test_prefetch_hints_vs_out_of_order_reads():
+    """Hint-driven warming in fetch-plan order must stay bit-exact
+    when the actual reads arrive in a DIFFERENT order (stripe
+    completions reorder freely), and prefetched blocks consumed by
+    reads count as useful."""
+    block = 4096
+    store = TieredBlockStore(hot_bytes=6 * block)
+    arena = ArenaManager()
+    seg, pattern = _make_entry(store, arena, n_blocks=16, block=block)
+    u0 = _counter("tier_prefetch_useful_total")
+    for i in range(16):  # the reader's plan order
+        store.warm(seg.mkey, i * block, block)
+    assert store.stats()["hot_bytes"] <= 6 * block
+    order = list(range(16))
+    np.random.default_rng(3).shuffle(order)
+    for i in order:  # out-of-order arrival
+        _expect(seg, pattern, i * block, block)
+    assert _counter("tier_prefetch_useful_total") > u0
+    assert store.stats()["hot_bytes"] <= 6 * block
+
+
+def test_budget_bounding_without_deadlock():
+    """A hot budget smaller than one block never deadlocks or fails:
+    oversized blocks serve cold (clamped out of promotion), concurrent
+    readers all complete, and hot bytes never exceed the budget."""
+    store = TieredBlockStore(hot_bytes=4096)
+    arena = ArenaManager()
+    seg, pattern = _make_entry(store, arena, n_blocks=4, block=16384)
+    peak = [0]
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            peak[0] = max(peak[0], store.stats()["hot_bytes"])
+            time.sleep(0.001)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    errs = []
+
+    def rd(i):
+        try:
+            for _ in range(4):
+                _expect(seg, pattern, i * 16384, 16384)
+                _expect(seg, pattern, i * 16384 + 100, 2000)  # sub-range
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=rd, args=(i % 4,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive(), "tier read deadlocked under tiny budget"
+    stop.set()
+    sampler.join(timeout=5)
+    assert not errs, errs
+    assert peak[0] <= 4096
+    assert store.stats()["hot_blocks"] == 0  # nothing ever fit
+
+
+def test_lazy_registration_and_never_read_counter(tmp_path):
+    """The eager-registration fix: a file-backed commit maps nothing
+    up front, and releasing a shuffle counts the committed bytes its
+    readers never touched (what the old whole-output registration paid
+    for every time)."""
+    arena = ArenaManager()
+    store = TieredBlockStore(hot_bytes=1 << 20)
+    resolver = ShuffleBlockResolver(
+        arena, node=None, stage_to_device=False,
+        spill_dir=str(tmp_path), tier_store=store,
+    )
+    parts = [bytes([i]) * 1000 for i in range(10)]
+    resolver.commit_map_output(5, 0, parts, prefer_file_backed=True)
+    entry = next(iter(store._by_mkey.values()))
+    assert entry.mf.array is None  # nothing mapped at commit
+    assert bytes(memoryview(resolver.get_local_block(5, 0, 3))) == parts[3]
+    assert bytes(memoryview(resolver.get_local_block(5, 0, 7))) == parts[7]
+    n0 = _counter("tier_bytes_never_read_total")
+    resolver.remove_shuffle(5)
+    assert _counter("tier_bytes_never_read_total") == n0 + 8 * 1000
+
+
+# ---------------------------------------------------------------------------
+# e2e: bit-exact shuffles under forced churn, every engine
+# ---------------------------------------------------------------------------
+
+def _conf(driver_port, prefetch, extra=None):
+    d = {
+        "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "10s",
+        "spark.shuffle.tpu.connectTimeout": "5s",
+        # every commit file-backed through the tier, tiny hot budget
+        "spark.shuffle.tpu.fileBackedCommitBytes": 1,
+        "spark.shuffle.tpu.tierHotBytes": "24k",
+        "spark.shuffle.tpu.tierPrefetch": prefetch,
+    }
+    if extra:
+        d.update(extra)
+    return TpuShuffleConf(d)
+
+
+@contextmanager
+def _cluster(netkind, driver_port, prefetch):
+    extra = {}
+    if netkind == "tcp-threaded":
+        extra["spark.shuffle.tpu.transportAsyncDispatcher"] = "off"
+    if netkind == "loopback":
+        shared = LoopbackNetwork()
+
+        def mknet():
+            return shared
+    else:
+        def mknet():
+            return TcpNetwork()
+    driver = TpuShuffleManager(
+        _conf(driver_port, prefetch, extra), is_driver=True,
+        network=mknet(), port=driver_port, stage_to_device=False,
+    )
+    executors = [
+        TpuShuffleManager(
+            _conf(driver_port, prefetch, extra), is_driver=False,
+            network=mknet(), port=driver_port + 10 + i * 10,
+            executor_id=str(i), stage_to_device=False,
+        )
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == 2 for e in executors):
+            break
+        time.sleep(0.01)
+    try:
+        yield driver, executors
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+@pytest.mark.parametrize("netkind,port_off", [
+    ("loopback", 0),
+    ("tcp-async", 40),
+    ("tcp-threaded", 80),
+])
+@pytest.mark.parametrize("prefetch", [True, False])
+def test_e2e_bit_exact_under_churn(netkind, port_off, prefetch):
+    """Full shuffle over tiered (file-backed) outputs with the hot
+    budget far below the dataset, plus an explicit whole-store warm
+    sweep between write and read to force demotion/promotion churn:
+    results stay bit-exact on every engine, prefetch on or off."""
+    # distinct port block per parametrization: a TCP listener from the
+    # previous case may still be draining on a shared port
+    port = BASE_PORT + 100 + port_off + (0 if prefetch else 200)
+    with _cluster(netkind, port, prefetch) as (driver, executors):
+        num_maps, num_parts = 4, 8
+        handle = driver.register_shuffle(
+            3, num_maps, HashPartitioner(num_parts)
+        )
+        maps_by_host = defaultdict(list)
+        expected = defaultdict(list)
+        for m in range(num_maps):
+            ex = executors[m % 2]
+            recs = [
+                (f"k{j % 17}", bytes([m, j % 251]) * 60)
+                for j in range(250)
+            ]
+            for k, v in recs:
+                expected[k].append(v)
+            w = ex.get_writer(handle, m)
+            w.write(recs)
+            w.stop(True)
+            maps_by_host[ex.local_smid].append(m)
+        d0 = _counter("tier_demotes_total")
+        for ex in executors:
+            # churn: demand-promote EVERY committed block (sub-range
+            # reads take the promoting path) through the tiny budget —
+            # demotions cascade as later blocks displace earlier ones
+            with ex.tier_store._lock:
+                entries = list(ex.tier_store._by_mkey.values())
+            for e in entries:
+                seg = ex.arena.get(e.mkey)
+                for blk in e.blocks:
+                    if blk.length > 1:
+                        seg.read(blk.offset, blk.length - 1)
+            assert ex.tier_store.stats()["hot_bytes"] <= 24 << 10
+        assert _counter("tier_demotes_total") > d0  # churn really ran
+        got = defaultdict(list)
+        for i, ex in enumerate(executors):
+            reader = ex.get_reader(
+                handle, i * 4, i * 4 + 4, dict(maps_by_host)
+            )
+            for k, v in reader.read():
+                got[k].append(v)
+            assert reader.metrics.remote_blocks > 0
+        assert set(got) == set(expected)
+        for k in expected:
+            assert sorted(got[k]) == sorted(expected[k]), k
+        if prefetch:
+            # the reader announced its plan and the responder warmed it
+            assert _counter("tier_hint_msgs_total") > 0
+            assert _counter("tier_hint_blocks_total") > 0
+        for ex in executors:
+            assert ex.tier_store.stats()["hot_bytes"] <= 24 << 10

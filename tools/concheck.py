@@ -16,10 +16,14 @@ Lock/RLock/Condition sites; this pass discovers every one of them in
   CK02  blocking while locked: socket ``sendall``/``sendmsg``/``recv``/
         ``recv_into``/``accept``/``connect``, ``Thread.join``,
         ``Event.wait``, ``queue.Queue.get`` (not ``get_nowait``),
-        ``subprocess.*``, or a ``Condition.wait`` on anything but the
-        innermost held lock, inside a held ``with`` region — directly
-        or through a same-class method call.  Deliberate cases carry a
-        code-scoped ``# noqa: CK02`` with a justification comment.
+        ``subprocess.*``, a disk-read entry point of the tiered block
+        store's cold tier (``pread``/``preadv``/``ensure_mapped``/
+        ``_disk_read``/``_load_row`` — memory/tier.py: a cold read
+        hiding under a lock serializes every hot hit behind the disk),
+        or a ``Condition.wait`` on anything but the innermost held
+        lock, inside a held ``with`` region — directly or through a
+        same-class method call.  Deliberate cases carry a code-scoped
+        ``# noqa: CK02`` with a justification comment.
   CK03  unguarded shared state: an attribute declared
         ``self._x = ...  # guarded-by: _lock`` may only be read or
         written inside a ``with <owner>._lock:`` region (or in
@@ -71,6 +75,12 @@ DBG_CTORS = {"dbg_lock": "Lock", "dbg_rlock": "RLock",
              "dbg_condition": "Condition"}
 SOCKET_BLOCKING = {"sendall", "sendmsg", "recv", "recv_into", "accept",
                    "connect", "create_connection"}
+# the tiered block store's disk-read entry points (memory/tier.py /
+# memory/mapped_file.py): cold-tier reads must never run under a lock —
+# a promotion's pread hiding inside a locked region would serialize
+# every concurrent hot hit behind the disk
+DISK_BLOCKING = {"pread", "preadv", "ensure_mapped", "_disk_read",
+                 "_load_row"}
 
 RANK_RE = re.compile(r"#\s*lock-order:\s*(-?\d+)")
 GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
@@ -504,6 +514,17 @@ class _FnScan(ast.NodeVisitor):
                     line,
                     f"blocking socket call .{attr}() while holding "
                     f"{holder}",
+                    attr,
+                )
+                return
+            if attr in DISK_BLOCKING and not isinstance(
+                    f.value, ast.Constant):
+                self._blocking(
+                    line,
+                    f"cold-tier disk read .{attr}() while holding "
+                    f"{holder} (every hot hit would queue behind the "
+                    f"disk — resolve residency under the lock, read "
+                    f"outside it)",
                     attr,
                 )
                 return

@@ -145,6 +145,55 @@ def render_decode_pipeline(counters: list) -> list:
     return out
 
 
+def render_tier(counters: list, gauges: list) -> list:
+    """Compact view of the tiered block store (memory/tier.py): hot
+    hit rate on the serve path, promote/demote traffic, prefetch
+    usefulness (predicted blocks actually consumed hot), eviction
+    refusals (pinned under an in-flight serve), and the bytes
+    committed but never read (what lazy per-span registration saved
+    over the old eager whole-output mmap)."""
+    vals = {}
+    for c in counters:
+        if not c.get("labels"):
+            vals[c["name"]] = c["value"]
+    hits = vals.get("tier_hits_total", 0)
+    misses = vals.get("tier_misses_total", 0)
+    if not hits and not misses and not vals.get("tier_commit_bytes_total"):
+        return []
+    served = hits + misses
+    out = ["tiered block store (memory/tier.py)"]
+    hot = next(
+        (g["value"] for g in gauges
+         if g["name"] == "tier_hot_bytes" and not g.get("labels")), 0,
+    )
+    out.append(
+        f"  committed {_fmt_num(vals.get('tier_commit_bytes_total', 0))}B"
+        f"  hot now {_fmt_num(hot)}B"
+        f"  never-read {_fmt_num(vals.get('tier_bytes_never_read_total', 0))}B"
+    )
+    rate = f" ({hits / served:.0%})" if served else ""
+    out.append(
+        f"  serves: hits={hits:,.0f}{rate}  misses={misses:,.0f}  "
+        f"cold bytes={_fmt_num(vals.get('tier_cold_read_bytes_total', 0))}B"
+    )
+    out.append(
+        f"  promote {vals.get('tier_promotes_total', 0):,.0f}"
+        f"/{_fmt_num(vals.get('tier_promote_bytes_total', 0))}B  "
+        f"demote {vals.get('tier_demotes_total', 0):,.0f}"
+        f"/{_fmt_num(vals.get('tier_demote_bytes_total', 0))}B  "
+        f"evict refusals={vals.get('tier_evict_refusals_total', 0):,.0f}"
+    )
+    pf = vals.get("tier_prefetch_tasks_total", 0)
+    useful = vals.get("tier_prefetch_useful_total", 0)
+    use = f" ({useful / pf:.0%} useful)" if pf else ""
+    out.append(
+        f"  prefetch tasks={pf:,.0f}{use}  "
+        f"hint msgs={vals.get('tier_hint_msgs_total', 0):,.0f}  "
+        f"hinted blocks={vals.get('tier_hint_blocks_total', 0):,.0f}"
+    )
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
@@ -156,6 +205,7 @@ def render(snap: dict, title: str = "") -> str:
     hists = [h for h in all_hists if h["name"] != "lock_hold_us"]
     lines.extend(render_lock_holds(lock_hists))
     lines.extend(render_decode_pipeline(counters))
+    lines.extend(render_tier(counters, gauges))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
     )
