@@ -35,6 +35,8 @@ bench-smoke:
 	python benchmarks/bench_reduce_loopback.py
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_terasort.py --out-of-core
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	python benchmarks/bench_qos.py
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

@@ -594,14 +594,118 @@ class TpuShuffleConf:
         time; healthy ICI links already carry hardware CRC."""
         return self._bool("verifyExchangeIntegrity", False)
 
+    # -- multi-tenant QoS (sparkrdma_tpu/qos/) ------------------------------
+    @property
+    def qos_enabled(self) -> bool:
+        """Multi-tenant QoS policy (qos/): the byte-credit pools
+        (serve, decode, reader in-flight window, tier hot budget)
+        acquire through weighted max-min credit brokers, the serve
+        queue and lane pool honor priority classes, and admission
+        control enforces per-tenant quotas.  Off by default — the
+        brokers then compile down to the existing pools (plain FIFO
+        credits, unclassed queues) for A/B."""
+        return self._bool("qosEnabled", False)
+
+    @property
+    def tenant(self) -> str:
+        """Tenant id this manager's shuffles register under.  Empty
+        (the default) gives every shuffle its own tenant
+        (``shuffle-<id>``) — isolation without configuration; name a
+        tenant to pool several shuffles under one weight/quota."""
+        return str(self.get("tenant", ""))
+
+    @property
+    def qos_tenant_weight(self) -> int:
+        """This tenant's weight in the brokered max-min share of every
+        credit budget (a weight-4 tenant gets 4x a weight-1 tenant's
+        share under contention; idle shares stay borrowable)."""
+        return self._int_in_range("qosTenantWeight", 1, 1, 1_000_000)
+
+    @property
+    def qos_tenant_priority(self) -> str:
+        """Priority class: ``interactive`` work dequeues ahead of
+        ``bulk`` (default) on the serve pool and may borrow from the
+        lane pool's reserved slice; anti-starvation aging keeps bulk
+        from starving behind a steady interactive stream."""
+        v = str(self.get("qosTenantPriority", "bulk")).lower()
+        return v if v in ("interactive", "bulk") else "bulk"
+
+    @property
+    def qos_tenant_max_bytes(self) -> int:
+        """Admission-control quota on the tenant's registered
+        (committed) map-output bytes: past it, a commit queues up to
+        ``qosAdmissionWait`` then the tenant DEGRADES (narrower
+        stripes, cold-tier serves) instead of OOMing the node.  0 (the
+        default) = unlimited."""
+        return self._bytes_in_range("qosTenantMaxBytes", 0, 0, 1 << 44)
+
+    @property
+    def qos_tenant_max_inflight(self) -> int:
+        """Per-tenant cap on brokered in-flight fetch bytes across all
+        of the tenant's concurrent readers (enforced by the reader
+        window's broker).  0 (the default) = unlimited — the weighted
+        share alone bounds it under contention."""
+        return self._bytes_in_range(
+            "qosTenantMaxInFlight", 0, 0, 1 << 40
+        )
+
+    @property
+    def qos_aging_ms(self) -> int:
+        """Anti-starvation aging on the classed edges: a bulk-class
+        task or credit waiter older than this is promoted to
+        interactive priority, so bulk never starves outright."""
+        return self._time_ms("qosAging", 100)
+
+    @property
+    def qos_interactive_bytes(self) -> int:
+        """Serve-size cutoff for the interactive class: serves at or
+        below this many requested bytes (metadata reads, small blocks
+        — the small-read-lane lineage) classify interactive regardless
+        of tenant; larger serves take the owning tenant's class."""
+        return self._bytes_in_range(
+            "qosInteractiveBytes", 512 << 10, 0, 1 << 30
+        )
+
+    @property
+    def qos_lane_reserve(self) -> int:
+        """Stripe-lane tokens held back from bulk-class borrows so an
+        interactive-class striped read always finds width (the lane
+        pool's priority grant).  Clamped to the pool size at use."""
+        return self._int_in_range("qosLaneReserve", 4, 0, 4096)
+
+    @property
+    def qos_admission_wait_ms(self) -> int:
+        """How long an over-quota commit queues for earlier shuffles
+        to release registered bytes before proceeding degraded."""
+        return self._time_ms("qosAdmissionWait", 100)
+
     # -- observability ------------------------------------------------------
+    @property
+    def metrics_http_port(self) -> int:
+        """Live Prometheus scrape endpoint (qos/http.py): serve
+        ``/metrics`` (text exposition), ``/metrics.json`` and
+        ``/tenants`` on this port for the manager's lifetime.  -1 (the
+        default) disables; 0 binds an ephemeral port (tests/one-off
+        runs — the bound address is ``manager.metrics_http.address``).
+        Setting it implies ``metrics`` (a scrape endpoint over a
+        disabled registry would be an empty page)."""
+        return self._int_in_range("metricsHttpPort", -1, -1, 65535)
+
+    @property
+    def metrics_http_host(self) -> str:
+        """Bind address of the scrape endpoint.  Defaults to loopback
+        (a metrics port should be opt-in reachable); set ``0.0.0.0``
+        for a fleet scraper to reach executors remotely."""
+        return str(self.get("metricsHttpHost", "127.0.0.1"))
+
     @property
     def metrics_enabled(self) -> bool:
         """Enable the process-wide metrics registry (metrics/registry.py):
         labeled counters/gauges/histograms across transport, shuffle and
         memory.  Off by default — instrumented call sites then hold
-        zero-overhead no-op handles."""
-        return self._bool("metrics", False)
+        zero-overhead no-op handles.  A live scrape endpoint
+        (``metricsHttpPort``) implies metrics."""
+        return self._bool("metrics", False) or self.metrics_http_port >= 0
 
     @property
     def lock_debug(self) -> bool:

@@ -82,9 +82,12 @@ class _Block:
 
 
 class TierEntry:
-    """One adopted map output: its data file + per-block residency."""
+    """One adopted map output: its data file + per-block residency.
+    ``tenant`` (qos/) is the owning tenant resolved at adoption — the
+    hot budget's weighted-share accounting keys on it."""
 
-    __slots__ = ("mf", "nbytes", "shuffle_id", "blocks", "_ends", "mkey")
+    __slots__ = ("mf", "nbytes", "shuffle_id", "blocks", "_ends",
+                 "mkey", "tenant")
 
     def __init__(self, mf, spans: Sequence[Tuple[int, int]],
                  nbytes: int, shuffle_id: Optional[int]):
@@ -92,6 +95,7 @@ class TierEntry:
         self.nbytes = nbytes
         self.shuffle_id = shuffle_id
         self.mkey = 0  # assigned at registration
+        self.tenant = None  # resolved by the adopting store
         self.blocks: List[_Block] = [
             _Block(i, off, ln)
             for i, (off, ln) in enumerate(spans) if ln > 0
@@ -165,10 +169,18 @@ class TieredBlockStore:
     """Per-node residency manager for file-backed map outputs."""
 
     def __init__(self, staging_pool=None, hot_bytes: int = 0,
-                 prefetch_blocks: int = 2, submitter=None):
+                 prefetch_blocks: int = 2, submitter=None, qos=None):
         self.staging_pool = staging_pool
         self.hot_budget = max(int(hot_bytes), 0)  # 0 = unbounded
         self.prefetch_blocks = max(int(prefetch_blocks), 0)
+        # multi-tenant QoS (qos/): when a tenant registry is attached,
+        # the hot budget splits into weighted max-min shares — an
+        # over-share tenant may only displace its own (or other
+        # over-share) blocks, a DEGRADED tenant (admission quota) is
+        # not promoted at all (its blocks serve cold), and idle shares
+        # stay borrowable (work conservation)
+        self._qos = qos
+        self._hot_by_tenant: Dict[str, int] = {}  # guarded-by: _lock
         # async promotion executor: (fn, args, cost_bytes) — wired to
         # Node.submit_serve so warms ride the serve pool's byte
         # credits; None runs nothing (demand-only cache)
@@ -210,6 +222,8 @@ class TieredBlockStore:
         per-partition (offset, length) pairs; takes ownership of ``mf``
         (freed on segment release)."""
         entry = TierEntry(mf, spans, nbytes, shuffle_id)
+        if self._qos is not None:
+            entry.tenant = self._qos.tenant_of_shuffle(shuffle_id)
         seg = TieredSegment(self, entry)
         arena.register_external(seg)
         entry.mkey = seg.mkey
@@ -324,7 +338,7 @@ class TieredBlockStore:
                     return self._pinned_view_locked(blk, rel, length)
                 ev = blk.loading
                 if ev is None and want_promote \
-                        and self._reserve_locked(blk.length):
+                        and self._reserve_locked(blk.length, entry=entry):
                     blk.loading = threading.Event()
                     ev = None
                     load = True
@@ -432,7 +446,8 @@ class TieredBlockStore:
             # never demote its still-unread head — when the budget is
             # full of unread predictions, warming simply stops and the
             # blocks serve cold on demand
-            if not self._reserve_locked(blk.length, prefetch=True):
+            if not self._reserve_locked(blk.length, prefetch=True,
+                                        entry=entry):
                 return 0
             self._seq += 1  # noqa: CK03 - held
             blk.seq = self._seq  # noqa: CK03 - held
@@ -504,27 +519,79 @@ class TieredBlockStore:
         with self._lock:
             blk.pins -= 1
 
-    def _reserve_locked(self, n: int, prefetch: bool = False) -> bool:
+    def _tier_shares_locked(self, extra) -> Dict[str, float]:
+        """The hot budget's weighted max-min shares over the tenants
+        with hot bytes, plus ``extra`` (the requester) — the SAME
+        formula every credit ledger uses (qos/broker.py)."""
+        from sparkrdma_tpu.qos.broker import weighted_shares
+
+        return weighted_shares(
+            self.hot_budget, self._qos,
+            self._hot_by_tenant,  # noqa: CK03 - caller holds _lock
+            {extra.name: extra} if extra is not None else None,
+        )
+
+    def _drop_hot_tenant_locked(self, tenant, n: int) -> None:
+        """Return ``n`` bytes of a tenant's hot usage (demotion or a
+        failed/raced load) — caller holds ``_lock``."""
+        if tenant is None:
+            return
+        left = self._hot_by_tenant.get(tenant.name, 0) - n  # noqa: CK03 - held
+        if left > 0:
+            self._hot_by_tenant[tenant.name] = left  # noqa: CK03 - held
+        else:
+            self._hot_by_tenant.pop(tenant.name, None)  # noqa: CK03 - held
+
+    def _reserve_locked(self, n: int, prefetch: bool = False,
+                        entry: Optional[TierEntry] = None) -> bool:
         """Make budget room for one promotion (evicting LRU unpinned
         hot blocks), reserving ``n`` bytes on success.  A block larger
         than the whole budget is never promoted (it serves cold) —
         the no-deadlock clamp.  ``prefetch`` restricts eviction to
         TOUCHED blocks (served at least once): a demand read may
         displace an unread prediction, a prediction may not — warming
-        the tail of a plan must never cannibalize its unread head."""
+        the tail of a plan must never cannibalize its unread head.
+        With QoS on, a DEGRADED tenant never promotes (cold serves —
+        the admission-control shed path) and eviction honors weighted
+        shares (``_evict_locked``)."""
+        tenant = entry.tenant if entry is not None else None
+        if self._qos is not None and tenant is not None \
+                and tenant.degraded:
+            counter("qos_tier_denials_total",
+                    tenant=tenant.name).inc()
+            return False
         if self.hot_budget:
             if n > self.hot_budget:
                 return False
             over = self._hot_bytes + n - self.hot_budget  # noqa: CK03 - held
             if over > 0:
-                self._evict_locked(over, touched_only=prefetch)
+                self._evict_locked(over, touched_only=prefetch,
+                                   requester=tenant)
             if self._hot_bytes + n > self.hot_budget:  # noqa: CK03 - held
                 return False
         self._hot_bytes += n  # noqa: CK03 - caller holds _lock
+        if tenant is not None:
+            self._hot_by_tenant[tenant.name] = (  # noqa: CK03 - held
+                self._hot_by_tenant.get(tenant.name, 0) + n  # noqa: CK03 - held
+            )
         self._m_hot.inc(n)
         return True
 
-    def _evict_locked(self, need: int, touched_only: bool = False) -> None:
+    def _evict_locked(self, need: int, touched_only: bool = False,
+                      requester=None) -> None:
+        protect_others = False
+        shares: Dict[str, float] = {}
+        if self._qos is not None and requester is not None:
+            # a requester already at/over its weighted share may only
+            # displace its OWN blocks (or another over-share tenant's)
+            # — an under-share tenant's hot set is protected from it;
+            # an under-share requester reclaims from anyone (that IS
+            # the reclaim-on-demand of borrowed idle shares)
+            shares = self._tier_shares_locked(requester)
+            protect_others = (
+                self._hot_by_tenant.get(requester.name, 0)  # noqa: CK03 - held
+                >= shares.get(requester.name, float("inf"))
+            )
         order = sorted(self._hot, key=lambda b: b.seq)  # noqa: CK03 - held
         freed = 0
         for blk in order:
@@ -536,13 +603,24 @@ class TieredBlockStore:
                 # in-flight serve: never demote under a live reader
                 self._m_evict_refusals.inc()
                 continue
+            if protect_others:
+                owner = self._hot[blk].tenant  # noqa: CK03 - held
+                if (owner is not None
+                        and owner.name != requester.name
+                        and self._hot_by_tenant.get(owner.name, 0)  # noqa: CK03 - held
+                        <= shares.get(owner.name, 0)):
+                    self._m_evict_refusals.inc()
+                    continue
             freed += blk.length
             self._demote_locked(blk)
 
     def _demote_locked(self, blk: _Block) -> None:
-        self._hot.pop(blk, None)  # noqa: CK03 - caller holds _lock
+        entry = self._hot.pop(blk, None)  # noqa: CK03 - caller holds _lock
         blk.row = None  # cold tier is the source of truth: no write-back
         self._hot_bytes -= blk.length  # noqa: CK03 - caller holds _lock
+        self._drop_hot_tenant_locked(
+            entry.tenant if entry is not None else None, blk.length
+        )
         self._m_hot.dec(blk.length)
         self._m_demotes.inc()
         self._m_demote_bytes.inc(blk.length)
@@ -559,6 +637,7 @@ class TieredBlockStore:
             else:
                 # failed load, or the entry was released mid-load
                 self._hot_bytes -= blk.length
+                self._drop_hot_tenant_locked(entry.tenant, blk.length)
                 self._m_hot.dec(blk.length)
         if ev is not None:
             ev.set()

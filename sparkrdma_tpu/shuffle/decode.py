@@ -42,9 +42,10 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.qos import CreditLedger
 from sparkrdma_tpu.utils.dbglock import dbg_condition
 from sparkrdma_tpu.utils.serde import as_view
 
@@ -147,7 +148,7 @@ class DecodeTicket:
         """Release held credits and drop the stream's reference —
         idempotent, caller holds the pool condition."""
         if self._held:
-            self._pool._credits += self._held
+            self._pool._ledger.put(self._stream._tenant, self._held)
             self._held = 0
             self._pool._cv.notify_all()
         self._stream._tickets.discard(self)
@@ -208,11 +209,15 @@ class DecodeStream:
 
     def __init__(self, pool: "DecodePool", decode_fn: Callable,
                  split_fn: Optional[Callable] = None,
-                 combine_fn: Optional[Callable] = None):
+                 combine_fn: Optional[Callable] = None,
+                 tenant=None):
         self._pool = pool
         self._decode_fn = decode_fn
         self._split_fn = split_fn
         self._combine_fn = combine_fn
+        # qos/: the reader's tenant — credit admission runs through
+        # the pool's weighted ledger under it (None = plain credits)
+        self._tenant = tenant
         self._tickets: set = set()  # guarded-by: (pool) _cv
         self._closed = False  # guarded-by: (pool) _cv
 
@@ -282,10 +287,16 @@ class DecodePool:
     admission."""
 
     def __init__(self, name: str, workers: int, credit_bytes: int,
-                 init_fn=None):
+                 init_fn=None, qos=None):
         self.workers = max(1, int(workers))
         self._budget = max(int(credit_bytes), 1)
-        self._credits = self._budget  # guarded-by: _cv
+        # credit policy core (qos/): weighted max-min per-tenant when
+        # a registry is attached, a plain budget counter otherwise —
+        # all access under _cv
+        self._ledger = CreditLedger("decode", self._budget, qos=qos)
+        # tenants currently credit-waiting (name → (tenant, waiters)):
+        # the ledger's reclaim-on-demand needs to see deprived waiters
+        self._waiting: Dict[str, tuple] = {}  # guarded-by: _cv
         self._cv = dbg_condition("decode.credits", 51)
         self._queue: "queue.Queue" = queue.Queue()
         self._stopped = False  # guarded-by: _cv
@@ -306,10 +317,36 @@ class DecodePool:
         for t in self._threads:
             t.start()
 
+    @property
+    def _credits(self) -> int:
+        """Free credit bytes (the pre-ledger attribute, kept for tests
+        and debugging; the condition's lock is reentrant)."""
+        with self._cv:
+            return self._ledger.free
+
     def stream(self, decode_fn: Callable,
                split_fn: Optional[Callable] = None,
-               combine_fn: Optional[Callable] = None) -> DecodeStream:
-        return DecodeStream(self, decode_fn, split_fn, combine_fn)
+               combine_fn: Optional[Callable] = None,
+               tenant=None) -> DecodeStream:
+        return DecodeStream(self, decode_fn, split_fn, combine_fn,
+                            tenant=tenant)
+
+    def _waiting_view(self) -> Dict:
+        """name → Tenant of currently credit-waiting tenants (cv
+        held) — the ledger's deprived-waiter input."""
+        w = self._waiting  # noqa: CK03 - caller holds _cv
+        return {k: t for k, (t, _n) in w.items()}
+
+    def _waiting_add(self, tenant) -> None:
+        t, n = self._waiting.get(tenant.name, (tenant, 0))  # noqa: CK03 - held
+        self._waiting[tenant.name] = (t, n + 1)  # noqa: CK03 - held
+
+    def _waiting_remove(self, tenant) -> None:
+        t, n = self._waiting.get(tenant.name, (tenant, 1))  # noqa: CK03 - held
+        if n <= 1:
+            self._waiting.pop(tenant.name, None)  # noqa: CK03 - caller holds _cv
+        else:
+            self._waiting[tenant.name] = (t, n - 1)  # noqa: CK03 - caller holds _cv
 
     def _observe(self, nbytes: int, seconds: float) -> None:
         self._m_tasks.inc()
@@ -328,12 +365,21 @@ class DecodePool:
                 if item._state != _QUEUED:
                     continue  # stolen by the consumer, or cancelled
                 cost = min(item.cost, self._budget)
-                if self._credits < cost:
-                    self._m_credit_waits.inc()
-                while (self._credits < cost and not self._stopped
+                tenant = item._stream._tenant
+                waited = False
+                while (not self._ledger.can_take(
+                            tenant, cost, self._waiting_view())
+                       and not self._stopped
                        and item._state == _QUEUED
                        and not item._stream._closed):
+                    if not waited:
+                        waited = True
+                        self._m_credit_waits.inc()
+                        if tenant is not None:
+                            self._waiting_add(tenant)
                     self._cv.wait(timeout=0.5)
+                if waited and tenant is not None:
+                    self._waiting_remove(tenant)
                 if item._state != _QUEUED:
                     continue  # stolen mid-wait: the consumer owns it now
                 if self._stopped or item._stream._closed:
@@ -342,7 +388,7 @@ class DecodePool:
                     item._settle_locked()
                     item._event.set()
                     continue
-                self._credits -= cost
+                self._ledger.take(tenant, cost)
                 item._held = cost
                 item._state = _DECODING
             t0 = time.monotonic()
@@ -427,6 +473,7 @@ def open_decode_stream(manager, handle, columnar: bool):
     pool = manager.get_decode_pool()
     if pool is None:
         return None
+    tenant = manager.qos_tenant_for(handle)
     serializer = manager.serializer
     agg = handle.aggregator
     split_fn = getattr(serializer, "frame_spans", None)
@@ -477,12 +524,13 @@ def open_decode_stream(manager, handle, columnar: bool):
                 ))
                 return merged, sum(n for _i, n in results)
 
-            return pool.stream(decode_fn, split_fn, combine_fn)
+            return pool.stream(decode_fn, split_fn, combine_fn,
+                               tenant=tenant)
 
         def decode_fn(data, _d=deser):
             recs = list(_d(data))
             return recs, len(recs)
-    return pool.stream(decode_fn, split_fn)
+    return pool.stream(decode_fn, split_fn, tenant=tenant)
 
 
 def iter_decoded_ahead(stream: DecodeStream, payloads: Iterator,

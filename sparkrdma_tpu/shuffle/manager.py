@@ -36,6 +36,7 @@ from sparkrdma_tpu.metrics import (
     write_json_snapshot,
     write_prometheus,
 )
+from sparkrdma_tpu.qos import WeightedCreditBroker, get_qos
 from sparkrdma_tpu.utils.dbglock import dbg_lock, dbg_rlock
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
@@ -151,6 +152,9 @@ class ShuffleHandle:
     aggregator: Optional[Aggregator] = None
     map_side_combine: bool = False
     key_ordering: bool = False
+    # QoS tenant id this shuffle registered under (qos/registry.py);
+    # empty until stamped by register_shuffle with qosEnabled
+    tenant: str = ""
 
     def __post_init__(self):
         if self.map_side_combine and self.aggregator is None:
@@ -244,6 +248,30 @@ class TpuShuffleManager:
             from sparkrdma_tpu.utils.dbglock import get_lock_factory
 
             get_lock_factory().enabled = True
+        # multi-tenant QoS (qos/): flip the process-global tenant
+        # registry on BEFORE building the node, exactly like the
+        # metrics registry — the node's pools classify/broker through
+        # it from their first task.  None keeps every edge plain FIFO.
+        self.qos = None
+        if conf.qos_enabled:
+            self.qos = get_qos()
+            self.qos.enabled = True
+        # live scrape endpoint (qos/http.py): serves /metrics,
+        # /metrics.json and /tenants for the manager's lifetime
+        self.metrics_http = None
+        if conf.metrics_http_port >= 0:
+            from sparkrdma_tpu.qos.http import MetricsHttpServer
+
+            try:
+                self.metrics_http = MetricsHttpServer(
+                    conf.metrics_http_port,
+                    host=conf.metrics_http_host,
+                )
+            except OSError:
+                logger.exception(
+                    "metrics scrape endpoint on port %d failed to bind "
+                    "— continuing without it", conf.metrics_http_port,
+                )
         if serializer is not None:
             self.serializer = serializer
         else:
@@ -311,6 +339,7 @@ class TpuShuffleManager:
         # hot blocks in budgeted pooled rows, cold blocks on disk with
         # prefetch promotion riding the node's serve-pool credits
         from sparkrdma_tpu.memory.tier import TieredBlockStore
+        from sparkrdma_tpu.qos import BULK as _QOS_BULK
 
         self.tier_store = TieredBlockStore(
             staging_pool=self.staging_pool,
@@ -318,7 +347,12 @@ class TpuShuffleManager:
             prefetch_blocks=(
                 conf.tier_prefetch_blocks if conf.tier_prefetch else 0
             ),
-            submitter=self.node.submit_serve,
+            # readahead warms ride the serve pool at BULK class — a
+            # prefetch storm never outranks demand serves
+            submitter=lambda fn, args, cost: self.node.submit_serve(
+                fn, args, cost, cls=_QOS_BULK
+            ),
+            qos=self.qos,
         )
         self.node.tier_store = self.tier_store
         self.resolver = ShuffleBlockResolver(
@@ -405,6 +439,26 @@ class TpuShuffleManager:
         # (same double-checked create: benign unlocked fast-path read)
         self._decode_pool = None
         self._decode_lock = dbg_lock("manager.decode_pool", 21)
+        # brokered in-flight fetch window (qos/): every reader of this
+        # manager shares ONE weighted maxBytesInFlight budget across
+        # tenants (per-tenant qosTenantMaxInFlight caps ride on it);
+        # None (QoS off) keeps each reader's private window alone
+        self._qos_inflight = None
+        if self.qos is not None:
+            from sparkrdma_tpu.utils.dbglock import dbg_condition
+
+            self._qos_inflight_cv = dbg_condition(
+                "manager.qos_inflight", 31
+            )
+            self._qos_inflight = WeightedCreditBroker(
+                "inflight", conf.max_bytes_in_flight,
+                self._qos_inflight_cv,
+                qos=self.qos, classed=True,
+                aging_ms=conf.qos_aging_ms, quota_inflight=True,
+                wait_counter=counter(
+                    "shuffle_inflight_credit_waits_total"
+                ),
+            )
 
         # heartbeat plane (driver side): last ack time per executor +
         # monitor thread — the CM DISCONNECTED/onBlockManagerRemoved
@@ -1374,6 +1428,56 @@ class TpuShuffleManager:
         with self._callbacks_lock:
             self._callbacks.pop(cb_id, None)
 
+    # -- multi-tenant QoS helpers (qos/) -------------------------------------
+    def qos_tenant_for(self, handle) -> Optional[object]:
+        """Resolve (get-or-create) the tenant a shuffle runs under:
+        the handle's stamped tenant id, else this manager's conf
+        ``tenant``, else one tenant per shuffle (``shuffle-<id>``).
+        Conf weight/priority/quotas apply on every resolution (last
+        writer wins — that is how policy changes land).  None with
+        QoS off."""
+        qos = self.qos
+        if qos is None:
+            return None
+        name = (
+            getattr(handle, "tenant", "")
+            or self.conf.tenant
+            or f"shuffle-{handle.shuffle_id}"
+        )
+        return qos.tenant(
+            name,
+            weight=self.conf.qos_tenant_weight,
+            priority=self.conf.qos_tenant_priority,
+            max_bytes=self.conf.qos_tenant_max_bytes,
+            max_inflight=self.conf.qos_tenant_max_inflight,
+        )
+
+    def qos_inflight_broker(self):
+        return self._qos_inflight
+
+    def _qos_bind(self, handle) -> None:
+        """Bind shuffle → tenant in the process-global registry so the
+        SERVING side (``Node.tenant_of_mkey``) can classify incoming
+        reads — called wherever a shuffle becomes live in this
+        process (registration, writers, readers)."""
+        if self.qos is not None:
+            self.qos.bind_shuffle(
+                handle.shuffle_id, self.qos_tenant_for(handle)
+            )
+
+    def qos_admit(self, handle, nbytes: int) -> bool:
+        """Admission control on registration: account ``nbytes`` of
+        committed map output under the tenant's registered-byte quota
+        (writers call this at commit).  Over quota the commit queues
+        up to ``qosAdmissionWait`` then the tenant DEGRADES rather
+        than OOM the node.  True = within quota (or QoS off)."""
+        if self.qos is None or nbytes <= 0:
+            return True
+        return self.qos.admit(
+            handle.shuffle_id, self.qos_tenant_for(handle), nbytes,
+            wait_s=self.conf.qos_admission_wait_ms / 1000.0,
+        )
+
     # -- public API (the ShuffleManager SPI) ---------------------------------
     def register_shuffle(
         self,
@@ -1390,6 +1494,11 @@ class TpuShuffleManager:
             shuffle_id, num_maps, partitioner, aggregator,
             map_side_combine, key_ordering,
         )
+        if self.qos is not None:
+            # stamp the tenant id so executors sharing the handle
+            # resolve the same tenant, and bind it for the serve path
+            handle.tenant = self.qos_tenant_for(handle).name
+            self._qos_bind(handle)
         self._shuffle_partitions[shuffle_id] = partitioner.num_partitions
         self._shuffle_num_maps[shuffle_id] = num_maps
         with self._plan_lock:
@@ -1397,6 +1506,8 @@ class TpuShuffleManager:
         return handle
 
     def get_writer(self, handle: ShuffleHandle, map_id: int) -> ShuffleWriter:
+        # executor-side binding: the writer's process serves the blocks
+        self._qos_bind(handle)
         return ShuffleWriter(self, handle, map_id)
 
     def get_reader(
@@ -1414,6 +1525,7 @@ class TpuShuffleManager:
         unified device plane: blocks arrive via driver-planned window
         collectives (maps_by_host is unused — the plan carries the
         manifest)."""
+        self._qos_bind(handle)
         if self.conf.read_plane == "windowed":
             from sparkrdma_tpu.shuffle.bulk import WindowedReadPlane
 
@@ -1451,6 +1563,7 @@ class TpuShuffleManager:
                         self.executor_id, n,
                         self.conf.decode_ahead_bytes,
                         init_fn=self.node._pin_worker_thread,
+                        qos=self.qos,
                     )
                 pool = self._decode_pool
         return pool
@@ -1642,6 +1755,11 @@ class TpuShuffleManager:
             self._outputs.pop(shuffle_id, None)
         self._shuffle_partitions.pop(shuffle_id, None)
         self._shuffle_num_maps.pop(shuffle_id, None)
+        if self.qos is not None:
+            # return the shuffle's admitted registered bytes: a tenant
+            # back under quota leaves degraded mode, queued admissions
+            # re-check
+            self.qos.release_shuffle(shuffle_id)
 
     def remove_executor(self, smid: ShuffleManagerId) -> None:
         """Elastic membership pruning (reference onBlockManagerRemoved,
@@ -1781,6 +1899,12 @@ class TpuShuffleManager:
                 tracer.clear()
         logger.info("staging pool at stop: %s", self.staging_pool.stats())
         logger.info("tier store at stop: %s", self.tier_store.stats())
+        if self.metrics_http is not None:
+            # the scrape endpoint dies with the manager: synchronous
+            # shutdown so the census sees no leaked serving thread
+            self.metrics_http.stop()
+        if self._qos_inflight is not None:
+            self._qos_inflight.stop()
         with self._decode_lock:
             decode_pool, self._decode_pool = self._decode_pool, None
         if decode_pool is not None:

@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from sparkrdma_tpu.metrics import counter, histogram
+from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.transport.channel import FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
@@ -96,11 +97,14 @@ def flush_read_metrics(manager, shuffle_id: int, m: ReadMetrics,
 @dataclass
 class _PendingFetch:
     """One grouped fetch against one host
-    (reference: PendingFetch, RdmaShuffleFetcherIterator.scala:112-127)."""
+    (reference: PendingFetch, RdmaShuffleFetcherIterator.scala:112-127).
+    ``qos_granted`` is the brokered in-flight credit this fetch holds
+    (qos/) — released per landed stripe, remainder at settle."""
 
     host: ShuffleManagerId
     locations: List[BlockLocation]
     total_bytes: int
+    qos_granted: int = 0
 
 
 class _Result:
@@ -155,6 +159,14 @@ class ShuffleReader:
         # to the pool and the consumer sees tickets instead of raw
         # payloads.  None = the legacy serial task-thread decode.
         self._decode_stream = None
+        # multi-tenant QoS (qos/): this reader's tenant and the
+        # manager-wide brokered in-flight window — every concurrent
+        # reader's fetch bytes share one weighted budget instead of
+        # each holding a private maxBytesInFlight (None = QoS off,
+        # the per-reader window alone throttles, exactly as before)
+        self._tenant = manager.qos_tenant_for(handle)
+        self._inflight = manager.qos_inflight_broker()
+        self._pump_registered = False
         self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
         self._m_local_read = histogram("shuffle_local_read_ms")
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
@@ -171,6 +183,11 @@ class ShuffleReader:
         the local consumption either way."""
         local_map_ids: List[int] = []
         conf = self.manager.conf
+        if self._inflight is not None and not self._pump_registered:
+            # brokered window: a credit release anywhere re-pumps this
+            # reader's pending queue (unregistered at cleanup)
+            self._pump_registered = True
+            self._inflight.add_pump(self._pump)
         reduce_ids = range(self.start_partition, self.end_partition)
         for host, map_ids in self.maps_by_host.items():
             if host == self.manager.local_smid:
@@ -310,8 +327,14 @@ class ShuffleReader:
 
     def _pump(self) -> None:
         """Issue pending fetches within the in-flight byte window
-        (RdmaShuffleFetcherIterator.scala:241-251,357-366)."""
+        (RdmaShuffleFetcherIterator.scala:241-251,357-366).  With QoS
+        on, each fetch additionally acquires its bytes from the
+        manager's brokered in-flight budget (weighted across tenants,
+        per-tenant ``qosTenantMaxInFlight`` cap) — a denied fetch goes
+        back to the head of the queue and the broker re-pumps this
+        reader when credits release."""
         conf = self.manager.conf
+        broker = self._inflight
         while True:
             with self._pending_lock:
                 if not self._pending:
@@ -324,6 +347,28 @@ class ShuffleReader:
                     return
                 fetch = self._pending.pop(0)
                 self._bytes_in_flight += fetch.total_bytes
+            if broker is not None:
+                granted = broker.clamp(fetch.total_bytes)
+                cls = (
+                    INTERACTIVE
+                    if self._tenant is not None
+                    and self._tenant.interactive else BULK
+                )
+                seq = broker.release_seq
+                if not broker.try_acquire(granted, self._tenant, cls):
+                    # over share/quota: requeue at the head — the
+                    # broker's release pump retries this reader
+                    with self._pending_lock:
+                        self._bytes_in_flight -= fetch.total_bytes
+                        self._pending.insert(0, fetch)
+                    if broker.release_seq != seq:
+                        # a release's pump fired INSIDE our deny-and-
+                        # requeue window and saw an empty queue — that
+                        # wakeup was for us; retry now instead of
+                        # waiting for a release that may never come
+                        continue
+                    return
+                fetch.qos_granted = granted
             self._issue(fetch)
 
     def _send_hint(self, host: ShuffleManagerId) -> None:
@@ -370,6 +415,8 @@ class ShuffleReader:
         t0 = time.monotonic()
         progressed = [0]
         settled = [False]
+        broker = self._inflight
+        qos_left = [fetch.qos_granted]
 
         def on_progress(n):
             # stripe-granular window accounting: each landed stripe (or
@@ -385,6 +432,12 @@ class ShuffleReader:
                     return
                 progressed[0] += n
                 self._bytes_in_flight -= n
+                rel = min(n, qos_left[0])
+                qos_left[0] -= rel
+            if rel and broker is not None:
+                # brokered credits free per stripe too (outside the
+                # pending lock: the release's grant scan runs pumps)
+                broker.release(rel, self._tenant)
             self._pump()
 
         def settle():
@@ -396,6 +449,10 @@ class ShuffleReader:
                 left = fetch.total_bytes - progressed[0]
                 if left > 0:
                     self._bytes_in_flight -= left
+                rel = qos_left[0]
+                qos_left[0] = 0
+            if rel and broker is not None:
+                broker.release(rel, self._tenant)
 
         def on_success(blocks):
             latency = (time.monotonic() - t0) * 1000
@@ -443,6 +500,7 @@ class ShuffleReader:
                 fetch.locations,
                 FnCompletionListener(on_success, on_failure),
                 on_progress=on_progress,
+                tenant=self._tenant,
             )
         except Exception as e:
             on_failure(e)
@@ -540,6 +598,9 @@ class ShuffleReader:
             t.cancel()
         for cb_id in self._callback_ids:
             self.manager.unregister_fetch_callback(cb_id)
+        if self._pump_registered:
+            self._pump_registered = False
+            self._inflight.remove_pump(self._pump)
         if self._decode_stream is not None:
             # poison in-flight decodes: queued tickets cancel, credits
             # release — runs on normal exhaustion, FetchFailedError AND

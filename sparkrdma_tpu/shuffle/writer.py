@@ -509,6 +509,12 @@ class ShuffleWriter:
                 shuffle=self.handle.shuffle_id, map=self.map_id,
             ):
                 mto = self._commit()
+            # QoS admission (qos/registry.py): account the committed
+            # bytes under the tenant's registered-byte quota — an
+            # over-quota tenant queues briefly for earlier shuffles to
+            # release, then proceeds DEGRADED (narrower stripes,
+            # cold-tier serves) instead of OOMing the node
+            self.manager.qos_admit(self.handle, self.metrics.bytes_written)
             self._record_metrics()
             return mto
         finally:

@@ -451,6 +451,17 @@ class Dispatcher:
                             logger.exception(
                                 "submission raised on dispatcher loop")
                 self._flush_completions()
+                if len(events) > 1:
+                    # interactive-before-bulk within one event batch
+                    # (the qos/ scheduling-edge contract): RPC lanes,
+                    # accepts and handshakes service ahead of bulk
+                    # channels — stable sort, so per-class arrival
+                    # order (and per-channel frame order) is untouched
+                    events.sort(
+                        key=lambda km: not getattr(
+                            km[0].data, "latency_class", False
+                        )
+                    )
                 for key, mask in events:
                     handler = key.data
                     if handler is None:
@@ -1341,6 +1352,7 @@ class AsyncTcpChannel(Channel):
             self.node.submit_serve(
                 self._serve_read_async, (payload,),
                 wire._req_cost(payload), deferred=True,
+                mkey=wire._req_mkey(payload),
             )
         elif state == self._RESP_HDR:
             self._rx_resp_hdr()

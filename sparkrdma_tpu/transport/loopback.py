@@ -268,6 +268,7 @@ class LoopbackChannel(Channel):
         try:
             self.remote.submit_serve(
                 serve, (), cost=sum(loc.length for loc in locations),
+                mkey=locations[0].mkey if locations else None,
             )
         except BaseException as e:
             # remote node stopped (serve pool refused): fail fast like

@@ -29,6 +29,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.qos import (
+    BULK,
+    INTERACTIVE,
+    ClassedTaskQueue,
+    WeightedCreditBroker,
+    get_qos,
+)
 from sparkrdma_tpu.utils.dbglock import dbg_condition, dbg_lock
 from sparkrdma_tpu.transport.channel import (
     BlockStore,
@@ -87,25 +94,52 @@ def transport_census() -> Dict[str, object]:
 
 
 class _ServePool:
-    """Bounded read-serve pool: fixed worker threads drain a FIFO of
-    serve tasks under a byte-credit budget — the responder-side flow
-    control of the one-sided READ service.  A serve's cost is the
-    requested byte total; workers block until enough credits are free,
-    so a slow reducer draining many multi-MB responses can never pin
-    unbounded server memory (the serve holds its resolved block views
-    only while it owns credits).  A single serve larger than the whole
-    budget clamps to it and runs alone rather than deadlocking."""
+    """Bounded read-serve pool: fixed worker threads drain serve tasks
+    under a byte-credit budget — the responder-side flow control of
+    the one-sided READ service.  A serve's cost is the requested byte
+    total; workers block until enough credits are free, so a slow
+    reducer draining many multi-MB responses can never pin unbounded
+    server memory (the serve holds its resolved block views only while
+    it owns credits).  A single serve larger than the whole budget
+    clamps to it and runs alone rather than deadlocking.
+
+    Credits flow through a :class:`WeightedCreditBroker` (qos/): with
+    QoS off that is plain FIFO handoff over one budget (and the
+    explicit FIFO is itself the fairness fix — grants go to credit
+    waiters in arrival order, so a clamped oversized serve can no
+    longer be bypassed indefinitely by a stream of small serves that
+    happen to fit the remaining credits); with QoS on, tenants take
+    weighted max-min shares, interactive-class serves (small reads,
+    interactive tenants) dequeue AND acquire ahead of bulk, and aging
+    keeps bulk from starving."""
 
     def __init__(self, name: str, workers: int, credit_bytes: int,
-                 init_fn=None):
-        self._budget = max(int(credit_bytes), 1)
-        self._credits = self._budget  # guarded-by: _cv
-        self._cv = dbg_condition("node.serve_credits", 50)
-        self._queue: "queue.Queue" = queue.Queue()
+                 init_fn=None, conf: Optional[TpuShuffleConf] = None):
+        qos = (
+            get_qos() if conf is not None and conf.qos_enabled else None
+        )
+        self._qos = qos
+        self._interactive_bytes = (
+            conf.qos_interactive_bytes if conf is not None else 512 << 10
+        )
+        aging_ms = conf.qos_aging_ms if conf is not None else 100
+        # both conditions are created HERE (and handed to the qos/
+        # machinery) so their ranks land in this file's hierarchy
+        self._queue_cv = dbg_condition("node.serve_queue", 49)
+        self._queue = ClassedTaskQueue(
+            self._queue_cv,
+            classed=qos is not None, aging_ms=aging_ms,
+        )
         self._stopped = False
         self._m_depth = gauge("transport_serve_queue_depth")
         self._m_tasks = counter("transport_serve_tasks_total")
         self._m_credit_waits = counter("transport_serve_credit_waits_total")
+        self._cv = dbg_condition("node.serve_credits", 50)
+        self._broker = WeightedCreditBroker(
+            "serve", max(int(credit_bytes), 1), self._cv,
+            qos=qos, classed=qos is not None, aging_ms=aging_ms,
+            wait_counter=self._m_credit_waits,
+        )
         self._workers = [
             threading.Thread(
                 target=self._run, daemon=True, name=f"serve-{name}-{i}",
@@ -116,8 +150,20 @@ class _ServePool:
         for t in self._workers:
             t.start()
 
+    def _classify(self, cost: int, tenant, cls: Optional[str]) -> str:
+        if cls is not None:
+            return cls
+        if self._qos is None:
+            return BULK
+        if cost <= self._interactive_bytes:
+            return INTERACTIVE  # the small-read-lane lineage
+        if tenant is not None and tenant.interactive:
+            return INTERACTIVE
+        return BULK
+
     def submit(self, fn, args: tuple, cost: int,
-               deferred: bool = False) -> None:
+               deferred: bool = False, tenant=None,
+               cls: Optional[str] = None) -> None:
         """Never blocks the caller (channel reader loops and the async
         dispatcher post here).  ``deferred=True`` is the
         completion-driven contract: the worker calls
@@ -127,20 +173,22 @@ class _ServePool:
         resident serve memory without a worker blocked in the send."""
         if self._stopped:
             raise TransportError("serve pool stopped")
+        cost = max(int(cost), 0)
+        cls = self._classify(cost, tenant, cls)
         self._m_depth.inc()
-        self._queue.put((fn, args, max(int(cost), 0), deferred))
+        self._queue.put((fn, args, cost, deferred, tenant, cls), cls=cls)
 
-    def _make_release(self, cost: int):
-        """Idempotent credit return, safe from any thread."""
-        released = [False]  # guarded-by: _cv
+    def _make_release(self, cost: int, tenant):
+        """Idempotent credit return, safe from any thread (list.pop is
+        atomic under the GIL — exactly one caller wins the token)."""
+        token = [None]
 
         def release() -> None:
-            with self._cv:
-                if released[0]:
-                    return
-                released[0] = True
-                self._credits += cost
-                self._cv.notify_all()
+            try:
+                token.pop()
+            except IndexError:
+                return
+            self._broker.release(cost, tenant)
 
         return release
 
@@ -160,18 +208,12 @@ class _ServePool:
             if item is None:
                 return
             self._m_depth.dec()
-            fn, args, cost, deferred = item
-            cost = min(cost, self._budget)
-            with self._cv:
-                if self._credits < cost:
-                    self._m_credit_waits.inc()
-                while self._credits < cost and not self._stopped:
-                    self._cv.wait(timeout=0.5)
-                if self._stopped:
-                    return
-                self._credits -= cost
+            fn, args, cost, deferred, tenant, cls = item
+            cost = self._broker.clamp(cost)
+            if not self._broker.acquire(cost, tenant, cls):
+                return  # pool stopped while credit-waiting
             self._m_tasks.inc()
-            release = self._make_release(cost)
+            release = self._make_release(cost, tenant)
             try:
                 if deferred:
                     fn(*args, release)
@@ -185,21 +227,15 @@ class _ServePool:
                     release()
 
     def stop(self) -> None:
-        with self._cv:
-            self._stopped = True
-            self._cv.notify_all()
+        self._stopped = True
+        self._broker.stop()
         # abandon queued serves (their channels are tearing down) and
         # keep the queue-depth gauge honest for the next node in this
         # process
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None:
-                self._m_depth.dec()
+        for _item in self._queue.drain_nowait():
+            self._m_depth.dec()
         for _ in self._workers:
-            self._queue.put(None)
+            self._queue.put_sentinel()
         for t in self._workers:
             t.join(timeout=2.0)
 
@@ -213,25 +249,39 @@ class _LanePool:
     ``transportNumStripes`` dedicated sockets.  Borrowing never blocks:
     an empty pool means the read falls back to the peer's dedicated
     small-read lane, unstriped (narrower, never wrong).  Size 0 is the
-    unbounded pre-fabric sentinel."""
+    unbounded pre-fabric sentinel.
 
-    def __init__(self, size: int):
+    With QoS on, ``reserve`` lane tokens are withheld from BULK-class
+    borrows (qos/ priority grants): an interactive tenant's striped
+    read always finds width even while bulk fan-out saturates the
+    pool — the lane-scheduler half of the small-read-lane
+    generalization."""
+
+    def __init__(self, size: int, reserve: int = 0):
         self.size = max(int(size), 0)
+        # a reserve covering the whole pool would demote EVERY bulk
+        # read to the small lane — cap it below the pool size
+        self.reserve = (
+            min(max(int(reserve), 0), max(self.size - 1, 0))
+            if self.size else 0
+        )
         self._free = self.size  # guarded-by: _lock
         self._lock = dbg_lock("node.lane_pool", 45)
         self._m_in_use = gauge("transport_lane_pool_in_use")
         self._m_borrows = counter("transport_lane_borrows_total")
         self._m_exhausted = counter("transport_lane_pool_exhausted_total")
 
-    def try_borrow(self, want: int) -> int:
+    def try_borrow(self, want: int, cls: str = BULK) -> int:
         """Take up to ``want`` lane tokens without blocking; returns
-        how many were granted (0 when the pool is dry)."""
+        how many were granted (0 when the pool is dry).  BULK-class
+        borrows leave the interactive reserve untouched."""
         if want <= 0:
             return 0
         if self.size == 0:
             return want
+        floor = self.reserve if cls != INTERACTIVE else 0
         with self._lock:
-            got = min(want, self._free)
+            got = min(want, max(self._free - floor, 0))
             self._free -= got
         if got:
             self._m_in_use.inc(got)
@@ -286,9 +336,19 @@ class Node:
         self._use_seq = 0  # guarded-by: _active_lock
         self._evicted_keys: set = set()  # guarded-by: _active_lock
         self._max_cached = self.conf.transport_max_cached_channels
+        # multi-tenant QoS (qos/): the process-global tenant registry
+        # when policy is on for this node's conf — pools classify and
+        # broker through it; None keeps every edge plain FIFO
+        self.qos = get_qos() if self.conf.qos_enabled else None
         # fixed borrowable data-lane budget for striped reads
-        # (transport/stripe.py borrows per read, releases on completion)
-        self.lane_pool = _LanePool(self.conf.transport_lane_pool_size)
+        # (transport/stripe.py borrows per read, releases on completion);
+        # QoS withholds a reserve slice from bulk-class borrows
+        self.lane_pool = _LanePool(
+            self.conf.transport_lane_pool_size,
+            reserve=(
+                self.conf.qos_lane_reserve if self.qos is not None else 0
+            ),
+        )
         self._m_cached = gauge("transport_cached_channels")
         self._m_evictions = counter("transport_channel_evictions_total")
         self._m_evict_refusals = counter(
@@ -396,8 +456,29 @@ class Node:
         """Run fn on the dispatcher (async completion delivery)."""
         return self._dispatcher.submit(fn, *args)
 
+    def tenant_of_mkey(self, mkey) -> Optional[object]:
+        """Resolve the QoS tenant owning a registered segment: the
+        serve path classifies an incoming read by the TARGET block's
+        owner (mkey → segment → shuffle → tenant), so the responder
+        applies per-tenant policy with zero wire-format change.  None
+        without QoS, for unknown mkeys, or for unbound shuffles."""
+        qos = self.qos
+        if qos is None or mkey is None:
+            return None
+        with self._block_store_lock:
+            store = self._block_stores.get(mkey)
+        get = getattr(store, "get", None)  # ArenaManager-backed stores
+        if get is None:
+            return None
+        try:
+            seg = get(mkey)
+        except Exception:
+            return None
+        return qos.tenant_of_shuffle(getattr(seg, "shuffle_id", None))
+
     def submit_serve(self, fn, args: tuple = (), cost: int = 0,
-                     deferred: bool = False):
+                     deferred: bool = False, mkey=None,
+                     cls: Optional[str] = None):
         """Run one read serve on the node's bounded serve pool (created
         on first use; workers pin to ``dispatcherCpuList`` like the
         dispatcher).  ``cost`` is the serve's requested byte total —
@@ -405,7 +486,10 @@ class Node:
         ``deferred=True`` hands ``fn`` an idempotent ``release``
         callable that returns the credits (the async dispatcher's
         send-completion events release there instead of a worker
-        blocking through the send)."""
+        blocking through the send).  ``mkey`` (the read's first target
+        segment) resolves the owning tenant for QoS accounting;
+        ``cls`` pins the priority class (tier warms pass BULK so a
+        prefetch storm can never outrank demand serves)."""
         if self._stopped.is_set():
             raise TransportError(f"{self}: stopped")
         pool = self._serve_pool
@@ -417,9 +501,11 @@ class Node:
                         self.conf.transport_serve_threads,
                         self.conf.transport_serve_credit_bytes,
                         init_fn=self._pin_worker_thread,
+                        conf=self.conf,
                     )
                 pool = self._serve_pool
-        pool.submit(fn, args, cost, deferred)
+        pool.submit(fn, args, cost, deferred,
+                    tenant=self.tenant_of_mkey(mkey), cls=cls)
 
     def warm_blocks(self, locations) -> int:
         """Serve-side warm-before-read: promote the hinted block spans
@@ -439,7 +525,7 @@ class Node:
             try:
                 self.submit_serve(
                     tier.warm, (loc.mkey, loc.address, loc.length),
-                    cost=loc.length,
+                    cost=loc.length, mkey=loc.mkey, cls=BULK,
                 )
             except TransportError:
                 break  # node stopping: drop the remaining hints

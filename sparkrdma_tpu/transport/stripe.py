@@ -47,6 +47,7 @@ from typing import List, Sequence
 import numpy as np
 
 from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.transport.channel import (
     ChannelType,
     CompletionListener,
@@ -188,12 +189,16 @@ class ReadGroup:
         locations: Sequence[BlockLocation],
         listener: CompletionListener,
         on_progress=None,
+        tenant=None,
     ) -> None:
         """Same contract as ``Channel.read_blocks``: completion delivers
         one bytes-like payload per location, in order — striped blocks
         arrive as the full reassembled destination row (read-only
         ndarray), small ones exactly as a plain channel read returns
-        them."""
+        them.  ``tenant`` (qos/) shapes the lane borrow: interactive
+        tenants draw on the pool's reserved slice, and a DEGRADED
+        tenant (over its admission quota) narrows to one data lane —
+        correct, just no longer fanned out."""
         locations = list(locations)
         ch0 = self.channel(0)
         scatter = getattr(ch0, "supports_scatter", False)
@@ -206,8 +211,16 @@ class ReadGroup:
         if striped:
             # borrow this read's stripe width from the node-wide pool;
             # a dry pool demotes the read to the small lane, unstriped
+            want, cls = self.num_stripes, BULK
+            if tenant is not None:
+                if tenant.degraded:
+                    want = 1  # admission degrade: narrower stripes
+                    counter("qos_degraded_reads_total",
+                            tenant=tenant.name).inc()
+                if tenant.interactive:
+                    cls = INTERACTIVE
             lanes_borrowed = self.node.lane_pool.try_borrow(
-                self.num_stripes
+                want, cls=cls
             )
             if lanes_borrowed == 0:
                 striped = []

@@ -161,6 +161,20 @@ def _req_cost(payload: bytes) -> int:
         return 0
 
 
+def _req_mkey(payload: bytes):
+    """First target mkey of one OP_READ_REQ — the serve pool resolves
+    the owning QoS tenant from it (every location of one grouped read
+    belongs to one shuffle's output, so the first is representative).
+    None for malformed/empty requests."""
+    try:
+        _req_id, count = _REQ_HDR.unpack_from(payload, 0)
+        if count <= 0:
+            return None
+        return _LOC.unpack_from(payload, _REQ_HDR.size)[2]
+    except Exception:
+        return None
+
+
 class TcpChannel(Channel):
     """One TCP connection; either endpoint can carry RPC frames, the
     acceptor side additionally serves block reads."""
@@ -349,7 +363,8 @@ class TcpChannel(Channel):
                     # never starve heartbeat/RPC dispatch, and its
                     # byte credits bound resident serve memory
                     self.node.submit_serve(
-                        self._serve_read, (payload,), _req_cost(payload)
+                        self._serve_read, (payload,),
+                        _req_cost(payload), mkey=_req_mkey(payload),
                     )
                 else:
                     raise TransportError(f"unknown opcode {opcode}")

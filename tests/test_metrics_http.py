@@ -1,0 +1,146 @@
+"""Live metrics scrape endpoint (qos/http.py): bind an ephemeral
+port, run a tenant-labeled shuffle, scrape /metrics over real HTTP,
+parse the exposition, and verify clean shutdown leaks nothing into
+the transport census."""
+
+import json
+import threading
+import time
+import urllib.request
+from collections import defaultdict
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+from sparkrdma_tpu.qos.registry import GLOBAL_QOS
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.transport import LoopbackNetwork
+from sparkrdma_tpu.transport.node import transport_census
+
+BASE_PORT = 31500
+
+
+@pytest.fixture(autouse=True)
+def registries():
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_QOS.reset()
+    yield
+    GLOBAL_REGISTRY.enabled = prev
+    GLOBAL_QOS.enabled = False
+    GLOBAL_QOS.reset()
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        assert resp.status == 200
+        return resp.read()
+
+
+def _parse_prom(text: str) -> dict:
+    """Minimal exposition parse: series string → float value."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _sp, value = line.rpartition(" ")
+        out[series] = float(value)
+    return out
+
+
+def test_scrape_endpoint_live_tenant_labels_and_clean_shutdown():
+    census0 = transport_census()
+    net = LoopbackNetwork()
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": BASE_PORT,
+        "spark.shuffle.tpu.metricsHttpPort": 0,  # ephemeral bind
+        "spark.shuffle.tpu.qosEnabled": True,
+        "spark.shuffle.tpu.tenant": "scraped",
+    })
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    # metricsHttpPort implies metrics: the registry is live
+    assert GLOBAL_REGISTRY.enabled
+    assert driver.metrics_http is not None
+    port = driver.metrics_http.port
+    assert port > 0
+    executors = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=BASE_PORT + 100 + i * 10, executor_id=str(i),
+        )
+        for i in range(2)
+    ]
+    # in-process cluster: only the first manager wins the ephemeral
+    # bind race... every manager binds its own ephemeral port, all live
+    for e in executors:
+        assert e.metrics_http is not None
+        assert e.metrics_http.port not in (0, port)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == 2 for e in executors):
+            break
+        time.sleep(0.01)
+    try:
+        handle = driver.register_shuffle(3, 2, HashPartitioner(2))
+        maps_by_host = defaultdict(list)
+        for m in range(2):
+            w = executors[m].get_writer(handle, m)
+            w.write([(j % 7, j) for j in range(300)])
+            w.stop(True)
+            maps_by_host[executors[m].local_smid].append(m)
+        records = []
+        for p in range(2):
+            r = executors[(p + 1) % 2].get_reader(
+                handle, p, p + 1, dict(maps_by_host)
+            )
+            records.extend(r.read())
+        assert len(records) == 600
+
+        # live scrape MID-RUN (before any stop): text exposition
+        url = driver.metrics_http.url("/metrics")
+        series = _parse_prom(_get(url).decode("utf-8"))
+        assert series, "empty exposition"
+        tenant_series = [
+            s for s in series if 'tenant="scraped"' in s
+        ]
+        assert tenant_series, (
+            f"no tenant-labeled series in scrape: {sorted(series)[:20]}"
+        )
+        assert any(
+            s.startswith("qos_granted_bytes_total") for s in tenant_series
+        )
+        # JSON snapshot + tenants view on the same endpoint
+        snap = json.loads(_get(driver.metrics_http.url("/metrics.json")))
+        assert {"counters", "gauges", "histograms"} <= set(snap)
+        tenants = json.loads(_get(driver.metrics_http.url("/tenants")))
+        assert tenants["enabled"]
+        assert any(
+            t["name"] == "scraped" for t in tenants["tenants"]
+        )
+        assert str(handle.shuffle_id) in json.dumps(tenants["shuffles"])
+        # unknown path → 404, endpoint stays healthy after it
+        with pytest.raises(urllib.error.HTTPError):
+            _get(driver.metrics_http.url("/nope"))
+        assert _get(url)
+        driver.unregister_shuffle(3)
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+    # clean shutdown: the port no longer answers and no serving thread
+    # leaked (census + thread-name check)
+    with pytest.raises(Exception):
+        _get(f"http://127.0.0.1:{port}/metrics")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        leftover = [
+            t.name for t in threading.enumerate()
+            if t.name.startswith("metrics-http-")
+        ]
+        if not leftover:
+            break
+        time.sleep(0.05)
+    assert not leftover, f"scrape threads leaked: {leftover}"
+    census = transport_census()
+    assert census["transport_threads"] <= census0["transport_threads"]

@@ -1,14 +1,21 @@
 #!/usr/bin/env python
-"""Render a metrics-registry JSON snapshot as a human-readable table.
+"""Render a metrics-registry snapshot as a human-readable table.
 
 Usage:
-    python tools/metrics_report.py SNAPSHOT.json [BASELINE.json]
+    python tools/metrics_report.py SNAPSHOT [BASELINE] [--tenant NAME]
 
-With one argument, renders the snapshot (written by
-``TpuShuffleConf metricsJsonPath`` at manager stop, or
-``sparkrdma_tpu.metrics.write_json_snapshot``).  With two, renders
-``SNAPSHOT - BASELINE`` (counter/histogram deltas; gauges keep the new
-reading) so one run's activity can be isolated from a warm process.
+``SNAPSHOT``/``BASELINE`` each accept any of:
+
+- a JSON snapshot file (``metricsJsonPath`` at manager stop, or
+  ``sparkrdma_tpu.metrics.write_json_snapshot``),
+- a Prometheus text-exposition file (``metricsPromPath``, or a saved
+  ``curl`` of the live endpoint),
+- an ``http(s)://`` URL — scraped live from a running manager's
+  ``metricsHttpPort`` endpoint (qos/http.py).
+
+With a baseline, renders ``SNAPSHOT - BASELINE`` (counter/histogram
+deltas; gauges keep the new reading) so one run's activity can be
+isolated from a warm process.
 
 Histograms print count/sum plus approximate p50/p95/p99 interpolated
 from the bucket counts, and the nonzero buckets.
@@ -17,6 +24,11 @@ from the bucket counts, and the nonzero buckets.
 series, utils/dbglock.py) additionally render as one compact
 "lock hold times" table — one row per lock, sorted by total held time —
 so a snapshot diff shows exactly which locks a run leaned on.
+
+Tenant-labeled QoS series (qos/broker.py) render as a per-tenant
+summary table (bytes served/decoded, in-flight, credit-wait time,
+admission rejections, degraded flag); ``--tenant NAME`` narrows every
+table to that tenant's series.
 """
 
 from __future__ import annotations
@@ -104,6 +116,178 @@ def render_lock_holds(hists: list) -> list:
             f"p50~{_fmt_us(p50):>8}  p99~{_fmt_us(p99):>8}"
         )
     return out
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text exposition → the JSON-snapshot dict shape, so
+    a live scrape renders (and diffs) exactly like a stop-time
+    snapshot.  Histograms rebuild from their cumulative ``_bucket``
+    series (the ``+Inf`` bucket becomes the overflow count)."""
+    import re
+
+    kinds = {}
+    series = []  # (name, labels dict, value)
+    lab_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        series_str, _sp, value = line.rpartition(" ")
+        if not series_str:
+            continue
+        name, labels = series_str, {}
+        if "{" in series_str:
+            name, rest = series_str.split("{", 1)
+            labels = {
+                k: v.replace('\\"', '"').replace("\\\\", "\\")
+                for k, v in lab_re.findall(rest.rsplit("}", 1)[0])
+            }
+        try:
+            series.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    out = {"counters": [], "gauges": [], "histograms": []}
+    hists = {}
+    for name, labels, value in series:
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) \
+                    and kinds.get(name[: -len(suffix)]) == "histogram":
+                base = name[: -len(suffix)]
+                break
+        if base is not None:
+            key_labels = {k: v for k, v in labels.items() if k != "le"}
+            key = (base, tuple(sorted(key_labels.items())))
+            h = hists.setdefault(key, {
+                "name": base, "labels": key_labels,
+                "buckets": [], "sum": 0.0, "count": 0,
+            })
+            if name.endswith("_bucket"):
+                le = labels.get("le", "+Inf")
+                edge = float("inf") if le == "+Inf" else float(le)
+                h["buckets"].append((edge, value))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            else:
+                h["count"] = int(value)
+        elif kinds.get(name) == "gauge":
+            out["gauges"].append(
+                {"name": name, "labels": labels, "value": value}
+            )
+        else:
+            out["counters"].append(
+                {"name": name, "labels": labels, "value": value}
+            )
+    for h in hists.values():
+        h["buckets"].sort(key=lambda ev: ev[0])
+        edges = [e for e, _v in h["buckets"] if e != float("inf")]
+        counts, prev = [], 0.0
+        for _e, cum in h["buckets"]:
+            counts.append(int(cum - prev))
+            prev = cum
+        out["histograms"].append({
+            "name": h["name"], "labels": h["labels"], "edges": edges,
+            "counts": counts, "sum": h["sum"], "count": h["count"],
+        })
+    return out
+
+
+def load_snapshot(src: str) -> dict:
+    """Load a snapshot from a JSON file, a Prometheus text file, or a
+    live ``http(s)://`` scrape URL."""
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(src, timeout=10) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    else:
+        with open(src) as f:
+            text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        return parse_prometheus(text)
+
+
+def render_tenants(counters: list, gauges: list) -> list:
+    """Per-tenant QoS summary over the brokered instruments
+    (qos/broker.py, qos/registry.py): bytes served (serve pool) and
+    decoded (decode pool), live brokered in-flight bytes, total
+    credit-wait time, admission rejections, and the degraded flag."""
+    tenants: dict = {}
+
+    def row(name):
+        return tenants.setdefault(name, {
+            "served": 0.0, "decoded": 0.0, "inflight": 0.0,
+            "wait_ms": 0.0, "rejects": 0.0, "registered": 0.0,
+            "degraded": 0.0,
+        })
+
+    for c in counters:
+        labels = c.get("labels") or {}
+        t = labels.get("tenant")
+        if not t:
+            continue
+        r = row(t)
+        if c["name"] == "qos_granted_bytes_total":
+            pool = labels.get("pool", "")
+            if pool == "serve":
+                r["served"] += c["value"]
+            elif pool == "decode":
+                r["decoded"] += c["value"]
+        elif c["name"] == "qos_credit_wait_ms_total":
+            r["wait_ms"] += c["value"]
+        elif c["name"] == "qos_admission_rejections_total":
+            r["rejects"] += c["value"]
+    for g in gauges:
+        labels = g.get("labels") or {}
+        t = labels.get("tenant")
+        if not t:
+            continue
+        r = row(t)
+        if g["name"] == "qos_in_flight_bytes":
+            r["inflight"] += g["value"]
+        elif g["name"] == "qos_tenant_registered_bytes":
+            r["registered"] = g["value"]
+        elif g["name"] == "qos_tenant_degraded":
+            r["degraded"] = max(r["degraded"], g["value"])
+    if not tenants:
+        return []
+    width = max(len(t) for t in tenants) + 2
+    out = ["tenants (qos/)"]
+    for name in sorted(tenants):
+        r = tenants[name]
+        flag = "  DEGRADED" if r["degraded"] else ""
+        out.append(
+            f"  {name:<{width}}"
+            f"served={_fmt_num(r['served'])}B  "
+            f"decoded={_fmt_num(r['decoded'])}B  "
+            f"in-flight={_fmt_num(r['inflight'])}B  "
+            f"registered={_fmt_num(r['registered'])}B  "
+            f"credit-wait={_fmt_us(r['wait_ms'] * 1e3)}  "
+            f"admission-rejects={r['rejects']:,.0f}{flag}"
+        )
+    return out
+
+
+def filter_tenant(snap: dict, tenant: str) -> dict:
+    """Keep only series labeled with this tenant (the --tenant view)."""
+    def keep(rec):
+        return (rec.get("labels") or {}).get("tenant") == tenant
+
+    return {
+        "ts": snap.get("ts"),
+        "counters": [c for c in snap.get("counters", []) if keep(c)],
+        "gauges": [g for g in snap.get("gauges", []) if keep(g)],
+        "histograms": [
+            h for h in snap.get("histograms", []) if keep(h)
+        ],
+    }
 
 
 def render_decode_pipeline(counters: list) -> list:
@@ -204,6 +388,7 @@ def render(snap: dict, title: str = "") -> str:
     lock_hists = [h for h in all_hists if h["name"] == "lock_hold_us"]
     hists = [h for h in all_hists if h["name"] != "lock_hold_us"]
     lines.extend(render_lock_holds(lock_hists))
+    lines.extend(render_tenants(counters, gauges))
     lines.extend(render_decode_pipeline(counters))
     lines.extend(render_tier(counters, gauges))
     width = max(
@@ -251,17 +436,28 @@ def render(snap: dict, title: str = "") -> str:
 
 
 def main(argv) -> int:
-    if len(argv) not in (2, 3):
+    args = list(argv[1:])
+    tenant = None
+    if "--tenant" in args:
+        i = args.index("--tenant")
+        try:
+            tenant = args[i + 1]
+        except IndexError:
+            print("--tenant needs a name", file=sys.stderr)
+            return 2
+        del args[i : i + 2]
+    if len(args) not in (1, 2):
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    with open(argv[1]) as f:
-        snap = json.load(f)
-    title = f"metrics snapshot: {argv[1]}"
-    if len(argv) == 3:
-        with open(argv[2]) as f:
-            base = json.load(f)
+    snap = load_snapshot(args[0])
+    title = f"metrics snapshot: {args[0]}"
+    if len(args) == 2:
+        base = load_snapshot(args[1])
         snap = diff_snapshots(snap, base)
-        title += f" (diff vs {argv[2]})"
+        title += f" (diff vs {args[1]})"
+    if tenant is not None:
+        snap = filter_tenant(snap, tenant)
+        title += f" (tenant={tenant})"
     print(render(snap, title))
     return 0
 
