@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck test bench bench-smoke dryrun clean
+.PHONY: all native lint concheck flowcheck test bench bench-smoke dryrun clean
 
 all: native
 
@@ -9,16 +9,24 @@ native:
 
 # style gate failing the build — the checkstyle/scalastyle analog
 # (reference pom.xml:93-141 runs both at validate, failsOnError=true)
-# — plus the concurrency lock-discipline gate (tools/concheck.py)
+# — plus the concurrency lock-discipline gate (tools/concheck.py) and
+# the resource-lifecycle gate (tools/flowcheck.py)
 lint:
 	python tools/lint.py
 	python tools/concheck.py
+	python tools/flowcheck.py
 
 # the concurrency gate alone: lock-order cycles/rank inversions (CK01),
 # blocking-under-lock (CK02), guarded-by discipline (CK03), unranked
 # locks (CK04) across sparkrdma_tpu/
 concheck:
 	python tools/concheck.py
+
+# the resource-lifecycle gate alone: acquire-without-release (FC01),
+# double-release (FC02), release-without-acquire (FC03), undeclared
+# resources (FC04) across sparkrdma_tpu/
+flowcheck:
+	python tools/flowcheck.py
 
 test: native lint
 	python -m pytest tests/ -x -q

@@ -719,6 +719,20 @@ class TpuShuffleConf:
         return self._bool("lockDebug", False)
 
     @property
+    def resource_debug(self) -> bool:
+        """Runtime resource-lifecycle sanitizer (utils/ledger.py):
+        every annotated acquire of a countable resource (serve
+        credits, lane tokens, tier pins, window bytes, registered
+        bytes, fds, send descriptors) returns a ledger ticket with an
+        acquisition-site stack; double releases raise
+        DoubleReleaseError and manager.stop() renders a loud leak
+        report (``resource_leaked_total{resource=}``).  Off by default
+        — call sites then share one no-op ticket (zero overhead).  The
+        static half is tools/flowcheck.py; the manager flips the
+        process-global ledger on BEFORE building its node."""
+        return self._bool("resourceDebug", False)
+
+    @property
     def metrics_json_path(self) -> str:
         """When set, manager.stop() writes a JSON snapshot of the
         registry here (executors suffix ``.<executor_id>`` so

@@ -24,6 +24,7 @@ import numpy as np
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.transport.channel import BlockStore, TransportError
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -211,6 +212,8 @@ class ArenaManager(BlockStore):
         self._lock = dbg_lock("arena.segments", 82)
         self._next_mkey = 1  # 0 is reserved for BlockLocation.EMPTY
         self._total_bytes = 0  # guarded-by: _lock
+        # resource: arena.registered_bytes (device + file segment bytes)
+        self._tickets: Dict[int, object] = {}  # guarded-by: _lock
         # unbudgeted (file-backed mmap) segment bytes
         self._file_bytes = 0  # guarded-by: _lock
         # stats
@@ -260,6 +263,15 @@ class ArenaManager(BlockStore):
             else:
                 self._file_bytes += nbytes
             self._registered_ever += 1
+            # the segment's byte reservation rides the registry until an
+            # unregister path settles it
+            # owns: arena.registered_bytes -> release
+            # owns: arena.registered_bytes -> release_shuffle
+            # owns: arena.registered_bytes -> stop
+            # owns: arena.registered_bytes -> replace_with_span
+            self._tickets[mkey] = ledger_acquire(
+                "arena.registered_bytes", nbytes
+            )  # acquires: arena.registered_bytes
         self._m_registered.inc()
         self._m_bytes.inc(nbytes)
         return seg
@@ -280,6 +292,10 @@ class ArenaManager(BlockStore):
             self._segments[mkey] = seg
             self._file_bytes += seg.nbytes
             self._registered_ever += 1
+            # owns: arena.registered_bytes -> release
+            self._tickets[mkey] = ledger_acquire(
+                "arena.registered_bytes", seg.nbytes
+            )  # acquires: arena.registered_bytes
         self._m_registered.inc()
         self._m_bytes.inc(seg.nbytes)
         return seg
@@ -303,6 +319,10 @@ class ArenaManager(BlockStore):
             self._segments[mkey] = seg
             self._total_bytes += seg.nbytes
             self._registered_ever += 1
+            # owns: arena.registered_bytes -> release
+            self._tickets[mkey] = ledger_acquire(
+                "arena.registered_bytes", seg.nbytes
+            )  # acquires: arena.registered_bytes
         self._m_registered.inc()
         self._m_bytes.inc(seg.nbytes)
         return seg
@@ -336,10 +356,16 @@ class ArenaManager(BlockStore):
                     self._file_bytes -= old.nbytes
                 self._total_bytes += seg.nbytes
                 released = old
+                old_tkt = self._tickets.pop(mkey, NOOP_TICKET)
+                # owns: arena.registered_bytes -> release
+                self._tickets[mkey] = ledger_acquire(
+                    "arena.registered_bytes", seg.nbytes
+                )  # acquires: arena.registered_bytes
         if released is None:
             span.free()
             return None
         self._m_bytes.inc(seg.nbytes - released.nbytes)
+        old_tkt.release()  # releases: arena.registered_bytes
         released._release_keepalive()
         return seg
 
@@ -356,9 +382,11 @@ class ArenaManager(BlockStore):
                 else:
                     self._file_bytes -= seg.nbytes
                 self._released_ever += 1
+            tkt = self._tickets.pop(mkey, NOOP_TICKET)
         if seg is not None:
             self._m_released.inc()
             self._m_bytes.dec(seg.nbytes)
+            tkt.release()  # releases: arena.registered_bytes
             seg._release_keepalive()
 
     def release_shuffle(self, shuffle_id: int) -> int:
@@ -368,6 +396,7 @@ class ArenaManager(BlockStore):
             doomed = [k for k, s in self._segments.items()
                       if s.shuffle_id == shuffle_id]
             segs = [self._segments.pop(k) for k in doomed]
+            tkts = [self._tickets.pop(k, NOOP_TICKET) for k in doomed]
             for seg in segs:
                 if seg.budgeted:
                     self._total_bytes -= seg.nbytes
@@ -377,6 +406,8 @@ class ArenaManager(BlockStore):
         if segs:
             self._m_released.inc(len(segs))
             self._m_bytes.dec(sum(s.nbytes for s in segs))
+        for tkt in tkts:
+            tkt.release()  # releases: arena.registered_bytes
         for seg in segs:
             seg._release_keepalive()
         return len(segs)
@@ -431,10 +462,14 @@ class ArenaManager(BlockStore):
         with self._lock:
             segs = list(self._segments.values())
             self._segments.clear()
+            tkts = list(self._tickets.values())
+            self._tickets.clear()
             self._total_bytes = 0
             self._file_bytes = 0
         if segs:
             self._m_released.inc(len(segs))
             self._m_bytes.dec(sum(s.nbytes for s in segs))
+        for tkt in tkts:
+            tkt.release()  # releases: arena.registered_bytes
         for seg in segs:
             seg._release_keepalive()

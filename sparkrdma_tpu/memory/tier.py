@@ -47,6 +47,7 @@ import numpy as np
 from sparkrdma_tpu.memory.staging import alloc_row_gc
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.transport.channel import TransportError
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 
 logger = logging.getLogger(__name__)
 
@@ -66,7 +67,7 @@ class _Block:
     """Residency state of one partition block of one map output."""
 
     __slots__ = ("index", "offset", "length", "row", "pins", "seq",
-                 "loading", "prefetched", "touched")
+                 "loading", "prefetched", "touched", "hot_tkt")
 
     def __init__(self, index: int, offset: int, length: int):
         self.index = index
@@ -74,7 +75,8 @@ class _Block:
         self.length = length
         # all mutable state below guarded-by the owning store's _lock
         self.row: Optional[np.ndarray] = None  # hot: exact-length view
-        self.pins = 0           # live consumer views of the hot row
+        self.pins = 0  # resource: tier.pins (live consumer views)
+        self.hot_tkt = NOOP_TICKET  # this block's hot-byte reservation
         self.seq = 0            # LRU clock at last touch
         self.loading: Optional[threading.Event] = None
         self.prefetched = False  # promoted by prefetch, not yet read
@@ -195,7 +197,7 @@ class TieredBlockStore:
         # precedent, memory/staging.py)
         self._lock = threading.RLock()  # lock-order: 76
         self._by_mkey: Dict[int, TierEntry] = {}  # guarded-by: _lock
-        self._hot_bytes = 0  # guarded-by: _lock
+        self._hot_bytes = 0  # resource: tier.hot_bytes  # guarded-by: _lock
         self._hot: Dict[_Block, TierEntry] = {}  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
         self._m_hot = gauge("tier_hot_bytes")
@@ -340,6 +342,9 @@ class TieredBlockStore:
                 if ev is None and want_promote \
                         and self._reserve_locked(blk.length, entry=entry):
                     blk.loading = threading.Event()
+                    blk.hot_tkt = ledger_acquire(
+                        "tier.hot_bytes", blk.length
+                    )
                     ev = None
                     load = True
                 else:
@@ -452,6 +457,7 @@ class TieredBlockStore:
             self._seq += 1  # noqa: CK03 - held
             blk.seq = self._seq  # noqa: CK03 - held
             blk.loading = threading.Event()
+            blk.hot_tkt = ledger_acquire("tier.hot_bytes", blk.length)
             blk.prefetched = True
         self._m_prefetch_tasks.inc()
         row = None
@@ -509,15 +515,21 @@ class TieredBlockStore:
         Memory safety does NOT depend on the pin — the alloc_gc base
         chain keeps the row's pages alive under any surviving slice —
         the pin only stops eviction from demoting a block mid-serve."""
-        blk.pins += 1  # noqa: CK03 - caller holds _lock
+        blk.pins += 1  # acquires: tier.pins  # noqa: CK03 - caller holds _lock
+        tkt = ledger_acquire("tier.pins")
         v = blk.row[rel : rel + length].view()
         v.flags.writeable = False
-        weakref.finalize(v, self._unpin, blk)
+        weakref.finalize(v, self._unpin, blk, tkt)  # releases: tier.pins
         return v
 
-    def _unpin(self, blk: _Block) -> None:
+    def _unpin(self, blk: _Block, tkt=NOOP_TICKET) -> None:
         with self._lock:
             blk.pins -= 1
+        # settled OUTSIDE the store lock: a finalizer firing at
+        # interpreter shutdown (after the ledger epoch closed) must be
+        # a silent no-op, and a live one must never raise with the
+        # store lock held
+        tkt.release()
 
     def _tier_shares_locked(self, extra) -> Dict[str, float]:
         """The hot budget's weighted max-min shares over the tenants
@@ -569,7 +581,11 @@ class TieredBlockStore:
                                    requester=tenant)
             if self._hot_bytes + n > self.hot_budget:  # noqa: CK03 - held
                 return False
-        self._hot_bytes += n  # noqa: CK03 - caller holds _lock
+        # the reservation's release duty rides the block: installed
+        # rows settle through demotion, failed/raced loads roll back
+        # owns: tier.hot_bytes -> _demote_locked
+        # owns: tier.hot_bytes -> _finish_load
+        self._hot_bytes += n  # acquires: tier.hot_bytes  # noqa: CK03 - held
         if tenant is not None:
             self._hot_by_tenant[tenant.name] = (  # noqa: CK03 - held
                 self._hot_by_tenant.get(tenant.name, 0) + n  # noqa: CK03 - held
@@ -617,7 +633,9 @@ class TieredBlockStore:
     def _demote_locked(self, blk: _Block) -> None:
         entry = self._hot.pop(blk, None)  # noqa: CK03 - caller holds _lock
         blk.row = None  # cold tier is the source of truth: no write-back
-        self._hot_bytes -= blk.length  # noqa: CK03 - caller holds _lock
+        tkt, blk.hot_tkt = blk.hot_tkt, NOOP_TICKET
+        tkt.release()
+        self._hot_bytes -= blk.length  # releases: tier.hot_bytes  # noqa: CK03
         self._drop_hot_tenant_locked(
             entry.tenant if entry is not None else None, blk.length
         )
@@ -636,7 +654,9 @@ class TieredBlockStore:
                 self._hot[blk] = entry
             else:
                 # failed load, or the entry was released mid-load
-                self._hot_bytes -= blk.length
+                tkt, blk.hot_tkt = blk.hot_tkt, NOOP_TICKET
+                tkt.release()
+                self._hot_bytes -= blk.length  # releases: tier.hot_bytes
                 self._drop_hot_tenant_locked(entry.tenant, blk.length)
                 self._m_hot.dec(blk.length)
         if ev is not None:

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional
 
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.utils.ledger import ledger_acquire
 
 #: priority classes on the scheduling edges (qos/broker.py)
 INTERACTIVE = "interactive"
@@ -78,6 +79,8 @@ class TenantRegistry:
         self._shuffle_tenant: Dict[int, str] = {}  # guarded-by: _cv
         # shuffle → admitted registered bytes (released at unregister)
         self._admitted: Dict[int, int] = {}  # guarded-by: _cv
+        # resource: qos.admitted_bytes (per-shuffle admitted quota bytes)
+        self._admit_tkts: Dict[int, list] = {}  # guarded-by: _cv
 
     # -- tenants -------------------------------------------------------------
     def tenant(self, name: str, weight: Optional[int] = None,
@@ -147,6 +150,12 @@ class TenantRegistry:
             self._admitted[shuffle_id] = (
                 self._admitted.get(shuffle_id, 0) + nbytes
             )
+            # the admitted quota rides the shuffle until unregister
+            # owns: qos.admitted_bytes -> release_shuffle
+            # owns: qos.admitted_bytes -> reset
+            self._admit_tkts.setdefault(shuffle_id, []).append(
+                ledger_acquire("qos.admitted_bytes", nbytes)
+            )  # acquires: qos.admitted_bytes
             # an admit IS a binding: release_shuffle must find the
             # tenant even if bind_shuffle never ran in this process
             self._shuffle_tenant.setdefault(shuffle_id, tenant.name)
@@ -167,21 +176,23 @@ class TenantRegistry:
         mode and queued admissions re-check."""
         with self._cv:
             nbytes = self._admitted.pop(shuffle_id, 0)
+            tkts = self._admit_tkts.pop(shuffle_id, ())
             name = self._shuffle_tenant.pop(shuffle_id, None)
             t = self._tenants.get(name) if name is not None else None
-            if t is None:
-                return
-            t.registered_bytes = max(0, t.registered_bytes - nbytes)
-            if t.degraded and (
-                t.max_bytes <= 0 or t.registered_bytes <= t.max_bytes
-            ):
-                t.degraded = False
-            gauge("qos_tenant_registered_bytes",
-                  tenant=t.name).set(t.registered_bytes)
-            gauge("qos_tenant_degraded", tenant=t.name).set(
-                1 if t.degraded else 0
-            )
-            self._cv.notify_all()
+            if t is not None:
+                t.registered_bytes = max(0, t.registered_bytes - nbytes)
+                if t.degraded and (
+                    t.max_bytes <= 0 or t.registered_bytes <= t.max_bytes
+                ):
+                    t.degraded = False
+                gauge("qos_tenant_registered_bytes",
+                      tenant=t.name).set(t.registered_bytes)
+                gauge("qos_tenant_degraded", tenant=t.name).set(
+                    1 if t.degraded else 0
+                )
+                self._cv.notify_all()
+        for tkt in tkts:
+            tkt.release()  # releases: qos.admitted_bytes
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> Dict:
@@ -210,7 +221,11 @@ class TenantRegistry:
             self._tenants.clear()
             self._shuffle_tenant.clear()
             self._admitted.clear()
+            tkts = [t for ts in self._admit_tkts.values() for t in ts]
+            self._admit_tkts.clear()
             self._cv.notify_all()
+        for tkt in tkts:
+            tkt.release()  # releases: qos.admitted_bytes
 
 
 # the process-global registry; managers enable it from conf qosEnabled

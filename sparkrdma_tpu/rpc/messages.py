@@ -34,6 +34,7 @@ type  class                                  direction
  9    ExchangePlanMsg                        driver → executor
  10   PublishShuffleMetricsMsg               executor → driver
  11   PrefetchHintMsg                        reader → serving executor
+ 12   CleanShuffleMsg                        driver → all executors
 ====  =====================================  ===========================
 
 Types 8-9 carry the BULK-SYNCHRONOUS collective shuffle plan: after the
@@ -635,6 +636,34 @@ class PrefetchHintMsg(RpcMsg):
 
 
 @dataclass(frozen=True)
+class CleanShuffleMsg(RpcMsg):
+    """Driver tells every executor one shuffle is unregistered, so each
+    releases its OWN side of that shuffle — registered arena segments,
+    block-store mkeys, QoS-admitted quota bytes.  Without this the
+    executor's resources for a finished shuffle survive until manager
+    stop (the resource ledger flagged exactly that: committed map
+    segments outstanding long after the driver forgot the shuffle).
+    The reference gets this for free — Spark's ContextCleaner invokes
+    unregisterShuffle on every executor — but this control plane has
+    no external cleaner, so the driver's unregister broadcasts."""
+
+    shuffle_id: int
+
+    MSG_TYPE = 12
+
+    def _payload(self) -> bytes:
+        return struct.pack("<i", self.shuffle_id)
+
+    def _payload_size(self) -> int:
+        return 4
+
+    @staticmethod
+    def _decode_payload(view: memoryview) -> "CleanShuffleMsg":
+        (shuffle_id,) = struct.unpack_from("<i", view, 0)
+        return CleanShuffleMsg(shuffle_id)
+
+
+@dataclass(frozen=True)
 class ExchangePlanMsg(RpcMsg):
     """The driver's bulk-exchange plan: the canonical host order, the
     full (src × dst) stream-length matrix every host must agree on, and
@@ -742,5 +771,6 @@ MSG_TYPES: Dict[int, Type[RpcMsg]] = {
         ExchangePlanMsg,
         PublishShuffleMetricsMsg,
         PrefetchHintMsg,
+        CleanShuffleMsg,
     )
 }

@@ -47,6 +47,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.qos import CreditLedger
 from sparkrdma_tpu.utils.dbglock import dbg_condition
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.serde import as_view
 
 # blocks at or above this size are considered for frame-boundary
@@ -67,6 +68,7 @@ class DecodeTicket:
     __slots__ = (
         "_pool", "_stream", "_fn", "_data", "cost", "nbytes",
         "_state", "_held", "_event", "_result", "_error", "_abandoned",
+        "_tkt",
     )
 
     def __init__(self, pool: "DecodePool", stream: "DecodeStream",
@@ -79,6 +81,7 @@ class DecodeTicket:
         self.nbytes = cost
         self._state = _QUEUED
         self._held = 0
+        self._tkt = NOOP_TICKET  # this ticket's held-credit reservation
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -150,6 +153,8 @@ class DecodeTicket:
         if self._held:
             self._pool._ledger.put(self._stream._tenant, self._held)
             self._held = 0
+            tkt, self._tkt = self._tkt, NOOP_TICKET
+            tkt.release()  # releases: decode.credit_bytes  # one-shot
             self._pool._cv.notify_all()
         self._stream._tickets.discard(self)
 
@@ -293,6 +298,7 @@ class DecodePool:
         # credit policy core (qos/): weighted max-min per-tenant when
         # a registry is attached, a plain budget counter otherwise —
         # all access under _cv
+        # resource: decode.credit_bytes (held decode-ahead credits)
         self._ledger = CreditLedger("decode", self._budget, qos=qos)
         # tenants currently credit-waiting (name → (tenant, waiters)):
         # the ledger's reclaim-on-demand needs to see deprived waiters
@@ -390,6 +396,13 @@ class DecodePool:
                     continue
                 self._ledger.take(tenant, cost)
                 item._held = cost
+                # held until the consumer settles the ticket (get /
+                # discard / stream close / worker completion-after-
+                # abandon all funnel through _settle_locked)
+                # owns: decode.credit_bytes -> _settle_locked
+                item._tkt = ledger_acquire(
+                    "decode.credit_bytes", cost
+                )  # acquires: decode.credit_bytes
                 item._state = _DECODING
             t0 = time.monotonic()
             try:

@@ -41,6 +41,7 @@ from sparkrdma_tpu.utils.dbglock import dbg_lock, dbg_rlock
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
+    CleanShuffleMsg,
     ExchangePlanMsg,
     FetchExchangePlanMsg,
     FetchMapStatusFailedMsg,
@@ -248,6 +249,17 @@ class TpuShuffleManager:
             from sparkrdma_tpu.utils.dbglock import get_lock_factory
 
             get_lock_factory().enabled = True
+        if conf.resource_debug:
+            # and the resource-lifecycle ledger (utils/ledger.py):
+            # every annotated acquire from here on hands out a live
+            # ticket; stop() renders the leak report
+            from sparkrdma_tpu.utils.ledger import get_resource_ledger
+
+            get_resource_ledger().enabled = True
+            # register as an owner: in a multi-manager process only
+            # the LAST manager's stop flushes the leak report (the
+            # others' live channels are not leaks)
+            get_resource_ledger().retain()
         # multi-tenant QoS (qos/): flip the process-global tenant
         # registry on BEFORE building the node, exactly like the
         # metrics registry — the node's pools classify/broker through
@@ -575,6 +587,8 @@ class TpuShuffleManager:
             self._handle_shuffle_metrics(msg)
         elif isinstance(msg, PrefetchHintMsg):
             self._handle_prefetch_hint(msg)
+        elif isinstance(msg, CleanShuffleMsg):
+            self._handle_clean_shuffle(msg)
 
     # -- heartbeat / failure detection ---------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -1760,6 +1774,39 @@ class TpuShuffleManager:
             # back under quota leaves degraded mode, queued admissions
             # re-check
             self.qos.release_shuffle(shuffle_id)
+        if self.is_driver:
+            # broadcast so every executor releases its OWN side of the
+            # shuffle (registered segments, block-store mkeys, QoS
+            # quota): without this, executor resources for a finished
+            # shuffle survive until manager stop — the resource ledger
+            # (conf resourceDebug) flagged exactly that leak.  Best
+            # effort, like the membership announce: a lost clean only
+            # delays the release to the executor's stop sweep.
+            clean = CleanShuffleMsg(shuffle_id)
+            for peer in self.executors:
+                try:
+                    # no connect retries (the heartbeat posture): an
+                    # unregister racing executor teardown must not
+                    # stall the caller through the full reconnect
+                    # budget of a peer that is already gone
+                    self._send_via(
+                        (peer.host, peer.port), ChannelType.RPC_REQUESTOR,
+                        clean, on_failure=lambda e: None,
+                        must_retry=False,
+                    )
+                except Exception:
+                    logger.info(
+                        "driver: clean-shuffle %d to %s failed",
+                        shuffle_id, peer.host,
+                    )
+
+    def _handle_clean_shuffle(self, msg: CleanShuffleMsg) -> None:
+        """Executor side of the driver's unregister broadcast: run the
+        local unregister sweep (idempotent — every pop tolerates an
+        already-unknown shuffle, so a duplicate clean is a no-op)."""
+        if self.is_driver:
+            return  # drivers originate cleans, they don't follow them
+        self.unregister_shuffle(msg.shuffle_id)
 
     def remove_executor(self, smid: ShuffleManagerId) -> None:
         """Elastic membership pruning (reference onBlockManagerRemoved,
@@ -1919,3 +1966,13 @@ class TpuShuffleManager:
         # stragglers (adoption racing teardown) before the pool closes
         self.tier_store.stop()
         self.staging_pool.close()
+        if self.conf.resource_debug:
+            # leak report LAST, after every pool above returned its
+            # resources.  Non-raising here: GC-tied tier views may
+            # legitimately outlive the manager and settle their pins
+            # from finalizers (the ledger epoch-bumps so those late
+            # releases become silent no-ops); the raising form is for
+            # tests that fully drain first.
+            from sparkrdma_tpu.utils.ledger import get_resource_ledger
+
+            get_resource_ledger().stop(raise_on_leak=False)

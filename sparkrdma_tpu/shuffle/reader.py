@@ -32,6 +32,7 @@ from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.transport.channel import FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.serde import Record
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.utils.types import BlockLocation, ShuffleManagerId
@@ -105,6 +106,10 @@ class _PendingFetch:
     locations: List[BlockLocation]
     total_bytes: int
     qos_granted: int = 0
+    # resource-ledger tickets (utils/ledger.py) for the window bytes /
+    # brokered credits this fetch holds while on the wire
+    win_tkt: Any = NOOP_TICKET
+    qos_tkt: Any = NOOP_TICKET
 
 
 class _Result:
@@ -137,6 +142,7 @@ class ShuffleReader:
         self._results: "queue.Queue[_Result]" = queue.Queue()
         self._pending: List[_PendingFetch] = []  # guarded-by: _pending_lock
         self._pending_lock = dbg_lock("reader.pending", 30)
+        # resource: reader.inflight_bytes (windowed fetch bytes on the wire)
         self._bytes_in_flight = 0  # guarded-by: _pending_lock
         # non-empty remote blocks not yet delivered
         self._outstanding_blocks = 0  # guarded-by: _pending_lock
@@ -165,6 +171,7 @@ class ShuffleReader:
         # each holding a private maxBytesInFlight (None = QoS off,
         # the per-reader window alone throttles, exactly as before)
         self._tenant = manager.qos_tenant_for(handle)
+        # resource: reader.qos_inflight_bytes (brokered fetch credits)
         self._inflight = manager.qos_inflight_broker()
         self._pump_registered = False
         self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
@@ -347,6 +354,14 @@ class ShuffleReader:
                     return
                 fetch = self._pending.pop(0)
                 self._bytes_in_flight += fetch.total_bytes
+            # the window reservation rides the fetch: landed stripes
+            # release piecewise, the completion/failure settle closes
+            # the remainder
+            # owns: reader.inflight_bytes -> on_progress
+            # owns: reader.inflight_bytes -> settle
+            fetch.win_tkt = ledger_acquire(
+                "reader.inflight_bytes", fetch.total_bytes
+            )  # acquires: reader.inflight_bytes
             if broker is not None:
                 granted = broker.clamp(fetch.total_bytes)
                 cls = (
@@ -361,6 +376,8 @@ class ShuffleReader:
                     with self._pending_lock:
                         self._bytes_in_flight -= fetch.total_bytes
                         self._pending.insert(0, fetch)
+                    tkt, fetch.win_tkt = fetch.win_tkt, NOOP_TICKET
+                    tkt.release()  # releases: reader.inflight_bytes
                     if broker.release_seq != seq:
                         # a release's pump fired INSIDE our deny-and-
                         # requeue window and saw an empty queue — that
@@ -369,6 +386,11 @@ class ShuffleReader:
                         continue
                     return
                 fetch.qos_granted = granted
+                # owns: reader.qos_inflight_bytes -> on_progress
+                # owns: reader.qos_inflight_bytes -> settle
+                fetch.qos_tkt = ledger_acquire(
+                    "reader.qos_inflight_bytes", granted
+                )  # acquires: reader.qos_inflight_bytes
             self._issue(fetch)
 
     def _send_hint(self, host: ShuffleManagerId) -> None:
@@ -434,10 +456,12 @@ class ShuffleReader:
                 self._bytes_in_flight -= n
                 rel = min(n, qos_left[0])
                 qos_left[0] -= rel
+            fetch.win_tkt.release(n)  # releases: reader.inflight_bytes
             if rel and broker is not None:
                 # brokered credits free per stripe too (outside the
                 # pending lock: the release's grant scan runs pumps)
                 broker.release(rel, self._tenant)
+                fetch.qos_tkt.release(rel)  # releases: reader.qos_inflight_bytes
             self._pump()
 
         def settle():
@@ -451,8 +475,10 @@ class ShuffleReader:
                     self._bytes_in_flight -= left
                 rel = qos_left[0]
                 qos_left[0] = 0
+            fetch.win_tkt.release()  # releases: reader.inflight_bytes  # one-shot
             if rel and broker is not None:
                 broker.release(rel, self._tenant)
+            fetch.qos_tkt.release()  # releases: reader.qos_inflight_bytes  # one-shot
 
         def on_success(blocks):
             latency = (time.monotonic() - t0) * 1000

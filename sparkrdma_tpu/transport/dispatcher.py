@@ -83,6 +83,7 @@ from sparkrdma_tpu.transport.channel import (
 )
 from sparkrdma_tpu.transport import tcp as wire
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.types import BlockLocation
 
 logger = logging.getLogger(__name__)
@@ -173,7 +174,7 @@ class _SendOp:
     across partial sends, completed (on the completion queue) when the
     whole frame has been handed to the kernel."""
 
-    __slots__ = ("views", "i", "total", "frames", "on_done")
+    __slots__ = ("views", "i", "total", "frames", "on_done", "tkt")
 
     def __init__(self, views: List[memoryview], total: int, frames: int,
                  on_done=None):
@@ -182,6 +183,7 @@ class _SendOp:
         self.total = total          # wire bytes incl. headers
         self.frames = frames        # logical frames in this descriptor
         self.on_done = on_done      # callable(err-or-None) | None
+        self.tkt = NOOP_TICKET      # ledger ticket, set when queued
 
     def advance(self, n: int) -> None:
         while n and self.i < len(self.views):
@@ -732,6 +734,7 @@ class AsyncTcpChannel(Channel):
         # reach the wire without a loop hop; only the EAGAIN remainder
         # is left for the loop to drain on EVENT_WRITE.  The lock also
         # serializes the fd's close against in-flight writes.
+        # resource: dispatcher.send_ops (queued outbound descriptors)
         self._tx: Deque[_SendOp] = deque()  # guarded-by: _tx_lock
         self._tx_bytes = 0  # guarded-by: _tx_lock
         # True while a serve worker synchronously drains the tx queue
@@ -746,6 +749,8 @@ class AsyncTcpChannel(Channel):
         # never be closed out from under an unrelated socket
         self._fd_closed = False  # guarded-by: _tx_lock
         self._tx_lock = dbg_lock("adisp.tx", 71)
+        # owns: tcp.fds -> _close_fd_locked
+        self._fd_tkt = ledger_acquire("tcp.fds")  # acquires: tcp.fds
         # ---- loop-thread-only state (never touched off-loop) ----
         self._events = 0
         self._registered = False
@@ -807,10 +812,9 @@ class AsyncTcpChannel(Channel):
         try:
             disp.post(ch._loop_register)
         except TransportError:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            # settle through the single-owner close so the channel's
+            # fd accounting closes with the socket
+            ch._close_fd()
             raise
         return ch
 
@@ -871,6 +875,15 @@ class AsyncTcpChannel(Channel):
                 err = TransportError("channel stopped")
                 rejected = op
             else:
+                # a queued descriptor leaves the tx queue exactly once:
+                # fully written (_write_locked pops it) or swept by a
+                # teardown path that fails the queue
+                # owns: dispatcher.send_ops -> _write_locked
+                # owns: dispatcher.send_ops -> _fail_tx
+                # owns: dispatcher.send_ops -> _loop_fail
+                op.tkt = ledger_acquire(
+                    "dispatcher.send_ops"
+                )  # acquires: dispatcher.send_ops
                 self._tx.append(op)
                 self._tx_bytes += op.total
                 self._m_backlog.inc(op.total)
@@ -951,6 +964,7 @@ class AsyncTcpChannel(Channel):
                 self._m_backlog.dec(op.total)
                 self._m_msgs_sent.inc(op.frames)
                 self._m_bytes_sent.inc(op.total)
+                op.tkt.release()  # releases: dispatcher.send_ops
                 done_ops.append(op)
         return None
 
@@ -1007,6 +1021,8 @@ class AsyncTcpChannel(Channel):
         """Close the fd exactly once — caller holds ``_tx_lock``."""
         if not self._fd_closed:  # noqa: CK03 - caller holds _tx_lock
             self._fd_closed = True  # noqa: CK03 - caller holds _tx_lock
+            tkt, self._fd_tkt = self._fd_tkt, NOOP_TICKET
+            tkt.release()  # releases: tcp.fds  # one-shot
             try:
                 self._sock.close()
             except OSError:
@@ -1045,6 +1061,7 @@ class AsyncTcpChannel(Channel):
                 self._m_backlog.dec(self._tx_bytes)
             self._tx_bytes = 0
         for op in tx:
+            op.tkt.release()  # releases: dispatcher.send_ops
             if op.on_done is not None:
                 _safe(op.on_done, err)
 
@@ -1904,6 +1921,7 @@ class AsyncTcpChannel(Channel):
                 self._disp.complete(self._deliver, entry[1], None, err,
                                     entry[2])
         for op in tx:
+            op.tkt.release()  # releases: dispatcher.send_ops
             if op.on_done is not None:
                 self._disp.complete(op.on_done, err)
         self._disp.complete(self._on_loop_dead, err)

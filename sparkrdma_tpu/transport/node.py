@@ -37,6 +37,7 @@ from sparkrdma_tpu.qos import (
     get_qos,
 )
 from sparkrdma_tpu.utils.dbglock import dbg_condition, dbg_lock
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.transport.channel import (
     BlockStore,
     Channel,
@@ -135,6 +136,7 @@ class _ServePool:
         self._m_tasks = counter("transport_serve_tasks_total")
         self._m_credit_waits = counter("transport_serve_credit_waits_total")
         self._cv = dbg_condition("node.serve_credits", 50)
+        # resource: serve.credit_bytes
         self._broker = WeightedCreditBroker(
             "serve", max(int(credit_bytes), 1), self._cv,
             qos=qos, classed=qos is not None, aging_ms=aging_ms,
@@ -178,7 +180,7 @@ class _ServePool:
         self._m_depth.inc()
         self._queue.put((fn, args, cost, deferred, tenant, cls), cls=cls)
 
-    def _make_release(self, cost: int, tenant):
+    def _make_release(self, cost: int, tenant, tkt=NOOP_TICKET):
         """Idempotent credit return, safe from any thread (list.pop is
         atomic under the GIL — exactly one caller wins the token)."""
         token = [None]
@@ -188,7 +190,8 @@ class _ServePool:
                 token.pop()
             except IndexError:
                 return
-            self._broker.release(cost, tenant)
+            self._broker.release(cost, tenant)  # releases: serve.credit_bytes
+            tkt.release()
 
         return release
 
@@ -210,10 +213,16 @@ class _ServePool:
             self._m_depth.dec()
             fn, args, cost, deferred, tenant, cls = item
             cost = self._broker.clamp(cost)
-            if not self._broker.acquire(cost, tenant, cls):
+            # owns: serve.credit_bytes -> release  (every exit of the
+            # try below — including the deferred contract, where the
+            # callee's completion event settles it — funnels through
+            # the idempotent closure)
+            if not self._broker.acquire(  # acquires: serve.credit_bytes
+                    cost, tenant, cls):
                 return  # pool stopped while credit-waiting
             self._m_tasks.inc()
-            release = self._make_release(cost, tenant)
+            tkt = ledger_acquire("serve.credit_bytes", cost)
+            release = self._make_release(cost, tenant, tkt)
             try:
                 if deferred:
                     fn(*args, release)
@@ -265,7 +274,7 @@ class _LanePool:
             min(max(int(reserve), 0), max(self.size - 1, 0))
             if self.size else 0
         )
-        self._free = self.size  # guarded-by: _lock
+        self._free = self.size  # resource: node.lane_tokens  # guarded-by: _lock
         self._lock = dbg_lock("node.lane_pool", 45)
         self._m_in_use = gauge("transport_lane_pool_in_use")
         self._m_borrows = counter("transport_lane_borrows_total")

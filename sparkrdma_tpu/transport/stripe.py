@@ -55,6 +55,7 @@ from sparkrdma_tpu.transport.channel import (
     TransportError,
 )
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.ledger import ledger_acquire
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -221,7 +222,8 @@ class ReadGroup:
                     cls = INTERACTIVE
             lanes_borrowed = self.node.lane_pool.try_borrow(
                 want, cls=cls
-            )
+            )  # acquires: node.lane_tokens
+            # owns: node.lane_tokens -> release_lanes
             if lanes_borrowed == 0:
                 striped = []
         if not striped:
@@ -237,10 +239,12 @@ class ReadGroup:
         # when a caller's on_failure raises out of state.fail AFTER
         # the finish transition already returned the tokens.
         owed = [lanes_borrowed]
+        tkt = ledger_acquire("node.lane_tokens", lanes_borrowed)
 
         def release_lanes() -> None:
             n, owed[0] = owed[0], 0
-            self.node.lane_pool.release(n)
+            self.node.lane_pool.release(n)  # releases: node.lane_tokens  # one-shot
+            tkt.release(n)
 
         try:
             self._read_striped(

@@ -47,6 +47,7 @@ from sparkrdma_tpu.transport.channel import (
 )
 from sparkrdma_tpu.transport.node import Address, Node
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.types import BlockLocation
 
 logger = logging.getLogger(__name__)
@@ -186,7 +187,10 @@ class TcpChannel(Channel):
         super().__init__(channel_type, node.conf.send_queue_depth)
         self.node = node
         self.peer = peer
+        # resource: tcp.fds (one socket fd per live channel)
         self._sock = sock
+        # owns: tcp.fds -> _close_sock
+        self._fd_tkt = ledger_acquire("tcp.fds")  # acquires: tcp.fds
         self._sg = (
             node.conf.transport_scatter_gather
             and hasattr(sock, "sendmsg")
@@ -222,15 +226,25 @@ class TcpChannel(Channel):
         )
         self._reader.start()
 
+    def _close_sock(self) -> None:
+        """Settle this channel's fd exactly once — ``stop()`` and the
+        reader loop's peer-close path can both get here (the socket
+        object makes the second ``close()`` harmless; the ledger ticket
+        must still settle once, under the reads lock)."""
+        with self._reads_lock:
+            tkt, self._fd_tkt = self._fd_tkt, NOOP_TICKET
+        tkt.release()  # releases: tcp.fds  # one-shot
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
     def stop(self) -> None:
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._close_sock()
         err = TransportError("channel stopped")
         with self._reads_lock:
             reads = list(self._reads.values())
@@ -376,10 +390,7 @@ class TcpChannel(Channel):
                 # its end) must not leak THIS end's fd until node
                 # teardown: the reader thread is the socket's only
                 # consumer, so it owns the close on its way out
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
+                self._close_sock()
             # and a dead channel must not pin cache slots, the passive
             # list, or a stale read group for the node's lifetime
             self.node.on_channel_dead(self)

@@ -1,0 +1,266 @@
+"""Tier-1 wrapper + unit fixtures for the resource-lifecycle gate
+(tools/flowcheck.py): the real tree must be clean with a nonempty
+resource census, and seeded lifecycle bugs must each produce exactly
+their FC finding."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_flowcheck():
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_flowcheck", REPO / "tools" / "flowcheck.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _analyze_src(tmp_path, src: str):
+    fc = _load_flowcheck()
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(src))
+    return fc.analyze([f], root=tmp_path)
+
+
+def _codes(findings):
+    return sorted({code for _rel, _line, code, _msg in findings})
+
+
+# -- tier-1: the real tree ----------------------------------------------------
+
+
+def test_library_is_flowcheck_clean():
+    fc = _load_flowcheck()
+    findings = fc.analyze([REPO / "sparkrdma_tpu"])
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {code} {msg}" for rel, line, code, msg in findings
+    )
+
+
+def test_library_census_is_nonempty():
+    """Clean AND nonempty: the analyzer actually discovered the
+    resource population (a discovery regression would pass
+    vacuously).  The census floor is the annotated sweep: credits,
+    tokens, pins, registered bytes, fds, send descriptors."""
+    fc = _load_flowcheck()
+    an = fc.Analyzer()
+    findings = an.analyze_paths([REPO / "sparkrdma_tpu"])
+    assert not findings
+    assert len(an.decls) >= 10, sorted(an.decls)
+    n_acq = sum(len(f.acquires) for f in an.fns)
+    n_rel = sum(len(f.releases) for f in an.fns)
+    assert n_acq >= 10 and n_rel >= 10, (n_acq, n_rel)
+
+
+# -- FC01: acquire without release on all paths -------------------------------
+
+
+def test_fc01_acquire_without_release(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def leaky():
+            tok = POOL.pop()  # acquires: fix.tokens
+            return tok
+    """)
+    assert _codes(findings) == ["FC01"]
+
+
+def test_fc01_plain_release_is_not_all_paths(tmp_path):
+    """A release outside any finally does not run when the code
+    between acquire and release raises — still FC01."""
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def racy():
+            tok = POOL.pop()  # acquires: fix.tokens
+            work(tok)
+            POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert _codes(findings) == ["FC01"]
+
+
+def test_fc01_finally_release_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def safe():
+            tok = POOL.pop()  # acquires: fix.tokens
+            try:
+                work(tok)
+            finally:
+                POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert findings == []
+
+
+def test_fc01_finalizer_release_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import weakref
+        POOL = []  # resource: fix.pins
+
+        def pin(view, blk):
+            POOL.append(blk)  # acquires: fix.pins
+            weakref.finalize(view, unpin, blk)  # releases: fix.pins
+            return view
+    """)
+    assert findings == []
+
+
+def test_fc01_ownership_transfer_is_clean(tmp_path):
+    """An acquire whose release duty is handed to another function is
+    clean here; the receiver's release is then FC03-clean too."""
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def borrow():
+            # owns: fix.tokens -> give_back
+            tok = POOL.pop()  # acquires: fix.tokens
+            return tok
+
+        def give_back(tok):
+            POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert findings == []
+
+
+def test_fc01_noqa_escape(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def deliberate():
+            tok = POOL.pop()  # acquires: fix.tokens  # noqa: FC01
+            return tok
+    """)
+    assert findings == []
+
+
+# -- FC02: double release -----------------------------------------------------
+
+
+def test_fc02_double_release_same_suite(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def double(tok):
+            # owns: fix.tokens -> double
+            POOL.append(tok)  # releases: fix.tokens
+            POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert _codes(findings) == ["FC02"]
+
+
+def test_fc02_body_and_finally(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def double(tok):
+            # owns: fix.tokens -> double
+            try:
+                POOL.append(tok)  # releases: fix.tokens
+            finally:
+                POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert _codes(findings) == ["FC02"]
+
+
+def test_fc02_one_shot_guard_accepted(tmp_path):
+    """The swap-and-release idiom settles at most once per site even
+    when both sites run — `# one-shot` records the guard."""
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def settle(state):
+            # owns: fix.tokens -> settle
+            try:
+                tok, state.tok = state.tok, None
+                if tok:
+                    POOL.append(tok)  # releases: fix.tokens  # one-shot
+            finally:
+                tok, state.tok = state.tok, None
+                if tok:
+                    POOL.append(tok)  # releases: fix.tokens  # one-shot
+    """)
+    assert findings == []
+
+
+def test_fc02_branches_are_not_one_path(tmp_path):
+    """Releases on mutually exclusive branches are fine."""
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def either(tok, fast):
+            # owns: fix.tokens -> either
+            if fast:
+                POOL.append(tok)  # releases: fix.tokens
+            else:
+                POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert findings == []
+
+
+# -- FC03: release without acquire or transfer --------------------------------
+
+
+def test_fc03_release_never_acquired(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        def who_gave_me_this(tok):
+            POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert _codes(findings) == ["FC03"]
+
+
+def test_fc03_class_method_receiver(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        POOL = []  # resource: fix.tokens
+
+        class Pool:
+            def borrow(self):
+                # owns: fix.tokens -> Pool.put_back
+                tok = POOL.pop()  # acquires: fix.tokens
+                return tok
+
+            def put_back(self, tok):
+                POOL.append(tok)  # releases: fix.tokens
+    """)
+    assert findings == []
+
+
+# -- FC04: undeclared resources -----------------------------------------------
+
+
+def test_fc04_undeclared_resource(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        def mystery():
+            tok = grab()  # acquires: fix.ghost  # noqa: FC01
+            return tok
+    """)
+    assert _codes(findings) == ["FC04"]
+
+
+def test_fc04_undeclared_owns_target(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        def mystery():
+            # owns: fix.ghost -> elsewhere
+            return grab()
+    """)
+    assert _codes(findings) == ["FC04"]
+
+
+def test_grammar_examples_in_docstrings_are_ignored(tmp_path):
+    findings = _analyze_src(tmp_path, '''
+        def documented():
+            """Example annotation, not a live site::
+
+                x = grab()  # acquires: fix.not_a_resource
+                # owns: fix.not_a_resource -> elsewhere
+            """
+            return None
+    ''')
+    assert findings == []

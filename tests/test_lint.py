@@ -244,3 +244,69 @@ def test_noqa_code_followed_by_justification_prose(tmp_path):
     lint.lint_python(hot, findings, root=tmp_path)
     py10 = [line for _r, line, code, _m in findings if code == "PY10"]
     assert py10 == [4], findings
+
+
+def _py11_root(tmp_path, readme: str):
+    """A fake repo root: conf.py declaring two keys (one via a legacy
+    rdma rename), one library file referencing keys, and a README."""
+    lib = tmp_path / "sparkrdma_tpu"
+    lib.mkdir()
+    (lib / "conf.py").write_text(
+        'LEGACY_RENAMES = {"useOdp": "lazyStaging"}\n\n\n'
+        "class Conf:\n"
+        "    def a(self):\n"
+        '        self.get("tierHotBytes")\n'
+        '        return self._bool("lazyStaging", False)\n'
+    )
+    (tmp_path / "README.md").write_text(readme)
+    return lib
+
+
+def test_py11_flags_undeclared_key_reference(tmp_path):
+    lint = _load_lint()
+    lib = _py11_root(tmp_path, "`tierHotBytes` and `lazyStaging`\n")
+    (lib / "mod.py").write_text(
+        '"""Knobs: spark.shuffle.tpu.tierHotBytes is declared,\n'
+        "spark.shuffle.rdma.useOdp renames onto a declared key, but\n"
+        'spark.shuffle.tpu.ghostKnob is drift."""\n'
+    )
+    findings = []
+    lint.lint_conf_keys(findings, root=tmp_path)
+    assert [(str(r), line, code) for r, line, code, _m in findings] == [
+        ("sparkrdma_tpu/mod.py", 3, "PY11")
+    ], findings
+    assert "ghostKnob" in findings[0][3]
+
+
+def test_py11_noqa_suppresses_reference_finding(tmp_path):
+    lint = _load_lint()
+    lib = _py11_root(tmp_path, "`tierHotBytes` and `lazyStaging`\n")
+    (lib / "mod.py").write_text(
+        "# spark.shuffle.tpu.ghostKnob  # noqa: PY11 - doc of a removed key\n"
+    )
+    findings = []
+    lint.lint_conf_keys(findings, root=tmp_path)
+    assert findings == []
+
+
+def test_py11_flags_undocumented_declared_key(tmp_path):
+    lint = _load_lint()
+    # README documents tierHotBytes only: lazyStaging goes undocumented
+    _py11_root(tmp_path, "| `tierHotBytes` | 64m |\n")
+    findings = []
+    lint.lint_conf_keys(findings, root=tmp_path)
+    assert len(findings) == 1, findings
+    rel, _line, code, msg = findings[0]
+    assert code == "PY11" and "lazyStaging" in msg
+    assert str(rel) == "README.md"
+
+
+def test_py11_full_dotted_key_documents_too(tmp_path):
+    lint = _load_lint()
+    _py11_root(
+        tmp_path,
+        "spark.shuffle.tpu.tierHotBytes and spark.shuffle.tpu.lazyStaging\n",
+    )
+    findings = []
+    lint.lint_conf_keys(findings, root=tmp_path)
+    assert findings == []

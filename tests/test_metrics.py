@@ -332,3 +332,55 @@ def test_metrics_disabled_leaves_registry_empty(tmp_path):
     finally:
         GLOBAL_REGISTRY.enabled = prev
         GLOBAL_REGISTRY.reset()
+
+
+def test_prometheus_parse_round_trips_with_snapshot_render():
+    """A live Prometheus scrape must render (tools/metrics_report.py)
+    exactly like the stop-time JSON snapshot of the same registry:
+    snapshot → exposition text → parse_prometheus → render is the
+    identity on the rendered report, counters/gauges/histograms and
+    the resource-census series included."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_metrics_report",
+        REPO / "tools" / "metrics_report.py",
+    )
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("shuffle_write_bytes_total").inc(123456)
+    reg.counter("resource_acquires_total", resource="x.pins").inc(3)
+    reg.counter("resource_leaked_total", resource="x.pins").inc(1)
+    reg.counter("resource_double_release_total").inc(2)
+    reg.gauge("resource_outstanding", resource="x.pins").set(2)
+    reg.gauge("arena_bytes_in_use").set(4096)
+    h = reg.histogram("fetch_ms", edges=[1.0, 5.0, 25.0])
+    for v in (0.5, 3.0, 3.0, 17.0, 99.0):
+        h.observe(v)
+    hl = reg.histogram("lock_hold_us", edges=[10.0, 100.0], lock="arena")
+    for v in (4.0, 40.0, 400.0):
+        hl.observe(v)
+
+    snap = reg.snapshot()
+    parsed = report.parse_prometheus(to_prometheus(reg))
+    assert report.render(parsed) == report.render(snap)
+
+    # the parse reconstructed the exact series, not just the rendering
+    assert parsed["counters"] == snap["counters"]
+    assert parsed["gauges"] == snap["gauges"]
+    assert len(parsed["histograms"]) == len(snap["histograms"])
+    by_key = {
+        (h["name"], tuple(sorted((h.get("labels") or {}).items()))): h
+        for h in parsed["histograms"]
+    }
+    for want in snap["histograms"]:
+        got = by_key[
+            (want["name"],
+             tuple(sorted((want.get("labels") or {}).items())))
+        ]
+        assert got["edges"] == list(want["edges"])
+        assert got["counts"] == list(want["counts"])
+        assert got["count"] == want["count"]
+        assert got["sum"] == pytest.approx(want["sum"])

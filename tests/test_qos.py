@@ -487,3 +487,31 @@ def test_lock_debug_stress_with_brokers_active():
     from sparkrdma_tpu.utils.dbglock import get_lock_factory
 
     get_lock_factory().enabled = False
+
+
+def test_release_shuffle_without_known_tenant_still_returns_admits():
+    """Regression: ``release_shuffle`` used to early-return when the
+    shuffle's tenant could not be resolved, leaking the admit quota
+    (the resource ledger's ``qos.admitted_bytes`` tickets) forever.
+    An unresolvable tenant must still hand the admitted bytes back."""
+    from sparkrdma_tpu.qos.registry import Tenant
+    from sparkrdma_tpu.utils.ledger import get_resource_ledger
+
+    led = get_resource_ledger()
+    led.reset()
+    led.enabled = True
+    try:
+        qos = TenantRegistry(enabled=True)
+        # a tenant object the registry never saw: tenant_of_shuffle
+        # resolution fails at release time
+        stray = Tenant("ghost")
+        assert qos.admit(7, stray, 4096)
+        assert led.outstanding() == {"qos.admitted_bytes": 4096}
+        qos.release_shuffle(7)
+        assert led.outstanding() == {}
+        assert led.double_releases() == 0
+        qos.release_shuffle(7)  # duplicate clean (broadcast): no-op
+        assert led.double_releases() == 0
+    finally:
+        led.enabled = False
+        led.reset()

@@ -250,3 +250,13 @@ def test_exchange_plan_roundtrip_windowed():
     assert got2.window == -1 and got2.final is True and got2.my_maps == ()
     # size estimate stays exact with the new tail fields
     assert len(plan._payload()) == plan._payload_size()
+
+
+def test_clean_shuffle_roundtrip():
+    from sparkrdma_tpu.rpc.messages import CleanShuffleMsg
+
+    msg = CleanShuffleMsg(417)
+    out = decode_msg(msg.encode())
+    assert isinstance(out, CleanShuffleMsg)
+    assert out == msg
+    assert len(msg._payload()) == msg._payload_size()

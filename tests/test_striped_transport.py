@@ -467,3 +467,87 @@ def test_group_read_failure_converts_to_fetch_failed():
         a.stop()
         net.unregister(a)
         net.unregister(b)
+
+
+def test_failed_striped_read_with_raising_listener_keeps_lanes_balanced():
+    """Regression for the lane-token one-shot guard: a striped read
+    that FAILS (unknown mkey at the server) whose ``on_failure``
+    callback itself raises must still return every borrowed lane token
+    exactly once — the pool refills and the resource ledger shows no
+    outstanding ``node.lane_tokens`` and no double release."""
+    from sparkrdma_tpu.utils.ledger import get_resource_ledger
+
+    led = get_resource_ledger()
+    led.reset()
+    led.enabled = True
+    conf = _conf(2, "64k")
+    net, a, b, mkey = _pair(TcpNetwork, BASE_PORT + 320, conf)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        pool = a.lane_pool
+        free0 = pool._free
+        done = threading.Event()
+
+        def angry_failure(e):
+            done.set()
+            raise RuntimeError("listener exploded") from e
+
+        group.read_blocks(
+            [BlockLocation(0, 1 << 20, mkey + 4077)],  # bad mkey
+            FnCompletionListener(
+                lambda blocks: done.set(), angry_failure
+            ),
+        )
+        assert done.wait(15), "failed striped read hung"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (pool._free == free0
+                    and not led.outstanding().get("node.lane_tokens")):
+                break
+            time.sleep(0.02)
+        assert pool._free == free0, (pool._free, free0)
+        assert not led.outstanding().get("node.lane_tokens"), \
+            led.leak_report()
+        assert led.double_releases() == 0
+    finally:
+        _teardown(net, a, b)
+        led.enabled = False
+        led.reset()
+
+
+def test_serve_pool_queued_task_cancelled_at_stop_holds_no_credits():
+    """Regression for the serve-credit lifecycle: tasks still QUEUED
+    when the pool stops never acquired credits, so abandoning them
+    must leave zero ``serve.credit_bytes`` outstanding — and the one
+    in-flight task's deferred release still settles cleanly."""
+    from sparkrdma_tpu.transport.node import _ServePool
+    from sparkrdma_tpu.utils.ledger import get_resource_ledger
+
+    led = get_resource_ledger()
+    led.reset()
+    led.enabled = True
+    try:
+        pool = _ServePool("t", workers=1, credit_bytes=1 << 16)
+        started, unblock = threading.Event(), threading.Event()
+
+        def blocker():
+            started.set()
+            unblock.wait(10)
+
+        pool.submit(blocker, (), cost=1024)
+        assert started.wait(5), "serve worker never picked up the task"
+        for _ in range(4):  # queued behind the single busy worker
+            pool.submit(lambda: None, (), cost=1024)
+        pool.stop()  # abandons the queued serves
+        unblock.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if not led.outstanding().get("serve.credit_bytes"):
+                break
+            time.sleep(0.02)
+        assert not led.outstanding().get("serve.credit_bytes"), \
+            led.leak_report()
+        assert led.double_releases() == 0
+    finally:
+        led.enabled = False
+        led.reset()

@@ -372,3 +372,41 @@ def test_e2e_bit_exact_under_churn(netkind, port_off, prefetch):
             assert _counter("tier_hint_blocks_total") > 0
         for ex in executors:
             assert ex.tier_store.stats()["hot_bytes"] <= 24 << 10
+
+
+def test_pin_finalizer_lifecycle_live_and_after_ledger_stop():
+    """Regression for the GC-tied pin lifecycle: a live consumer view
+    settles its ``tier.pins`` ticket when collected, and a finalizer
+    firing AFTER the ledger stopped (interpreter-shutdown ordering:
+    the manager stops the ledger, then cyclic GC drops the last view)
+    is a silent no-op — never a DoubleReleaseError out of the GC."""
+    from sparkrdma_tpu.utils.ledger import get_resource_ledger
+
+    led = get_resource_ledger()
+    led.reset()
+    led.enabled = True
+    try:
+        store = TieredBlockStore(hot_bytes=1 << 20)
+        arena = ArenaManager()
+        seg, pattern = _make_entry(store, arena)
+        store.warm(seg.mkey, 0, 8192)
+        view = seg.read(0, 8192)  # hot: a pinned zero-copy view
+        assert isinstance(view, np.ndarray)
+        assert led.outstanding().get("tier.pins") == 1
+        del view
+        gc.collect()  # live finalizer: the pin settles
+        assert led.outstanding().get("tier.pins") is None
+        assert led.double_releases() == 0
+
+        late = seg.read(8192, 8192) if store.warm(
+            seg.mkey, 8192, 8192
+        ) else seg.read(0, 8192)
+        assert led.outstanding().get("tier.pins") == 1
+        led.stop(raise_on_leak=False)  # the manager stopped first
+        del late
+        gc.collect()  # late finalizer: stale epoch, silent no-op
+        assert led.double_releases() == 0
+        arena.release(seg.mkey)
+    finally:
+        led.enabled = False
+        led.reset()

@@ -1,0 +1,509 @@
+#!/usr/bin/env python
+"""Resource-lifecycle analyzer — the leak/double-release gate.
+
+``make lint`` runs this next to tools/lint.py and tools/concheck.py.
+The library hands out a deep hierarchy of countable resources — serve
+credits, lane tokens, tier view pins, reader in-flight window bytes,
+arena registered bytes, accepted/connected fds, dispatcher send
+descriptors, QoS admitted bytes — and the review history shows nearly
+every one of them shipped (or nearly shipped) a lifecycle bug found by
+hand.  fabric-lib makes descriptor lifecycle — post, complete exactly
+once, never leak a posted op — the core correctness contract of an
+RDMA transport; this pass turns that contract into a machine-checked
+invariant, exactly as concheck/dbglock did for lock ordering.  The
+runtime half is sparkrdma_tpu/utils/ledger.py (conf
+``spark.shuffle.tpu.resourceDebug``).
+
+Every resource is DECLARED once (the census registry) and every
+acquire/release site carries a trailing annotation; the pass checks:
+
+  FC01  acquire without release on all paths: a function that acquires
+        a declared resource must release it in a ``finally`` suite, or
+        register the release as a finalizer (a ``*.finalize(...)`` call
+        annotated as the release site), or explicitly hand the duty on
+        with an ownership-transfer annotation
+        ``# owns: <resource> -> <function-or-Class.method>``.
+  FC02  double release: two releases of the same resource reachable on
+        one path — sequentially in one suite, or once in a try body /
+        except handler AND again in that try's ``finally`` — without a
+        ``# one-shot`` guard annotation on either site.
+  FC03  release under wrong conditions: a function releases a resource
+        it never acquired, and no ownership-transfer annotation
+        anywhere in the tree names it as the receiver.
+  FC04  unannotated resource: an ``# acquires:`` / ``# releases:`` /
+        ``# owns:`` annotation names a resource that no
+        ``# resource:`` declaration registers — the census must stay
+        complete (the CK04 idiom).
+
+Annotation grammar::
+
+    self._pool = _LanePool(n)        # resource: node.lane_tokens
+    got = pool.try_borrow(want)      # acquires: node.lane_tokens
+    pool.release(got)                # releases: node.lane_tokens
+    weakref.finalize(v, unpin, b)    # releases: tier.pins
+    token.pop().release()            # releases: serve.credits  # one-shot
+    n = pool.try_borrow(w)  # acquires: x  # owns: x -> release_lanes
+
+``# acquires:`` / ``# releases:`` take a comma-separated resource
+list and must trail the statement (any line of a multi-line
+statement's span).  ``# owns:`` may trail any statement line of the
+owning function; the named receiver is matched by bare function name
+or ``Class.method``.  A ``# one-shot`` on a release statement marks a
+guarded (at-most-once) release closure, escaping FC02.
+
+Suppressions are code-scoped: ``# noqa: FC01`` silences only FC01 on
+that line; a bare ``# noqa`` silences everything (discouraged).
+
+Usage: ``python tools/flowcheck.py [paths...]`` (default: the
+library).  Exit status 1 on any finding; on success prints the
+resource census.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+LIB = ROOT / "sparkrdma_tpu"
+
+_NAME = r"[A-Za-z_][A-Za-z0-9_.\-]*"
+RES_RE = re.compile(rf"#\s*resource:\s*({_NAME})")
+ACQ_RE = re.compile(rf"#\s*acquires:\s*({_NAME}(?:\s*,\s*{_NAME})*)")
+REL_RE = re.compile(rf"#\s*releases:\s*({_NAME}(?:\s*,\s*{_NAME})*)")
+OWNS_RE = re.compile(
+    rf"#\s*owns:\s*({_NAME})\s*->\s*([A-Za-z_][A-Za-z0-9_.]*)"
+)
+ONESHOT_RE = re.compile(r"#\s*one-shot\b")
+
+# ONE noqa grammar + suppression decision for all three gates:
+# tools/lint.py owns the definition (code-scoped sets, bare-noqa =
+# everything, alias handling)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from lint import _suppressed as _lint_suppressed  # noqa: E402
+
+Finding = Tuple[object, int, str, str]  # (rel, line, code, message)
+
+
+class _Suppressor:
+    def __init__(self, lines: List[str]):
+        self._lines = lines
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        return _lint_suppressed(self._lines, lineno, code)
+
+
+class Site:
+    """One annotated acquire or release statement."""
+
+    __slots__ = ("resources", "line", "in_finally", "suite_id",
+                 "prot_trys", "fin_trys", "one_shot", "is_finalizer")
+
+    def __init__(self, resources: List[str], line: int,
+                 in_finally: bool, suite_id: int,
+                 prot_trys: frozenset, fin_trys: frozenset,
+                 one_shot: bool, is_finalizer: bool):
+        self.resources = resources
+        self.line = line
+        self.in_finally = in_finally
+        self.suite_id = suite_id
+        self.prot_trys = prot_trys  # try-nodes this site is protected by
+        self.fin_trys = fin_trys    # try-nodes whose finally holds it
+        self.one_shot = one_shot
+        self.is_finalizer = is_finalizer
+
+
+class FnInfo:
+    """Lifecycle sites of one function/method (nested defs get their
+    own FnInfo under their actual def name, so closure receivers like
+    ``release_lanes`` are addressable ownership-transfer targets)."""
+
+    def __init__(self, rel: str, cls_name: str, fn_name: str,
+                 line: int):
+        self.rel = rel
+        self.cls_name = cls_name
+        self.fn_name = fn_name
+        self.line = line
+        self.acquires: List[Site] = []
+        self.releases: List[Site] = []
+        # resource -> receiver names this function hands the duty to
+        self.owns: Dict[str, Set[str]] = {}
+        self.owns_lines: Dict[str, int] = {}
+
+
+_COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+             ast.AsyncWith, ast.Try)
+
+
+def _split_names(spec: str) -> List[str]:
+    return [s.strip() for s in spec.split(",") if s.strip()]
+
+
+def _stmt_header_span(stmt: ast.stmt) -> Tuple[int, int]:
+    """Line span carrying a statement's trailing annotation: the whole
+    span for simple statements, only the header line(s) for compound
+    ones (their bodies' annotations belong to the inner statements)."""
+    if isinstance(stmt, _COMPOUND):
+        first_body = stmt.body[0].lineno if stmt.body else stmt.lineno
+        return stmt.lineno, max(stmt.lineno, first_body - 1)
+    return stmt.lineno, stmt.end_lineno or stmt.lineno
+
+
+def _span_find(pattern: re.Pattern, lines: List[str], lo: int,
+               hi: int, skip: Set[int] = frozenset()
+               ) -> Optional[re.Match]:
+    for i in range(lo, hi + 1):
+        if i <= len(lines) and i not in skip:
+            m = pattern.search(lines[i - 1])
+            if m is not None:
+                return m
+    return None
+
+
+def _is_docstring(stmt: ast.stmt) -> bool:
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str))
+
+
+def _has_finalize_call(stmt: ast.stmt) -> bool:
+    """The statement registers a finalizer (``weakref.finalize(...)``
+    or any ``*.finalize(...)`` / ``finalize(...)`` call) — a release
+    annotation on it means 'released by the finalizer', which counts
+    as released-on-all-paths for FC01."""
+    if isinstance(stmt, _COMPOUND):
+        return False
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name == "finalize":
+                return True
+    return False
+
+
+class _FnWalk:
+    """Walk one function body collecting annotated lifecycle sites
+    with their control-flow context (finally-ness, suite identity,
+    try-structure membership).  Nested defs are queued and scanned as
+    their own functions; their line spans are excluded from this
+    function's ownership-transfer scan."""
+
+    def __init__(self, mod: "ModuleInfo", info: FnInfo):
+        self.mod = mod
+        self.info = info
+        self.suite_counter = 0
+        self.nested: List[ast.stmt] = []
+
+    def walk_suite(self, body: List[ast.stmt], in_finally: bool,
+                   prot: frozenset, fin: frozenset) -> None:
+        self.suite_counter += 1
+        sid = self.suite_counter
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.nested.append(stmt)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue  # methods scanned under their own class pass
+            if not _is_docstring(stmt):
+                self._collect_sites(stmt, in_finally, sid, prot, fin)
+            self._recurse(stmt, in_finally, prot, fin)
+
+    def _collect_sites(self, stmt: ast.stmt, in_finally: bool,
+                       sid: int, prot: frozenset,
+                       fin: frozenset) -> None:
+        lo, hi = _stmt_header_span(stmt)
+        lines = self.mod.lines
+        skip = self.mod.string_lines
+        acq = _span_find(ACQ_RE, lines, lo, hi, skip)
+        rel = _span_find(REL_RE, lines, lo, hi, skip)
+        if acq is None and rel is None:
+            return
+        one_shot = _span_find(ONESHOT_RE, lines, lo, hi, skip) is not None
+        if acq is not None:
+            self.info.acquires.append(Site(
+                _split_names(acq.group(1)), lo, in_finally, sid,
+                prot, fin, one_shot, False,
+            ))
+        if rel is not None:
+            self.info.releases.append(Site(
+                _split_names(rel.group(1)), lo, in_finally, sid,
+                prot, fin, one_shot, _has_finalize_call(stmt),
+            ))
+
+    def _recurse(self, stmt: ast.stmt, in_finally: bool,
+                 prot: frozenset, fin: frozenset) -> None:
+        if isinstance(stmt, ast.Try):
+            tid = id(stmt)
+            tprot = prot | {tid}
+            self.walk_suite(stmt.body, in_finally, tprot, fin)
+            for h in stmt.handlers:
+                self.walk_suite(h.body, in_finally, tprot, fin)
+            self.walk_suite(stmt.orelse, in_finally, tprot, fin)
+            self.walk_suite(stmt.finalbody, True, prot, fin | {tid})
+        elif isinstance(stmt, (ast.If, ast.While, ast.For,
+                               ast.AsyncFor)):
+            self.walk_suite(stmt.body, in_finally, prot, fin)
+            self.walk_suite(stmt.orelse, in_finally, prot, fin)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.walk_suite(stmt.body, in_finally, prot, fin)
+        elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+            for case in stmt.cases:
+                self.walk_suite(case.body, in_finally, prot, fin)
+
+
+def _string_lines(tree: ast.Module) -> Set[int]:
+    """Lines covered by multi-line string constants (docstrings,
+    embedded text): annotation grammar EXAMPLES live there — never
+    live annotations — so every scan skips these lines."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str) \
+                and node.end_lineno is not None \
+                and node.end_lineno > node.lineno:
+            out.update(range(node.lineno, node.end_lineno + 1))
+    return out
+
+
+class ModuleInfo:
+    def __init__(self, rel: str, lines: List[str], tree: ast.Module):
+        self.rel = rel
+        self.lines = lines
+        self.tree = tree
+        self.string_lines = _string_lines(tree)
+
+
+class Analyzer:
+    def __init__(self, root: pathlib.Path = ROOT):
+        self.root = root
+        self.findings: List[Finding] = []
+        self.modules: Dict[str, ModuleInfo] = {}
+        # resource name -> first declaration site (rel, line)
+        self.decls: Dict[str, Tuple[str, int]] = {}
+        self.fns: List[FnInfo] = []
+        # resource -> receiver names granted the release duty
+        self.owns_targets: Dict[str, Set[str]] = {}
+        self._sups: Dict[str, _Suppressor] = {}
+
+    def emit(self, rel: str, line: int, code: str, msg: str) -> None:
+        sup = self._sups.get(rel)
+        if sup is not None and sup.suppressed(line, code):
+            return
+        self.findings.append((rel, line, code, msg))
+
+    # -- entry points --------------------------------------------------------
+    def analyze_paths(self, paths) -> List[Finding]:
+        files: List[pathlib.Path] = []
+        for p in paths:
+            p = pathlib.Path(p)
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            else:
+                files.append(p)
+        for f in files:
+            self._load(f)
+        for mod in self.modules.values():
+            self._scan_module(mod)
+        self._rule_checks()
+        self.findings.sort(key=lambda x: (str(x[0]), x[1], x[2]))
+        return self.findings
+
+    def _rel(self, path: pathlib.Path) -> str:
+        try:
+            return str(path.relative_to(self.root))
+        except ValueError:
+            return str(path)
+
+    def _load(self, path: pathlib.Path) -> None:
+        rel = self._rel(path)
+        try:
+            text = path.read_text()
+            tree = ast.parse(text)
+        except (UnicodeDecodeError, SyntaxError):
+            return  # tools/lint.py owns PY01
+        lines = text.splitlines()
+        self._sups[rel] = _Suppressor(lines)
+        self.modules[rel] = ModuleInfo(rel, lines, tree)
+        # pass 1: the declaration registry (raw-line scan — a
+        # declaration may trail any statement, including class bodies)
+        mod = self.modules[rel]
+        for i, line in enumerate(lines, 1):
+            if i in mod.string_lines:
+                continue
+            m = RES_RE.search(line)
+            if m is not None:
+                self.decls.setdefault(m.group(1), (rel, i))
+
+    # -- pass 2: per-function site collection --------------------------------
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self._scan_fn(mod, "", stmt)
+        for stmt in ast.walk(mod.tree):
+            if isinstance(stmt, ast.ClassDef):
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._scan_fn(mod, stmt.name, item)
+
+    def _scan_fn(self, mod: ModuleInfo, cls_name: str, node) -> None:
+        queued = [node]
+        seen = 0
+        while seen < len(queued):
+            fn = queued[seen]
+            seen += 1
+            info = FnInfo(mod.rel, cls_name, fn.name, fn.lineno)
+            walker = _FnWalk(mod, info)
+            walker.walk_suite(fn.body, False, frozenset(), frozenset())
+            self._collect_owns(mod, info, fn, walker.nested)
+            self.fns.append(info)
+            queued.extend(walker.nested)
+
+    def _collect_owns(self, mod: ModuleInfo, info: FnInfo, fn,
+                      nested: List[ast.stmt]) -> None:
+        """Ownership-transfer annotations over the function's own line
+        span, excluding nested defs' spans (those own their lines)."""
+        skip: Set[int] = set(mod.string_lines)
+        for n in nested:
+            skip.update(range(n.lineno, (n.end_lineno or n.lineno) + 1))
+        for i in range(fn.lineno, (fn.end_lineno or fn.lineno) + 1):
+            if i in skip or i > len(mod.lines):
+                continue
+            for m in OWNS_RE.finditer(mod.lines[i - 1]):
+                resource, target = m.group(1), m.group(2)
+                info.owns.setdefault(resource, set()).add(target)
+                info.owns_lines.setdefault(resource, i)
+                self.owns_targets.setdefault(resource, set()).add(
+                    target
+                )
+
+    # -- rule evaluation -----------------------------------------------------
+    def _rule_checks(self) -> None:
+        for fn in self.fns:
+            self._check_fc04(fn)
+            self._check_fc01(fn)
+            self._check_fc02(fn)
+            self._check_fc03(fn)
+
+    def _check_fc04(self, fn: FnInfo) -> None:
+        for site in fn.acquires + fn.releases:
+            for r in site.resources:
+                if r not in self.decls:
+                    self.emit(
+                        fn.rel, site.line, "FC04",
+                        f"annotation names undeclared resource {r} — "
+                        f"register it with a '# resource: {r}' "
+                        f"declaration so the census stays complete",
+                    )
+        for r, line in fn.owns_lines.items():
+            if r not in self.decls:
+                self.emit(
+                    fn.rel, line, "FC04",
+                    f"ownership transfer names undeclared resource "
+                    f"{r} — register it with a '# resource: {r}' "
+                    f"declaration",
+                )
+
+    def _check_fc01(self, fn: FnInfo) -> None:
+        for site in fn.acquires:
+            for r in site.resources:
+                if r not in self.decls:
+                    continue  # FC04 already said it
+                released = any(
+                    r in rs.resources
+                    and (rs.in_finally or rs.is_finalizer)
+                    for rs in fn.releases
+                )
+                if released or r in fn.owns:
+                    continue
+                self.emit(
+                    fn.rel, site.line, "FC01",
+                    f"{r} acquired here but not released on all "
+                    f"paths — release it in a finally, register the "
+                    f"release as a finalizer, or annotate the "
+                    f"handoff with '# owns: {r} -> <receiver>'",
+                )
+
+    def _check_fc02(self, fn: FnInfo) -> None:
+        by_res: Dict[str, List[Site]] = {}
+        for site in fn.releases:
+            if site.is_finalizer:
+                continue  # a registration, not an immediate release
+            for r in site.resources:
+                by_res.setdefault(r, []).append(site)
+        for r, sites in by_res.items():
+            sites.sort(key=lambda s: s.line)
+            for i, a in enumerate(sites):
+                for b in sites[i + 1:]:
+                    if a.one_shot or b.one_shot:
+                        continue
+                    if a.suite_id == b.suite_id:
+                        self.emit(
+                            fn.rel, b.line, "FC02",
+                            f"{r} released twice on one path (also "
+                            f"released at line {a.line}) — guard one "
+                            f"site or annotate the guarded closure "
+                            f"with '# one-shot'",
+                        )
+                    elif a.prot_trys & b.fin_trys:
+                        self.emit(
+                            fn.rel, b.line, "FC02",
+                            f"{r} released in this finally AND in its "
+                            f"protected region (line {a.line}) — both "
+                            f"run on the non-raising path; guard one "
+                            f"site or annotate '# one-shot'",
+                        )
+
+    def _check_fc03(self, fn: FnInfo) -> None:
+        acquired: Set[str] = set()
+        for site in fn.acquires:
+            acquired.update(site.resources)
+        names = {fn.fn_name}
+        if fn.cls_name:
+            names.add(f"{fn.cls_name}.{fn.fn_name}")
+        for site in fn.releases:
+            for r in site.resources:
+                if r not in self.decls or r in acquired:
+                    continue
+                if names & self.owns_targets.get(r, set()):
+                    continue
+                self.emit(
+                    fn.rel, site.line, "FC03",
+                    f"{r} released here but never acquired in "
+                    f"{fn.fn_name}(), and no '# owns: {r} -> "
+                    f"{fn.fn_name}' transfer annotation hands it in",
+                )
+
+
+def analyze(paths, root: pathlib.Path = ROOT) -> List[Finding]:
+    return Analyzer(root=root).analyze_paths(paths)
+
+
+def main(argv) -> int:
+    paths = [pathlib.Path(a) for a in argv[1:]] or [LIB]
+    an = Analyzer()
+    findings = an.analyze_paths(paths)
+    for rel, line, code, msg in findings:
+        print(f"{rel}:{line}: {code} {msg}")
+    if findings:
+        print(f"flowcheck: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    n_acq = sum(len(f.acquires) for f in an.fns)
+    n_rel = sum(len(f.releases) for f in an.fns)
+    print(f"flowcheck: clean ({len(an.decls)} resource(s) declared, "
+          f"{n_acq} acquire / {n_rel} release site(s) balanced)")
+    for name in sorted(an.decls):
+        rel, line = an.decls[name]
+        print(f"  {name:28s} {rel}:{line}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -44,6 +44,15 @@ Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
         functions — frames go out as sendmsg iovecs and land via
         recv_into; an intermediate copy there is a regression
         (suppress a deliberate one with ``# noqa``)
+  PY11  conf-key drift, both directions.  Every full
+        ``spark.shuffle.tpu.<key>`` / ``spark.shuffle.rdma.<key>``
+        reference in sparkrdma_tpu/ must name a key DECLARED in
+        conf.py (a str first argument to ``self.get``/``self.set``/
+        ``_int_in_range``/``_bytes_in_range``/``_bool``/``_time_ms``;
+        rdma-namespace references resolve through LEGACY_RENAMES
+        first).  And every declared key must appear in a README.md
+        conf table — as the backticked short key (`` `tierHotBytes` ``)
+        or the full dotted key — so no knob ships undocumented.
 
 C++ (native/):
   CC01  line longer than 100 characters
@@ -378,6 +387,74 @@ def lint_python(path: pathlib.Path, findings: list,
             findings.append((rel_, lineno, code, msg))
 
 
+# PY11: conf-key drift.  The declaration side is conf.py's accessor
+# calls; the reference side is every full dotted key in library text
+# (docstrings included — a doc pointing at a key that does not exist
+# is exactly the drift this rule exists to catch).
+_CONF_GETTERS = {"get", "set", "_int_in_range", "_bytes_in_range",
+                 "_bool", "_time_ms"}
+_CONF_KEY_RE = re.compile(
+    r"spark\.shuffle\.(tpu|rdma)\.([A-Za-z_][A-Za-z0-9_]*)"
+)
+
+
+def _declared_conf_keys(conf_path: pathlib.Path):
+    """(declared short keys, legacy→tpu rename map) from conf.py's AST."""
+    tree = ast.parse(conf_path.read_text())
+    declared: set = set()
+    renames: dict = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CONF_GETTERS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            declared.add(node.args[0].value)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "LEGACY_RENAMES":
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if (isinstance(k, ast.Constant)
+                                and isinstance(v, ast.Constant)):
+                            renames[k.value] = v.value
+    return declared, renames
+
+
+def lint_conf_keys(findings: list, root: pathlib.Path = ROOT) -> None:
+    """PY11 — see the module docstring."""
+    lib = root / "sparkrdma_tpu"
+    conf_path = lib / "conf.py"
+    if not conf_path.is_file():
+        return
+    declared, renames = _declared_conf_keys(conf_path)
+    for path in sorted(lib.rglob("*.py")):
+        rel = path.relative_to(root)
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines, 1):
+            for m in _CONF_KEY_RE.finditer(line):
+                ns, short = m.group(1), m.group(2)
+                key = renames.get(short, short) if ns == "rdma" else short
+                if key in declared:
+                    continue
+                if _suppressed(lines, i, "PY11"):
+                    continue
+                findings.append(
+                    (rel, i, "PY11",
+                     f"conf key {m.group(0)} is not declared in conf.py")
+                )
+    readme = root / "README.md"
+    text = readme.read_text() if readme.is_file() else ""
+    for key in sorted(declared):
+        if f"`{key}`" in text or f"spark.shuffle.tpu.{key}" in text:
+            continue
+        findings.append(
+            (readme.relative_to(root) if readme.is_file()
+             else pathlib.Path("README.md"), 0, "PY11",
+             f"declared conf key {key} missing from the README conf tables")
+        )
+
+
 def lint_cpp(path: pathlib.Path, findings: list) -> None:
     rel = path.relative_to(ROOT)
     for i, line in enumerate(path.read_text().splitlines(), 1):
@@ -395,6 +472,7 @@ def main() -> int:
         lint_python(f, findings)
     for f in cc_files():
         lint_cpp(f, findings)
+    lint_conf_keys(findings)
     for rel, line, code, msg in findings:
         print(f"{rel}:{line}: {code} {msg}")
     if findings:

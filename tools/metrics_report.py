@@ -378,6 +378,49 @@ def render_tier(counters: list, gauges: list) -> list:
     return out
 
 
+def render_resources(counters: list, gauges: list) -> list:
+    """Resource-ledger census (utils/ledger.py, conf resourceDebug):
+    one row per tracked resource — lifetime acquires, units still
+    outstanding, units reported leaked at ledger stop — plus the
+    global double-release count.  A healthy run shows zero in the
+    outstanding and leaked columns."""
+    rows: dict = {}
+
+    def row(name):
+        return rows.setdefault(
+            name, {"acquires": 0.0, "outstanding": 0.0, "leaked": 0.0}
+        )
+
+    doubles = 0.0
+    for c in counters:
+        labels = c.get("labels") or {}
+        if c["name"] == "resource_acquires_total" and "resource" in labels:
+            row(labels["resource"])["acquires"] += c["value"]
+        elif c["name"] == "resource_leaked_total" and "resource" in labels:
+            row(labels["resource"])["leaked"] += c["value"]
+        elif c["name"] == "resource_double_release_total":
+            doubles += c["value"]
+    for g in gauges:
+        labels = g.get("labels") or {}
+        if g["name"] == "resource_outstanding" and "resource" in labels:
+            row(labels["resource"])["outstanding"] += g["value"]
+    if not rows and not doubles:
+        return []
+    out = ["resource ledger (utils/ledger.py)"]
+    width = max([len(r) for r in rows] + [8]) + 2
+    for name in sorted(rows):
+        r = rows[name]
+        leak = (f"  LEAKED={r['leaked']:,.0f}" if r["leaked"] else "")
+        out.append(
+            f"  {name:<{width}}"
+            f"acquires={r['acquires']:,.0f}  "
+            f"outstanding={r['outstanding']:,.0f}{leak}"
+        )
+    if doubles:
+        out.append(f"  double releases: {doubles:,.0f}")
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
@@ -391,6 +434,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_tenants(counters, gauges))
     lines.extend(render_decode_pipeline(counters))
     lines.extend(render_tier(counters, gauges))
+    lines.extend(render_resources(counters, gauges))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
     )
