@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck flowcheck test bench bench-smoke dryrun clean
+.PHONY: all native lint concheck flowcheck wirecheck test bench bench-smoke dryrun clean
 
 all: native
 
@@ -9,12 +9,14 @@ native:
 
 # style gate failing the build — the checkstyle/scalastyle analog
 # (reference pom.xml:93-141 runs both at validate, failsOnError=true)
-# — plus the concurrency lock-discipline gate (tools/concheck.py) and
-# the resource-lifecycle gate (tools/flowcheck.py)
+# — plus the concurrency lock-discipline gate (tools/concheck.py),
+# the resource-lifecycle gate (tools/flowcheck.py) and the
+# wire-protocol conformance gate (tools/wirecheck.py)
 lint:
 	python tools/lint.py
 	python tools/concheck.py
 	python tools/flowcheck.py
+	python tools/wirecheck.py
 
 # the concurrency gate alone: lock-order cycles/rank inversions (CK01),
 # blocking-under-lock (CK02), guarded-by discipline (CK03), unranked
@@ -27,6 +29,12 @@ concheck:
 # resources (FC04) across sparkrdma_tpu/
 flowcheck:
 	python tools/flowcheck.py
+
+# the wire-protocol gate alone: pack/unpack asymmetry (WC01), MSG_TYPE
+# registry integrity (WC02), opcode/handler parity (WC03), magic sizes
+# (WC04), bounds discipline (WC05) across the wire surface
+wirecheck:
+	python tools/wirecheck.py
 
 test: native lint
 	python -m pytest tests/ -x -q

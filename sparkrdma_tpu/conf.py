@@ -733,6 +733,21 @@ class TpuShuffleConf:
         return self._bool("resourceDebug", False)
 
     @property
+    def wire_debug(self) -> bool:
+        """Runtime wire-protocol frame validator (utils/wiredbg.py):
+        both TCP engines' receive paths and the loopback dispatch plane
+        validate every frame as it arrives — header sanity (known
+        opcode, bounded length) and full schema-derived decode of RPC
+        frames BEFORE the application listener sees them, with
+        ``wire_frames_{validated,rejected}_total`` counters labeled by
+        engine/opcode and hexdump context on every rejection.  Off by
+        default — the receive paths then pay one module-global read
+        per frame.  The static half is tools/wirecheck.py; the manager
+        flips the process-global validator on BEFORE building its
+        node."""
+        return self._bool("wireDebug", False)
+
+    @property
     def metrics_json_path(self) -> str:
         """When set, manager.stop() writes a JSON snapshot of the
         registry here (executors suffix ``.<executor_id>`` so

@@ -8,7 +8,10 @@ from sparkrdma_tpu.rpc.messages import (
     HelloMsg,
     PublishMapTaskOutputMsg,
     RpcMsg,
+    WireField,
+    WireFormatError,
     decode_msg,
+    hex_context,
 )
 
 __all__ = [
@@ -18,6 +21,9 @@ __all__ = [
     "PublishMapTaskOutputMsg",
     "FetchMapStatusMsg",
     "FetchMapStatusResponseMsg",
+    "WireField",
+    "WireFormatError",
     "decode_msg",
+    "hex_context",
     "MSG_TYPES",
 ]

@@ -1,4 +1,4 @@
-"""Compact binary control-plane RPC messages.
+"""Compact binary control-plane RPC messages, declared as wire schemas.
 
 The reference frames every control message as ``4B length + 4B type``
 followed by a type-specific payload, and *segments* large payloads into
@@ -12,6 +12,19 @@ their element lists across frames; each frame is a complete message of the
 same type covering a sub-range, so the receiver just applies them in any
 order (the publish path lands each sub-range via
 ``MapTaskOutput.put_range``).
+
+Every message class declares its wire layout as a ``WIRE_SCHEMA`` — an
+ordered tuple of :class:`WireField` specs (name, struct code,
+variable-length section rule).  For fixed-layout messages the codec pair
+(``_payload`` / ``_decode_payload``) is DERIVED from the schema, so
+pack/unpack symmetry is true by construction; the one hand-written codec
+(:class:`ExchangePlanMsg`, whose manifest nests rows) declares its
+sections as ``custom`` fields and is checked for symmetry by the static
+gate (tools/wirecheck.py, WC01).  Schema-driven decode validates every
+count/length field against the received buffer BEFORE allocating or
+looping (WC05's runtime contract), and all malformed input surfaces as
+:class:`WireFormatError` — a ``ValueError`` carrying the message type
+and a hexdump context, so one bad frame never costs more than itself.
 
 The first five message types mirror the reference's set
 (RdmaRpcMsg.scala:31-35); types 6-7 carry the failure-detection plane
@@ -54,26 +67,307 @@ from typing import Dict, List, Sequence, Tuple, Type
 from sparkrdma_tpu.utils.types import (
     LOCATION_ENTRY_SIZE,
     BlockLocation,
+    BlockManagerId,
     ShuffleManagerId,
 )
 
 _HEADER = struct.Struct("<ii")  # (frame_length, msg_type)
 HEADER_SIZE = _HEADER.size
 
+# The named structs every codec builds from — sizes always come from
+# these (``.size``), never from integer literals (wirecheck WC04).
+_I32 = struct.Struct("<i")
+_Q64 = struct.Struct("<q")
+_PAIR_II = struct.Struct("<ii")      # (map_id, reduce_id)
+_PLAN_BLOCK = struct.Struct("<iiq")  # (map_id, reduce_id, length)
+_PLAN_TAIL = struct.Struct("<iBi")   # (window, final, len(my_maps))
+
+# Smallest possible serialized ShuffleManagerId (all-empty strings) —
+# the count-validation floor for smid lists.
+_SMID_MIN_SIZE = ShuffleManagerId(
+    "", 0, BlockManagerId("", "", 0)
+).serialized_length()
+
+
+def hex_context(data, limit: int = 32) -> str:
+    """First ``limit`` bytes as a hexdump fragment for error context."""
+    view = bytes(memoryview(data)[:limit])
+    dump = view.hex(" ")
+    suffix = "…" if len(data) > limit else ""
+    return f"{len(data)}B [{dump}{suffix}]"
+
+
+class WireFormatError(ValueError):
+    """A frame that violates the wire contract — truncated, oversized
+    length field, unknown type.  Subclasses ``ValueError`` so existing
+    decode-contract callers keep working; carries enough structure for
+    the receive paths to count and scope the failure to ONE frame."""
+
+    def __init__(self, message: str, *, msg_type=None,
+                 unknown_type: bool = False):
+        super().__init__(message)
+        self.msg_type = msg_type
+        self.unknown_type = unknown_type
+
+
+def _require(view: memoryview, off: int, need: int) -> None:
+    """Bounds guard: the next ``need`` bytes must exist at ``off``."""
+    if need < 0 or off + need > len(view):
+        raise WireFormatError(
+            f"truncated payload: need {need}B at offset {off}, "
+            f"have {len(view) - off}B"
+        )
+
+
+def _check_count(n: int, min_elem: int, view: memoryview,
+                 off: int) -> int:
+    """Validate a wire-supplied element count against the bytes that
+    actually follow, BEFORE any allocation or loop sized by it — a
+    lying count must cost nothing (no multi-GiB list from a 20-byte
+    frame)."""
+    if n < 0 or n * min_elem > len(view) - off:
+        raise WireFormatError(
+            f"bad element count {n} (×{min_elem}B min) with "
+            f"{len(view) - off}B remaining"
+        )
+    return n
+
+
+class WireField:
+    """One field of a message's wire layout, in wire order.
+
+    kind:
+      ``scalar``      one struct code (e.g. ``<i``)
+      ``bool``        struct-coded int carrying a bool
+      ``smid``        a ShuffleManagerId (self-delimiting)
+      ``list``        ``<i`` count + count elements (elem: ``smid``,
+                      ``loc``, or a struct code like ``<ii``)
+      ``bytes``       ``<i`` length + raw bytes
+      ``str``         ``<i`` length + UTF-8, truncated to ``max_len``
+      ``bytes_rest``  raw bytes to end of payload (last field only)
+      ``custom``      hand-written codec section; ``code`` documents the
+                      layout and wirecheck audits the methods (WC01/05)
+    """
+
+    __slots__ = ("name", "kind", "code", "st", "n_values", "max_len")
+
+    def __init__(self, name: str, kind: str, code=None, max_len=None):
+        self.name = name
+        self.kind = kind
+        self.code = code
+        self.max_len = max_len
+        self.st = None
+        self.n_values = 0
+        if kind in ("scalar", "bool") or (
+            kind == "list" and code not in ("smid", "loc")
+        ):
+            if not (isinstance(code, str) and code.startswith("<")):
+                raise ValueError(
+                    f"wire field {name!r}: struct code {code!r} must be "
+                    f"explicit little-endian ('<'-prefixed)"
+                )
+            self.st = struct.Struct(code)
+            self.n_values = len(self.st.unpack(bytes(self.st.size)))
+
+    # -- readable constructors ----------------------------------------------
+    @classmethod
+    def i32(cls, name):
+        return cls(name, "scalar", "<i")
+
+    @classmethod
+    def scalar(cls, name, code):
+        return cls(name, "scalar", code)
+
+    @classmethod
+    def bool_i32(cls, name):
+        return cls(name, "bool", "<i")
+
+    @classmethod
+    def smid(cls, name):
+        return cls(name, "smid")
+
+    @classmethod
+    def list(cls, name, elem):
+        return cls(name, "list", elem)
+
+    @classmethod
+    def bytes_i32(cls, name):
+        return cls(name, "bytes")
+
+    @classmethod
+    def str_i32(cls, name, max_len):
+        return cls(name, "str", max_len=max_len)
+
+    @classmethod
+    def bytes_rest(cls, name):
+        return cls(name, "bytes_rest")
+
+    @classmethod
+    def custom(cls, name, layout):
+        return cls(name, "custom", layout)
+
+
+F = WireField
+
+
+def _schema_is_derived(schema) -> bool:
+    return all(f.kind != "custom" for f in schema)
+
+
+def _encode_field(buf: bytearray, f: WireField, v) -> None:
+    kind = f.kind
+    if kind == "scalar":
+        buf += f.st.pack(v)
+    elif kind == "bool":
+        buf += f.st.pack(int(bool(v)))
+    elif kind == "smid":
+        v.write(buf)
+    elif kind == "list":
+        buf += _I32.pack(len(v))
+        if f.code in ("smid", "loc"):
+            for e in v:
+                e.write(buf)
+        elif f.n_values == 1:
+            for e in v:
+                buf += f.st.pack(e)
+        else:
+            for e in v:
+                buf += f.st.pack(*e)
+    elif kind == "bytes":
+        buf += _I32.pack(len(v))
+        buf += v
+    elif kind == "str":
+        raw = v.encode("utf-8")[: f.max_len]
+        buf += _I32.pack(len(raw))
+        buf += raw
+    elif kind == "bytes_rest":
+        buf += v
+    else:  # pragma: no cover - schema validated at class definition
+        raise TypeError(f"cannot derive encoder for {f.kind!r} field")
+
+
+def _field_size(f: WireField, v) -> int:
+    kind = f.kind
+    if kind in ("scalar", "bool"):
+        return f.st.size
+    if kind == "smid":
+        return v.serialized_length()
+    if kind == "list":
+        if f.code == "smid":
+            return _I32.size + sum(e.serialized_length() for e in v)
+        if f.code == "loc":
+            return _I32.size + LOCATION_ENTRY_SIZE * len(v)
+        return _I32.size + f.st.size * len(v)
+    if kind == "bytes":
+        return _I32.size + len(v)
+    if kind == "str":
+        return _I32.size + len(v.encode("utf-8")[: f.max_len])
+    if kind == "bytes_rest":
+        return len(v)
+    raise TypeError(f"cannot size {f.kind!r} field")  # pragma: no cover
+
+
+def _decode_field(f: WireField, view: memoryview, off: int):
+    """Decode one schema field at ``off``; returns (value, new offset).
+    Every wire-supplied length/count is validated against the buffer
+    before it sizes a read, loop, or allocation."""
+    kind = f.kind
+    if kind == "scalar":
+        _require(view, off, f.st.size)
+        vals = f.st.unpack_from(view, off)
+        return (vals[0] if f.n_values == 1 else vals), off + f.st.size
+    if kind == "bool":
+        _require(view, off, f.st.size)
+        (v,) = f.st.unpack_from(view, off)
+        return bool(v), off + f.st.size
+    if kind == "smid":
+        return ShuffleManagerId.read(view, off)
+    if kind == "list":
+        _require(view, off, _I32.size)
+        (n,) = _I32.unpack_from(view, off)
+        off += _I32.size
+        if f.code == "smid":
+            _check_count(n, _SMID_MIN_SIZE, view, off)
+            out = []
+            for _ in range(n):
+                e, off = ShuffleManagerId.read(view, off)
+                out.append(e)
+            return out, off
+        if f.code == "loc":
+            _check_count(n, LOCATION_ENTRY_SIZE, view, off)
+            out = []
+            for _ in range(n):
+                out.append(BlockLocation.read(view, off))
+                off += LOCATION_ENTRY_SIZE
+            return out, off
+        _check_count(n, f.st.size, view, off)
+        out = []
+        for _ in range(n):
+            vals = f.st.unpack_from(view, off)
+            out.append(vals[0] if f.n_values == 1 else vals)
+            off += f.st.size
+        return out, off
+    if kind == "bytes":
+        _require(view, off, _I32.size)
+        (n,) = _I32.unpack_from(view, off)
+        off += _I32.size
+        _require(view, off, n)
+        return bytes(view[off : off + n]), off + n
+    if kind == "str":
+        _require(view, off, _I32.size)
+        (n,) = _I32.unpack_from(view, off)
+        off += _I32.size
+        _require(view, off, n)
+        return bytes(view[off : off + n]).decode("utf-8", "replace"), off + n
+    if kind == "bytes_rest":
+        return bytes(view[off:]), len(view)
+    raise TypeError(f"cannot decode {f.kind!r} field")  # pragma: no cover
+
 
 class RpcMsg:
-    """Base class: framing + segmentation."""
+    """Base class: framing + segmentation + schema-derived codecs."""
 
     MSG_TYPE: int = 0
+    WIRE_SCHEMA: Tuple[WireField, ...] = ()
 
-    # -- subclass hooks -----------------------------------------------------
+    # -- schema-derived codec ------------------------------------------------
     def _payload(self) -> bytes:
-        raise NotImplementedError
+        schema = type(self).WIRE_SCHEMA
+        if not _schema_is_derived(schema):  # pragma: no cover
+            raise NotImplementedError(
+                f"{type(self).__name__} has custom wire sections and "
+                f"must hand-write _payload"
+            )
+        buf = bytearray()
+        for f in schema:
+            _encode_field(buf, f, getattr(self, f.name))
+        return bytes(buf)
 
     def _payload_size(self) -> int:
         """Cheap payload-size estimate used to decide splitting without
-        serializing (subclasses override with arithmetic)."""
-        return len(self._payload())
+        serializing — derived from the schema field by field."""
+        return sum(
+            _field_size(f, getattr(self, f.name))
+            for f in type(self).WIRE_SCHEMA
+        )
+
+    @classmethod
+    def _decode_payload(cls, view: memoryview) -> "RpcMsg":
+        schema = cls.WIRE_SCHEMA
+        if not _schema_is_derived(schema):  # pragma: no cover
+            raise NotImplementedError(
+                f"{cls.__name__} has custom wire sections and must "
+                f"hand-write _decode_payload"
+            )
+        kwargs = {}
+        off = 0
+        for f in schema:
+            kwargs[f.name], off = _decode_field(f, view, off)
+        if off != len(view):
+            raise WireFormatError(
+                f"{cls.__name__}: {len(view) - off}B of trailing garbage"
+            )
+        return cls(**kwargs)
 
     def _split(self, max_payload: int) -> Sequence["RpcMsg"]:
         """Split into messages whose payloads each fit max_payload.
@@ -117,20 +411,39 @@ class RpcMsg:
 
 def decode_msg(data: bytes) -> RpcMsg:
     """Decode one frame (dispatch by type header,
-    reference: RdmaRpcMsg.scala:67-87)."""
+    reference: RdmaRpcMsg.scala:67-87).
+
+    Every malformed input — truncated header, length mismatch, unknown
+    type, bad field — raises :class:`WireFormatError` (a ``ValueError``),
+    never anything the receive paths would mistake for an engine fault:
+    the blast radius of a bad frame is exactly that frame."""
     if len(data) < HEADER_SIZE:
-        raise ValueError(f"frame too short: {len(data)}B")
+        raise WireFormatError(
+            f"frame too short: {hex_context(data)}"
+        )
     length, msg_type = _HEADER.unpack_from(data, 0)
     if length != len(data):
-        raise ValueError(f"frame length {length} != buffer length {len(data)}")
+        raise WireFormatError(
+            f"frame length {length} != buffer length {len(data)}",
+            msg_type=msg_type,
+        )
     cls = MSG_TYPES.get(msg_type)
     if cls is None:
-        raise ValueError(f"unknown RPC message type {msg_type}")
+        raise WireFormatError(
+            f"unknown RPC message type {msg_type}: {hex_context(data)}",
+            msg_type=msg_type, unknown_type=True,
+        )
     try:
         return cls._decode_payload(memoryview(data)[HEADER_SIZE:])
-    except struct.error as e:
+    except WireFormatError as e:
+        if e.msg_type is None:
+            e.msg_type = msg_type
+        raise
+    except (struct.error, ValueError) as e:
         # malformed frames must surface as ValueError, the decode contract
-        raise ValueError(f"malformed {cls.__name__} frame: {e}") from e
+        raise WireFormatError(
+            f"malformed {cls.__name__} frame: {e}", msg_type=msg_type
+        ) from e
 
 
 # ---------------------------------------------------------------------------
@@ -145,21 +458,10 @@ class HelloMsg(RpcMsg):
     channel_port: int  # port the driver should connect back to
 
     MSG_TYPE = 1
-
-    def _payload(self) -> bytes:
-        buf = bytearray()
-        self.shuffle_manager_id.write(buf)
-        buf += struct.pack("<i", self.channel_port)
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return self.shuffle_manager_id.serialized_length() + 4
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "HelloMsg":
-        smid, off = ShuffleManagerId.read(view, 0)
-        (port,) = struct.unpack_from("<i", view, off)
-        return HelloMsg(smid, port)
+    WIRE_SCHEMA = (
+        F.smid("shuffle_manager_id"),
+        F.i32("channel_port"),
+    )
 
 
 @dataclass(frozen=True)
@@ -171,43 +473,27 @@ class AnnounceShuffleManagersMsg(RpcMsg):
     shuffle_manager_ids: Tuple[ShuffleManagerId, ...]
 
     MSG_TYPE = 2
+    WIRE_SCHEMA = (
+        F.list("shuffle_manager_ids", "smid"),
+    )
 
     def __init__(self, shuffle_manager_ids: Sequence[ShuffleManagerId]):
         object.__setattr__(self, "shuffle_manager_ids", tuple(shuffle_manager_ids))
 
-    def _payload(self) -> bytes:
-        buf = bytearray(struct.pack("<i", len(self.shuffle_manager_ids)))
-        for smid in self.shuffle_manager_ids:
-            smid.write(buf)
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return 4 + sum(s.serialized_length() for s in self.shuffle_manager_ids)
-
     def _split(self, max_payload: int) -> Sequence["AnnounceShuffleManagersMsg"]:
         parts: List[AnnounceShuffleManagersMsg] = []
         cur: List[ShuffleManagerId] = []
-        cur_len = 4
+        cur_len = _I32.size
         for smid in self.shuffle_manager_ids:
             n = smid.serialized_length()
             if cur and cur_len + n > max_payload:
                 parts.append(AnnounceShuffleManagersMsg(cur))
-                cur, cur_len = [], 4
+                cur, cur_len = [], _I32.size
             cur.append(smid)
             cur_len += n
         if cur:
             parts.append(AnnounceShuffleManagersMsg(cur))
         return parts
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "AnnounceShuffleManagersMsg":
-        (n,) = struct.unpack_from("<i", view, 0)
-        off = 4
-        smids = []
-        for _ in range(n):
-            smid, off = ShuffleManagerId.read(view, off)
-            smids.append(smid)
-        return AnnounceShuffleManagersMsg(smids)
 
 
 @dataclass(frozen=True)
@@ -235,6 +521,16 @@ class PublishMapTaskOutputMsg(RpcMsg):
     epoch: int = 0
 
     MSG_TYPE = 3
+    WIRE_SCHEMA = (
+        F.smid("shuffle_manager_id"),
+        F.i32("shuffle_id"),
+        F.i32("map_id"),
+        F.i32("total_num_partitions"),
+        F.i32("first_reduce_id"),
+        F.i32("last_reduce_id"),
+        F.i32("epoch"),
+        F.bytes_rest("entries"),
+    )
 
     def __post_init__(self):
         expect = (self.last_reduce_id - self.first_reduce_id + 1) * LOCATION_ENTRY_SIZE
@@ -244,26 +540,8 @@ class PublishMapTaskOutputMsg(RpcMsg):
                 f"[{self.first_reduce_id},{self.last_reduce_id}]"
             )
 
-    def _payload(self) -> bytes:
-        buf = bytearray()
-        self.shuffle_manager_id.write(buf)
-        buf += struct.pack(
-            "<iiiiii",
-            self.shuffle_id,
-            self.map_id,
-            self.total_num_partitions,
-            self.first_reduce_id,
-            self.last_reduce_id,
-            self.epoch,
-        )
-        buf += self.entries
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return self.shuffle_manager_id.serialized_length() + 24 + len(self.entries)
-
     def _split(self, max_payload: int) -> Sequence["PublishMapTaskOutputMsg"]:
-        fixed = self.shuffle_manager_id.serialized_length() + 24
+        fixed = self._payload_size() - len(self.entries)
         per_seg = max(1, (max_payload - fixed) // LOCATION_ENTRY_SIZE)
         parts: List[PublishMapTaskOutputMsg] = []
         first = self.first_reduce_id
@@ -285,18 +563,6 @@ class PublishMapTaskOutputMsg(RpcMsg):
             )
             first = last + 1
         return parts
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "PublishMapTaskOutputMsg":
-        smid, off = ShuffleManagerId.read(view, 0)
-        shuffle_id, map_id, total, first, last, epoch = struct.unpack_from(
-            "<iiiiii", view, off
-        )
-        off += 24
-        return PublishMapTaskOutputMsg(
-            smid, shuffle_id, map_id, total, first, last,
-            bytes(view[off:]), epoch,
-        )
 
 
 @dataclass(frozen=True)
@@ -322,6 +588,15 @@ class FetchMapStatusMsg(RpcMsg):
     index: int = 0   # offset of block_ids[0] within the logical request
 
     MSG_TYPE = 4
+    WIRE_SCHEMA = (
+        F.smid("requester"),
+        F.smid("host"),
+        F.i32("shuffle_id"),
+        F.i32("callback_id"),
+        F.i32("total"),
+        F.i32("index"),
+        F.list("block_ids", "<ii"),
+    )
 
     def __init__(self, requester, host, shuffle_id, callback_id, block_ids,
                  total=-1, index=0):
@@ -333,34 +608,9 @@ class FetchMapStatusMsg(RpcMsg):
         object.__setattr__(self, "total", len(self.block_ids) if total < 0 else total)
         object.__setattr__(self, "index", index)
 
-    def _payload(self) -> bytes:
-        buf = bytearray()
-        self.requester.write(buf)
-        self.host.write(buf)
-        buf += struct.pack(
-            "<iiiii",
-            self.shuffle_id, self.callback_id, self.total, self.index,
-            len(self.block_ids),
-        )
-        for map_id, reduce_id in self.block_ids:
-            buf += struct.pack("<ii", map_id, reduce_id)
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return (
-            self.requester.serialized_length()
-            + self.host.serialized_length()
-            + 20
-            + 8 * len(self.block_ids)
-        )
-
     def _split(self, max_payload: int) -> Sequence["FetchMapStatusMsg"]:
-        fixed = (
-            self.requester.serialized_length()
-            + self.host.serialized_length()
-            + 20
-        )
-        per_seg = max(1, (max_payload - fixed) // 8)
+        fixed = self._payload_size() - _PAIR_II.size * len(self.block_ids)
+        per_seg = max(1, (max_payload - fixed) // _PAIR_II.size)
         parts: List[FetchMapStatusMsg] = []
         for start in range(0, len(self.block_ids), per_seg):
             parts.append(
@@ -371,23 +621,6 @@ class FetchMapStatusMsg(RpcMsg):
                 )
             )
         return parts
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "FetchMapStatusMsg":
-        requester, off = ShuffleManagerId.read(view, 0)
-        host, off = ShuffleManagerId.read(view, off)
-        shuffle_id, callback_id, total, index, n = struct.unpack_from(
-            "<iiiii", view, off
-        )
-        off += 20
-        blocks = []
-        for _ in range(n):
-            blocks.append(struct.unpack_from("<ii", view, off))
-            off += 8
-        return FetchMapStatusMsg(
-            requester, host, shuffle_id, callback_id, blocks,
-            total=total, index=index,
-        )
 
 
 @dataclass(frozen=True)
@@ -404,6 +637,12 @@ class FetchMapStatusResponseMsg(RpcMsg):
     locations: Tuple[BlockLocation, ...]
 
     MSG_TYPE = 5
+    WIRE_SCHEMA = (
+        F.i32("callback_id"),
+        F.i32("total"),
+        F.i32("index"),
+        F.list("locations", "loc"),
+    )
 
     def __init__(self, callback_id, total, index, locations):
         object.__setattr__(self, "callback_id", callback_id)
@@ -411,20 +650,9 @@ class FetchMapStatusResponseMsg(RpcMsg):
         object.__setattr__(self, "index", index)
         object.__setattr__(self, "locations", tuple(locations))
 
-    def _payload(self) -> bytes:
-        buf = bytearray(
-            struct.pack("<iiii", self.callback_id, self.total, self.index,
-                        len(self.locations))
-        )
-        for loc in self.locations:
-            loc.write(buf)
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return 16 + LOCATION_ENTRY_SIZE * len(self.locations)
-
     def _split(self, max_payload: int) -> Sequence["FetchMapStatusResponseMsg"]:
-        per_seg = max(1, (max_payload - 16) // LOCATION_ENTRY_SIZE)
+        fixed = self._payload_size() - LOCATION_ENTRY_SIZE * len(self.locations)
+        per_seg = max(1, (max_payload - fixed) // LOCATION_ENTRY_SIZE)
         parts: List[FetchMapStatusResponseMsg] = []
         for start in range(0, len(self.locations), per_seg):
             parts.append(
@@ -436,16 +664,6 @@ class FetchMapStatusResponseMsg(RpcMsg):
                 )
             )
         return parts
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "FetchMapStatusResponseMsg":
-        callback_id, total, index, n = struct.unpack_from("<iiii", view, 0)
-        off = 16
-        locs = []
-        for _ in range(n):
-            locs.append(BlockLocation.read(view, off))
-            off += LOCATION_ENTRY_SIZE
-        return FetchMapStatusResponseMsg(callback_id, total, index, locs)
 
 
 @dataclass(frozen=True)
@@ -461,19 +679,10 @@ class FetchMapStatusFailedMsg(RpcMsg):
     reason: str
 
     MSG_TYPE = 6
-
-    def _payload(self) -> bytes:
-        reason = self.reason.encode("utf-8")[:1024]
-        return struct.pack("<ii", self.callback_id, len(reason)) + reason
-
-    def _payload_size(self) -> int:
-        return 8 + len(self.reason.encode("utf-8")[:1024])
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "FetchMapStatusFailedMsg":
-        callback_id, n = struct.unpack_from("<ii", view, 0)
-        reason = bytes(view[8 : 8 + n]).decode("utf-8", "replace")
-        return FetchMapStatusFailedMsg(callback_id, reason)
+    WIRE_SCHEMA = (
+        F.i32("callback_id"),
+        F.str_i32("reason", max_len=1024),
+    )
 
 
 @dataclass(frozen=True)
@@ -489,21 +698,11 @@ class HeartbeatMsg(RpcMsg):
     is_ack: bool
 
     MSG_TYPE = 7
-
-    def _payload(self) -> bytes:
-        buf = bytearray()
-        self.shuffle_manager_id.write(buf)
-        buf += struct.pack("<ii", self.seq, 1 if self.is_ack else 0)
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return self.shuffle_manager_id.serialized_length() + 8
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "HeartbeatMsg":
-        smid, off = ShuffleManagerId.read(view, 0)
-        seq, ack = struct.unpack_from("<ii", view, off)
-        return HeartbeatMsg(smid, seq, bool(ack))
+    WIRE_SCHEMA = (
+        F.smid("shuffle_manager_id"),
+        F.i32("seq"),
+        F.bool_i32("is_ack"),
+    )
 
 
 @dataclass(frozen=True)
@@ -525,25 +724,12 @@ class FetchExchangePlanMsg(RpcMsg):
     window: int = -1
 
     MSG_TYPE = 8
-
-    def _payload(self) -> bytes:
-        buf = bytearray()
-        self.requester.write(buf)
-        buf += struct.pack(
-            "<iii", self.shuffle_id, self.callback_id, self.window
-        )
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return self.requester.serialized_length() + 12
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "FetchExchangePlanMsg":
-        smid, off = ShuffleManagerId.read(view, 0)
-        shuffle_id, callback_id, window = struct.unpack_from(
-            "<iii", view, off
-        )
-        return FetchExchangePlanMsg(smid, shuffle_id, callback_id, window)
+    WIRE_SCHEMA = (
+        F.smid("requester"),
+        F.i32("shuffle_id"),
+        F.i32("callback_id"),
+        F.i32("window"),
+    )
 
 
 @dataclass(frozen=True)
@@ -560,28 +746,11 @@ class PublishShuffleMetricsMsg(RpcMsg):
     payload: bytes  # JSON {metric: number}
 
     MSG_TYPE = 10
-
-    def _payload(self) -> bytes:
-        buf = bytearray()
-        self.shuffle_manager_id.write(buf)
-        buf += struct.pack("<ii", self.shuffle_id, len(self.payload))
-        buf += self.payload
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return (
-            self.shuffle_manager_id.serialized_length()
-            + 8 + len(self.payload)
-        )
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "PublishShuffleMetricsMsg":
-        smid, off = ShuffleManagerId.read(view, 0)
-        shuffle_id, n = struct.unpack_from("<ii", view, off)
-        off += 8
-        return PublishShuffleMetricsMsg(
-            smid, shuffle_id, bytes(view[off : off + n])
-        )
+    WIRE_SCHEMA = (
+        F.smid("shuffle_manager_id"),
+        F.i32("shuffle_id"),
+        F.bytes_i32("payload"),
+    )
 
 
 @dataclass(frozen=True)
@@ -599,40 +768,24 @@ class PrefetchHintMsg(RpcMsg):
     locations: Tuple[BlockLocation, ...]
 
     MSG_TYPE = 11
+    WIRE_SCHEMA = (
+        F.i32("shuffle_id"),
+        F.list("locations", "loc"),
+    )
 
     def __init__(self, shuffle_id: int, locations):
         object.__setattr__(self, "shuffle_id", shuffle_id)
         object.__setattr__(self, "locations", tuple(locations))
 
-    def _payload(self) -> bytes:
-        buf = bytearray(
-            struct.pack("<ii", self.shuffle_id, len(self.locations))
-        )
-        for loc in self.locations:
-            loc.write(buf)
-        return bytes(buf)
-
-    def _payload_size(self) -> int:
-        return 8 + LOCATION_ENTRY_SIZE * len(self.locations)
-
     def _split(self, max_payload: int) -> Sequence["PrefetchHintMsg"]:
-        per_seg = max(1, (max_payload - 8) // LOCATION_ENTRY_SIZE)
+        fixed = self._payload_size() - LOCATION_ENTRY_SIZE * len(self.locations)
+        per_seg = max(1, (max_payload - fixed) // LOCATION_ENTRY_SIZE)
         return [
             PrefetchHintMsg(
                 self.shuffle_id, self.locations[i : i + per_seg]
             )
             for i in range(0, len(self.locations), per_seg)
         ]
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "PrefetchHintMsg":
-        shuffle_id, n = struct.unpack_from("<ii", view, 0)
-        off = 8
-        locs = []
-        for _ in range(n):
-            locs.append(BlockLocation.read(view, off))
-            off += LOCATION_ENTRY_SIZE
-        return PrefetchHintMsg(shuffle_id, locs)
 
 
 @dataclass(frozen=True)
@@ -650,17 +803,9 @@ class CleanShuffleMsg(RpcMsg):
     shuffle_id: int
 
     MSG_TYPE = 12
-
-    def _payload(self) -> bytes:
-        return struct.pack("<i", self.shuffle_id)
-
-    def _payload_size(self) -> int:
-        return 4
-
-    @staticmethod
-    def _decode_payload(view: memoryview) -> "CleanShuffleMsg":
-        (shuffle_id,) = struct.unpack_from("<i", view, 0)
-        return CleanShuffleMsg(shuffle_id)
+    WIRE_SCHEMA = (
+        F.i32("shuffle_id"),
+    )
 
 
 @dataclass(frozen=True)
@@ -669,7 +814,12 @@ class ExchangePlanMsg(RpcMsg):
     full (src × dst) stream-length matrix every host must agree on, and
     the requester's destination manifest — for each source host, the
     (map_id, reduce_id, length) blocks concatenated into that source's
-    stream toward the requester, in order."""
+    stream toward the requester, in order.
+
+    The manifest nests per-host rows, so this is the one HAND-WRITTEN
+    codec: the ``custom`` schema fields document the layout, and
+    tools/wirecheck.py audits encode/decode symmetry (WC01) and bounds
+    discipline (WC05) instead of deriving them."""
 
     callback_id: int
     hosts: Tuple[ShuffleManagerId, ...]          # canonical order
@@ -680,6 +830,15 @@ class ExchangePlanMsg(RpcMsg):
     my_maps: Tuple[int, ...] = ()  # requester's map_ids in this window
 
     MSG_TYPE = 9
+    WIRE_SCHEMA = (
+        F.custom("callback_id", "<i"),
+        F.custom("hosts", "<i count + count × smid"),
+        F.custom("lengths", "<{E*E}q row-major matrix, no count prefix"),
+        F.custom("manifest", "per host row: <i count + count × <iiq"),
+        F.custom("window", "<i (first of <iBi tail)"),
+        F.custom("final", "<B (second of <iBi tail)"),
+        F.custom("my_maps", "<i count (third of tail) + count × <i"),
+    )
 
     def __init__(self, callback_id, hosts, lengths, manifest,
                  window: int = -1, final: bool = True, my_maps=()):
@@ -703,54 +862,69 @@ class ExchangePlanMsg(RpcMsg):
             )
 
     def _payload(self) -> bytes:
-        buf = bytearray(struct.pack("<ii", self.callback_id, len(self.hosts)))
+        buf = bytearray(_PAIR_II.pack(self.callback_id, len(self.hosts)))
         for h in self.hosts:
             h.write(buf)
         for x in self.lengths:
-            buf += struct.pack("<q", x)
+            buf += _Q64.pack(x)
         for row in self.manifest:
-            buf += struct.pack("<i", len(row))
+            buf += _I32.pack(len(row))
             for map_id, reduce_id, length in row:
-                buf += struct.pack("<iiq", map_id, reduce_id, length)
-        buf += struct.pack(
-            "<iBi", self.window, int(self.final), len(self.my_maps)
+                buf += _PLAN_BLOCK.pack(map_id, reduce_id, length)
+        buf += _PLAN_TAIL.pack(
+            self.window, int(self.final), len(self.my_maps)
         )
         for m in self.my_maps:
-            buf += struct.pack("<i", m)
+            buf += _I32.pack(m)
         return bytes(buf)
 
     def _payload_size(self) -> int:
         return (
-            8
+            _PAIR_II.size
             + sum(h.serialized_length() for h in self.hosts)
-            + 8 * len(self.lengths)
-            + sum(4 + 16 * len(row) for row in self.manifest)
-            + 9 + 4 * len(self.my_maps)
+            + _Q64.size * len(self.lengths)
+            + sum(
+                _I32.size + _PLAN_BLOCK.size * len(row)
+                for row in self.manifest
+            )
+            + _PLAN_TAIL.size + _I32.size * len(self.my_maps)
         )
 
     @staticmethod
     def _decode_payload(view: memoryview) -> "ExchangePlanMsg":
-        callback_id, e = struct.unpack_from("<ii", view, 0)
-        off = 8
+        _require(view, 0, _PAIR_II.size)
+        callback_id, e = _PAIR_II.unpack_from(view, 0)
+        off = _PAIR_II.size
+        _check_count(e, _SMID_MIN_SIZE, view, off)
         hosts = []
         for _ in range(e):
             h, off = ShuffleManagerId.read(view, off)
             hosts.append(h)
+        _require(view, off, _Q64.size * e * e)
         lengths = struct.unpack_from(f"<{e * e}q", view, off) if e else ()
-        off += 8 * e * e
+        off += _Q64.size * e * e
         manifest = []
         for _ in range(e):
-            (cnt,) = struct.unpack_from("<i", view, off)
-            off += 4
+            _require(view, off, _I32.size)
+            (cnt,) = _I32.unpack_from(view, off)
+            off += _I32.size
+            _check_count(cnt, _PLAN_BLOCK.size, view, off)
             row = []
             for _ in range(cnt):
-                m, r, n = struct.unpack_from("<iiq", view, off)
-                off += 16
+                m, r, n = _PLAN_BLOCK.unpack_from(view, off)
+                off += _PLAN_BLOCK.size
                 row.append((m, r, n))
             manifest.append(tuple(row))
-        window, final, n_my = struct.unpack_from("<iBi", view, off)
-        off += 9
+        _require(view, off, _PLAN_TAIL.size)
+        window, final, n_my = _PLAN_TAIL.unpack_from(view, off)
+        off += _PLAN_TAIL.size
+        _check_count(n_my, _I32.size, view, off)
         my_maps = struct.unpack_from(f"<{n_my}i", view, off) if n_my else ()
+        off += _I32.size * n_my
+        if off != len(view):
+            raise WireFormatError(
+                f"ExchangePlanMsg: {len(view) - off}B of trailing garbage"
+            )
         return ExchangePlanMsg(
             callback_id, hosts, lengths, manifest,
             window=window, final=bool(final), my_maps=my_maps,

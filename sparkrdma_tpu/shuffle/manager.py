@@ -53,7 +53,9 @@ from sparkrdma_tpu.rpc.messages import (
     PublishMapTaskOutputMsg,
     PublishShuffleMetricsMsg,
     RpcMsg,
+    WireFormatError,
     decode_msg,
+    hex_context,
 )
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.shuffle.partitioner import Partitioner
@@ -260,6 +262,13 @@ class TpuShuffleManager:
             # the LAST manager's stop flushes the leak report (the
             # others' live channels are not leaks)
             get_resource_ledger().retain()
+        if conf.wire_debug:
+            # and the wire-frame validator (utils/wiredbg.py): every
+            # frame both engines and the loopback plane receive from
+            # here on is header- and schema-checked before dispatch
+            from sparkrdma_tpu.utils.wiredbg import set_wire_debug
+
+            set_wire_debug(True)
         # multi-tenant QoS (qos/): flip the process-global tenant
         # registry on BEFORE building the node, exactly like the
         # metrics registry — the node's pools classify/broker through
@@ -562,6 +571,20 @@ class TpuShuffleManager:
     def _receive(self, channel: Channel, frame: bytes) -> None:
         try:
             msg = decode_msg(frame)
+        except WireFormatError as e:
+            # one-frame blast radius: the channel stays up, the frame
+            # is counted and dropped with structured context — an
+            # unknown MSG_TYPE (future peer?) is tallied apart from a
+            # frame whose declared type fails its own schema
+            kind = "msg_type" if e.unknown_type else "malformed"
+            counter(
+                "wire_unknown_frames_total", engine="control", kind=kind
+            ).inc()
+            logger.warning(
+                "dropping control frame (%s): %s (frame %s)",
+                kind, e, hex_context(bytes(frame)),
+            )
+            return
         except ValueError:
             logger.exception("dropping malformed control frame")
             return

@@ -82,6 +82,7 @@ from sparkrdma_tpu.transport.channel import (
     TransportError,
 )
 from sparkrdma_tpu.transport import tcp as wire
+from sparkrdma_tpu.utils import wiredbg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.types import BlockLocation
@@ -608,12 +609,26 @@ class _Handshake:
         if self._got < wire._HELLO.size:
             return
         try:
-            magic, type_idx, src_port, _ = wire._HELLO.unpack(
+            magic, type_idx, src_port, version = wire._HELLO.unpack(
                 bytes(self._buf)
             )
             if magic != wire._MAGIC \
                     or type_idx >= len(wire._TYPE_BY_INDEX):
                 raise TransportError(f"bad hello from {self._addr}")
+            if version != wire.WIRE_VERSION:
+                # structured rejection (NAK + both versions) — the 5
+                # bytes always fit a fresh socket's send buffer; the
+                # connector's error names both sides
+                self._sock.send(
+                    b"\x00"
+                    + wire._HELLO_REJ.pack(wire.WIRE_VERSION, version)
+                )
+                counter("wire_version_rejects_total").inc()
+                raise TransportError(
+                    f"protocol version mismatch from {self._addr}: "
+                    f"hello spoke wire version {version}, this node "
+                    f"requires {wire.WIRE_VERSION}"
+                )
             # the 1-byte ack always fits a fresh socket's send buffer
             self._sock.send(b"\x01")
             self._sock.setsockopt(
@@ -1333,11 +1348,15 @@ class AsyncTcpChannel(Channel):
             opcode, length = wire._HDR.unpack(bytes(self._rx_store))
             if length > wire._MAX_FRAME:
                 raise TransportError(f"oversized frame: {length}B")
+            if wiredbg.wire_debug_enabled():
+                herr = wiredbg.header_error("dispatcher", opcode, length)
+                if herr is not None:
+                    raise TransportError(f"wireDebug: {herr}")
             self._m_msgs_recv.inc()
             self._m_bytes_recv.inc(wire._HDR.size + length)
             if opcode == wire.OP_RPC:
                 if length == 0:
-                    self.node.dispatch_frame(self, b"")
+                    self._rx_rpc_frame(b"")
                     self._arm_fixed(self._HDR, wire._HDR.size)
                 else:
                     self._arm_fixed(self._RPC, length)
@@ -1355,11 +1374,18 @@ class AsyncTcpChannel(Channel):
                 self._rx_frame_len = length
                 self._arm_fixed(self._RESP_HDR, wire._RESP_HDR.size)
             else:
+                # desynced byte stream: the channel must die, but
+                # counted and scoped (outstanding reads fail with a
+                # structured error; the node stays up)
+                counter(
+                    "wire_unknown_frames_total",
+                    engine="dispatcher", kind="opcode",
+                ).inc()
                 raise TransportError(f"unknown opcode {opcode}")
         elif state == self._RPC:
             frame = bytes(self._rx_store)
             self._arm_fixed(self._HDR, wire._HDR.size)
-            self.node.dispatch_frame(self, frame)
+            self._rx_rpc_frame(frame)
         elif state == self._REQ:
             payload = bytes(self._rx_store)
             self._arm_fixed(self._HDR, wire._HDR.size)
@@ -1385,9 +1411,24 @@ class AsyncTcpChannel(Channel):
         else:  # pragma: no cover - state machine exhaustive
             raise TransportError(f"bad recv state {state}")
 
+    def _rx_rpc_frame(self, frame: bytes) -> None:  # on-loop
+        """Hand one RPC frame to the application dispatch plane —
+        schema-validated first under wireDebug (a rejected frame is
+        counted, hexdump-logged, and dropped: one-frame blast
+        radius)."""
+        if (wiredbg.wire_debug_enabled()
+                and not wiredbg.rpc_frame_ok("dispatcher", frame)):
+            return
+        self.node.dispatch_frame(self, frame)
+
     def _rx_resp_hdr(self) -> None:  # on-loop
         req_id, status = wire._RESP_HDR.unpack(bytes(self._rx_store))
         body = self._rx_frame_len - wire._RESP_HDR.size
+        # bytes of this frame's body not yet consumed — the hard bound
+        # every block-length prefix is validated against (a lying
+        # prefix must never read into the next frame or size an
+        # allocation)
+        self._rx_resp_left = body
         with self._reads_lock:
             entry = self._reads.pop(req_id, None)
         if entry is None:
@@ -1434,6 +1475,14 @@ class AsyncTcpChannel(Channel):
         for _ in range(count):
             (n,) = wire._LEN.unpack_from(payload, off)
             off += wire._LEN.size
+            if n > len(payload) - off:
+                # lying length prefix: fail the read, then tear the
+                # (desynced) channel down — never silently truncate
+                self._rx_settle(None, TransportError(
+                    f"block length {n}B exceeds response remainder "
+                    f"{len(payload) - off}B"
+                ))
+                raise TransportError("block length exceeds frame")
             blocks.append(payload[off: off + n])
             off += n
             if on_progress is not None:
@@ -1444,11 +1493,28 @@ class AsyncTcpChannel(Channel):
         self._rx_settle(blocks, None)
 
     def _rx_next_block(self) -> None:  # on-loop
+        if self._rx_resp_left < wire._LEN.size:
+            self._rx_settle(None, TransportError(
+                f"short read response: {self._rx_resp_left}B left "
+                f"before next block prefix"
+            ))
+            raise TransportError("short read response body")
         self._arm_fixed(self._RESP_LEN, wire._LEN.size)
 
     def _rx_resp_len(self) -> None:  # on-loop
         (n,) = wire._LEN.unpack(bytes(self._rx_store))
+        self._rx_resp_left -= wire._LEN.size
         count, listener, _t0, dest, _prog, _total = self._rx_entry
+        if n > self._rx_resp_left:
+            # without this bound a lying prefix would read INTO the
+            # next frame's bytes (or hang waiting for bytes that never
+            # come) and size an attacker-controlled allocation
+            self._rx_settle(None, TransportError(
+                f"block length {n}B exceeds response remainder "
+                f"{self._rx_resp_left}B"
+            ))
+            raise TransportError("block length exceeds frame")
+        self._rx_resp_left -= n
         d = dest[self._rx_idx] if self._rx_idx < len(dest) else None
         if d is None:
             store = self._recv_buffer(n)

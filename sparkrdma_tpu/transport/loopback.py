@@ -33,6 +33,7 @@ from sparkrdma_tpu.transport.channel import (
     TransportError,
 )
 from sparkrdma_tpu.transport.node import Address, Node
+from sparkrdma_tpu.utils import wiredbg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 
 _PAIRED = {
@@ -185,8 +186,18 @@ class LoopbackChannel(Channel):
                 raise err
             target = self.peer_channel if self.peer_channel is not None else self
             for frame in frames:
+                data = bytes(frame)
+                if (wiredbg.wire_debug_enabled()
+                        and not wiredbg.rpc_frame_ok("loopback", data)):
+                    # loopback has no byte framing, so this is the
+                    # engine's whole validator: the rejected frame is
+                    # dropped (counted + logged) but still frees its
+                    # recv slot — the credit must flow back or the
+                    # sender leaks it
+                    target._frame_consumed()
+                    continue
                 self.remote.dispatch_frame(
-                    target, bytes(frame), on_consumed=target._frame_consumed
+                    target, data, on_consumed=target._frame_consumed
                 )
         except BaseException as e:
             self._error(e)

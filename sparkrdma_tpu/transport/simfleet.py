@@ -173,9 +173,16 @@ class SimPeerFleet:
         if not conn.hello_done:
             if len(rx) < wire._HELLO.size:
                 return
-            magic = wire._HELLO.unpack_from(rx, 0)[0]
+            magic, _ct, _port, version = wire._HELLO.unpack_from(rx, 0)
             del rx[:wire._HELLO.size]
             if magic != wire._MAGIC:
+                self._drop(conn)
+                return
+            if version != wire.WIRE_VERSION:
+                # same structured rejection real acceptors send: the
+                # dialing engine surfaces both versions in its error
+                self._send(conn, b"\x00" + wire._HELLO_REJ.pack(
+                    wire.WIRE_VERSION, version))
                 self._drop(conn)
                 return
             conn.hello_done = True

@@ -26,6 +26,13 @@ Framing: every message is ``1B opcode + 4B LE length + payload``.
 Read requests carry ``8B req_id + 4B count + count × (8B address,
 4B length, 4B mkey)``; responses carry ``8B req_id + 1B status`` then
 either ``count × (4B len + bytes)`` or an error string.
+
+The 9-byte connect hello carries the protocol version
+(``WIRE_VERSION``): ``4B magic + 1B channel type + 2B src port +
+2B version``.  A version mismatch is rejected STRUCTURALLY — the
+acceptor answers ``\\x00`` plus ``<HH`` (its version, the hello's
+version) instead of the ``\\x01`` ack, so both sides can name both
+versions in the error instead of desyncing mid-stream.
 """
 
 from __future__ import annotations
@@ -46,6 +53,7 @@ from sparkrdma_tpu.transport.channel import (
     TransportError,
 )
 from sparkrdma_tpu.transport.node import Address, Node
+from sparkrdma_tpu.utils import wiredbg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.types import BlockLocation
@@ -54,11 +62,19 @@ logger = logging.getLogger(__name__)
 
 _MAGIC = b"STPU"
 _HDR = struct.Struct("<BI")          # opcode, payload length
-_HELLO = struct.Struct("<4sBHH")     # magic, channel type, src port, pad
+_HELLO = struct.Struct("<4sBHH")     # magic, channel type, src port, version
+_HELLO_REJ = struct.Struct("<HH")    # (acceptor's version, hello's version)
 _REQ_HDR = struct.Struct("<QI")      # req_id, location count
 _LOC = struct.Struct("<QII")         # address, length, mkey
 _RESP_HDR = struct.Struct("<QB")     # req_id, status
 _LEN = struct.Struct("<I")
+
+#: Wire protocol generation carried in the connect hello.  Bump on any
+#: incompatible change to framing or message layout; peers speaking a
+#: different generation are rejected at handshake with both versions
+#: named (pre-versioning peers sent 0 in this slot, so they reject
+#: cleanly too).
+WIRE_VERSION = 1
 
 OP_RPC = 1
 OP_READ_REQ = 2
@@ -124,6 +140,14 @@ def build_read_response_parts(node, payload: bytes, peer) -> Optional[List]:
         )
         return None
     try:
+        # the count must agree byte-for-byte with the payload BEFORE it
+        # sizes the location loop — a lying count becomes a scoped
+        # error reply, not a struct.error mid-parse
+        if count < 0 or _REQ_HDR.size + count * _LOC.size != len(payload):
+            raise ValueError(
+                f"read request count {count} disagrees with payload "
+                f"{len(payload)}B"
+            )
         locs = []
         off = _REQ_HDR.size
         for _ in range(count):
@@ -355,6 +379,10 @@ class TcpChannel(Channel):
                 opcode, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
                 if length > _MAX_FRAME:
                     raise TransportError(f"oversized frame: {length}B")
+                if wiredbg.wire_debug_enabled():
+                    herr = wiredbg.header_error("tcp", opcode, length)
+                    if herr is not None:
+                        raise TransportError(f"wireDebug: {herr}")
                 self._m_msgs_recv.inc()
                 self._m_bytes_recv.inc(_HDR.size + length)
                 if opcode == OP_READ_RESP:
@@ -367,6 +395,9 @@ class TcpChannel(Channel):
                     continue
                 payload = _recv_exact(self._sock, length) if length else b""
                 if opcode == OP_RPC:
+                    if (wiredbg.wire_debug_enabled()
+                            and not wiredbg.rpc_frame_ok("tcp", payload)):
+                        continue  # counted + logged; ONE frame dropped
                     self.node.dispatch_frame(self, payload)
                 elif opcode == OP_READ_REQ:
                     # serve OFF the reader thread: one large read must
@@ -381,6 +412,15 @@ class TcpChannel(Channel):
                         _req_cost(payload), mkey=_req_mkey(payload),
                     )
                 else:
+                    # an unknown opcode means the byte stream is
+                    # desynced — the CHANNEL must die (there is no way
+                    # to find the next frame boundary), but it is
+                    # counted and scoped: outstanding reads fail with
+                    # a structured error and the node stays up
+                    counter(
+                        "wire_unknown_frames_total",
+                        engine="tcp", kind="opcode",
+                    ).inc()
                     raise TransportError(f"unknown opcode {opcode}")
         except BaseException as e:
             if self.state not in (ChannelState.STOPPED,):
@@ -431,14 +471,39 @@ class TcpChannel(Channel):
                 for _ in range(count):
                     (n,) = _LEN.unpack_from(payload, off)
                     off += _LEN.size
+                    if n > len(payload) - off:
+                        # a lying length prefix must fail loudly, not
+                        # silently truncate the block (bounds
+                        # discipline: every wire length is checked
+                        # against the bytes actually received)
+                        raise TransportError(
+                            f"block length {n}B exceeds response "
+                            f"remainder {len(payload) - off}B"
+                        )
                     blocks.append(payload[off: off + n])
                     off += n
                     if on_progress is not None:
                         self._safe_progress(on_progress, n)
             else:
-                blocks, err = [], None
+                blocks, err, remaining = [], None, body
                 for i in range(count):
+                    if remaining < _LEN.size:
+                        raise TransportError(
+                            f"short read response: {remaining}B left "
+                            f"before block {i} of {count}"
+                        )
                     (n,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+                    remaining -= _LEN.size
+                    if n > remaining:
+                        # without this check a lying prefix would read
+                        # INTO the next frame's bytes (or hang waiting
+                        # for bytes that never come) — the frame's
+                        # declared length is the hard bound
+                        raise TransportError(
+                            f"block length {n}B exceeds response "
+                            f"remainder {remaining}B"
+                        )
+                    remaining -= n
                     d = dest[i] if i < len(dest) else None
                     if d is None:
                         blocks.append(self._recv_payload(n))
@@ -616,11 +681,24 @@ class TcpNetwork:
             except OSError:
                 return  # listener closed
             try:
-                magic, type_idx, src_port, _ = _HELLO.unpack(
+                magic, type_idx, src_port, version = _HELLO.unpack(
                     _recv_exact(sock, _HELLO.size)
                 )
                 if magic != _MAGIC or type_idx >= len(_TYPE_BY_INDEX):
                     raise TransportError(f"bad hello from {addr}")
+                if version != WIRE_VERSION:
+                    # structured rejection: NAK byte + both versions,
+                    # so the connector's error can name them (old
+                    # pre-versioning hellos carry 0 here)
+                    sock.sendall(  # noqa: PY10 - 5B one-shot handshake NAK
+                        b"\x00" + _HELLO_REJ.pack(WIRE_VERSION, version)
+                    )
+                    counter("wire_version_rejects_total").inc()
+                    raise TransportError(
+                        f"protocol version mismatch from {addr}: hello "
+                        f"spoke wire version {version}, this node "
+                        f"requires {WIRE_VERSION}"
+                    )
                 req_type = _TYPE_BY_INDEX[type_idx]
                 sock.sendall(b"\x01")  # ack (ESTABLISHED)
             except BaseException:
@@ -647,11 +725,26 @@ class TcpNetwork:
             sock.settimeout(timeout_s)
             sock.sendall(_HELLO.pack(
                 _MAGIC, _TYPE_BY_INDEX.index(channel_type),
-                src.address[1], 0,
+                src.address[1], WIRE_VERSION,
             ))
             ack = _recv_exact(sock, 1)
             if ack != b"\x01":
-                raise TransportError(f"handshake rejected by {peer}")
+                detail = ""
+                if ack == b"\x00":
+                    # structured version rejection carries both sides
+                    try:
+                        srv_ver, cli_ver = _HELLO_REJ.unpack(
+                            _recv_exact(sock, _HELLO_REJ.size)
+                        )
+                        detail = (
+                            f": peer requires wire version {srv_ver}, "
+                            f"this hello spoke {cli_ver}"
+                        )
+                    except TransportError:
+                        pass
+                raise TransportError(
+                    f"handshake rejected by {peer}{detail}"
+                )
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except socket.timeout as e:

@@ -29,6 +29,11 @@ from typing import Dict, Tuple
 _LOCATION_STRUCT = struct.Struct("<qii")
 LOCATION_ENTRY_SIZE = _LOCATION_STRUCT.size  # 16
 
+# String/port wire pieces — offsets always advance by these ``.size``
+# constants, never by integer literals (wirecheck WC04).
+_U16 = struct.Struct("<H")
+_I32 = struct.Struct("<i")
+
 
 @dataclass(frozen=True, slots=True)
 class BlockLocation:
@@ -68,21 +73,22 @@ def _write_utf8(buf: bytearray, s: str) -> None:
     raw = s.encode("utf-8")
     if len(raw) > 0xFFFF:
         raise ValueError(f"string too long for wire format: {len(raw)}")
-    buf += struct.pack("<H", len(raw))
+    buf += _U16.pack(len(raw))
     buf += raw
 
 
 def _read_utf8(view: memoryview, offset: int) -> Tuple[str, int]:
-    if offset + 2 > len(view):
+    if offset + _U16.size > len(view):
         raise ValueError(f"truncated string header at offset {offset}")
-    (n,) = struct.unpack_from("<H", view, offset)
-    end = offset + 2 + n
+    (n,) = _U16.unpack_from(view, offset)
+    start = offset + _U16.size
+    end = start + n
     if end > len(view):
         raise ValueError(
-            f"truncated string: need {n}B at offset {offset + 2}, "
-            f"have {len(view) - offset - 2}B"
+            f"truncated string: need {n}B at offset {start}, "
+            f"have {len(view) - start}B"
         )
-    s = bytes(view[offset + 2 : end]).decode("utf-8")
+    s = bytes(view[start:end]).decode("utf-8")
     return s, end
 
 
@@ -102,20 +108,20 @@ class BlockManagerId:
     def write(self, buf: bytearray) -> None:
         _write_utf8(buf, self.executor_id)
         _write_utf8(buf, self.host)
-        buf += struct.pack("<i", self.port)
+        buf += _I32.pack(self.port)
 
     @staticmethod
     def read(view: memoryview, offset: int = 0) -> Tuple["BlockManagerId", int]:
         executor_id, offset = _read_utf8(view, offset)
         host, offset = _read_utf8(view, offset)
-        (port,) = struct.unpack_from("<i", view, offset)
-        return BlockManagerId(executor_id, host, port), offset + 4
+        (port,) = _I32.unpack_from(view, offset)
+        return BlockManagerId(executor_id, host, port), offset + _I32.size
 
     def serialized_length(self) -> int:
         return (
-            2 + len(self.executor_id.encode("utf-8"))
-            + 2 + len(self.host.encode("utf-8"))
-            + 4
+            _U16.size + len(self.executor_id.encode("utf-8"))
+            + _U16.size + len(self.host.encode("utf-8"))
+            + _I32.size
         )
 
 
@@ -134,20 +140,20 @@ class ShuffleManagerId:
 
     def write(self, buf: bytearray) -> None:
         _write_utf8(buf, self.host)
-        buf += struct.pack("<i", self.port)
+        buf += _I32.pack(self.port)
         self.block_manager_id.write(buf)
 
     @staticmethod
     def read(view: memoryview, offset: int = 0) -> Tuple["ShuffleManagerId", int]:
         host, offset = _read_utf8(view, offset)
-        (port,) = struct.unpack_from("<i", view, offset)
-        bmid, offset = BlockManagerId.read(view, offset + 4)
+        (port,) = _I32.unpack_from(view, offset)
+        bmid, offset = BlockManagerId.read(view, offset + _I32.size)
         return get_cached_shuffle_manager_id(ShuffleManagerId(host, port, bmid)), offset
 
     def serialized_length(self) -> int:
         return (
-            2 + len(self.host.encode("utf-8"))
-            + 4
+            _U16.size + len(self.host.encode("utf-8"))
+            + _I32.size
             + self.block_manager_id.serialized_length()
         )
 

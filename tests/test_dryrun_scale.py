@@ -303,7 +303,7 @@ def test_async_dispatcher_bounded_threads_fds_at_high_peer_count():
         for i in range(n_peers):
             s = socket.create_connection(("127.0.0.1", port), timeout=10)
             s.sendall(wire._HELLO.pack(wire._MAGIC, type_idx,
-                                       50000 + i, 0))
+                                       50000 + i, wire.WIRE_VERSION))
             assert s.recv(1) == b"\x01", f"handshake {i} rejected"
             s.settimeout(30)
             socks.append(s)

@@ -1,0 +1,486 @@
+"""Schema-driven frame fuzz: truncated/mutated/bit-flipped frames fed
+into ``decode_msg`` and BOTH engines' live receive machines under
+``wireDebug``.  Everything must fail clean — structured
+WireFormatError/TransportError, one-frame (or one-channel) blast
+radius, healthy node afterward, zero ledger leaks, never a hang."""
+
+import random
+import socket
+import struct
+import threading
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY, counter
+from sparkrdma_tpu.rpc.messages import (
+    CleanShuffleMsg,
+    FetchMapStatusFailedMsg,
+    FetchMapStatusMsg,
+    HeartbeatMsg,
+    HelloMsg,
+    PrefetchHintMsg,
+    WireFormatError,
+    decode_msg,
+)
+from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+from sparkrdma_tpu.transport import tcp as wire
+from sparkrdma_tpu.transport.channel import (
+    ChannelType,
+    FnCompletionListener,
+    TransportError,
+)
+from sparkrdma_tpu.transport.node import Node
+from sparkrdma_tpu.utils import wiredbg
+from sparkrdma_tpu.utils.ledger import get_resource_ledger
+from sparkrdma_tpu.utils.types import (
+    BlockLocation,
+    BlockManagerId,
+    ShuffleManagerId,
+)
+
+BASE_PORT = 26200
+
+
+def _smid(i):
+    return ShuffleManagerId(
+        f"host{i}", 9000 + i, BlockManagerId(str(i), f"host{i}", 7000 + i)
+    )
+
+
+def _corpus():
+    """Valid frames across fixed, variable-length, and nested layouts."""
+    return [
+        m.encode()
+        for m in (
+            HelloMsg(_smid(1), channel_port=4242),
+            HeartbeatMsg(_smid(2), seq=7, is_ack=True),
+            CleanShuffleMsg(3),
+            FetchMapStatusFailedMsg(5, reason="lost executor"),
+            FetchMapStatusMsg(
+                _smid(3), _smid(4), 1, 9, block_ids=[(0, 1), (2, 3)]
+            ),
+            PrefetchHintMsg(2, locations=[BlockLocation(0, 64, 5)]),
+        )
+    ]
+
+
+def _mutants(rng):
+    """≥200 hostile frames: truncations, bit flips, byte substitutions,
+    header lies, raw garbage."""
+    muts = []
+    for f in _corpus():
+        L = len(f)
+        for cut in sorted({0, 1, 3, 4, 7, L // 2, L - 1}):
+            if cut < L:
+                muts.append(f[:cut])
+        for _ in range(10):
+            b = bytearray(f)
+            b[rng.randrange(L)] ^= 1 << rng.randrange(8)
+            muts.append(bytes(b))
+        for _ in range(8):
+            b = bytearray(f)
+            b[rng.randrange(L)] = rng.randrange(256)
+            muts.append(bytes(b))
+        # length-field lie and unknown-type lie
+        muts.append(struct.pack("<i", L + 99) + f[4:])
+        muts.append(f[:4] + struct.pack("<i", 99) + f[8:])
+    for _ in range(40):
+        muts.append(bytes(rng.randrange(256) for _ in range(
+            rng.randrange(0, 64)
+        )))
+    return muts
+
+
+@pytest.fixture()
+def wire_debug():
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    wiredbg.set_wire_debug(True)
+    yield
+    wiredbg.set_wire_debug(False)
+    GLOBAL_REGISTRY.enabled = prev
+
+
+@pytest.fixture()
+def metrics_on():
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    yield
+    GLOBAL_REGISTRY.enabled = prev
+
+
+@pytest.fixture()
+def ledger():
+    """resourceDebug analog: track transport resources during the fuzz
+    and require a clean ledger after teardown."""
+    led = get_resource_ledger()
+    was = led.enabled
+    led.enabled = True
+    yield led
+    led.enabled = was
+
+
+# -- decode_msg fuzz (pure codec layer) ---------------------------------------
+
+
+def test_decode_fuzz_fail_clean():
+    """Every hostile frame either decodes or raises WireFormatError (a
+    ValueError) — never any other exception, never a hang."""
+    muts = _mutants(random.Random(0xC0DEC))
+    assert len(muts) >= 200, len(muts)
+    outcomes = {"ok": 0, "rejected": 0}
+    for m in muts:
+        try:
+            decode_msg(m)
+            outcomes["ok"] += 1
+        except WireFormatError as e:
+            assert isinstance(e, ValueError)
+            outcomes["rejected"] += 1
+    assert outcomes["rejected"] > 100, outcomes
+    # the decoder holds no state: valid frames still decode after
+    for f in _corpus():
+        assert decode_msg(f).encode() == f
+
+
+def test_decode_rejections_carry_structure():
+    truncated = _corpus()[0][:6]
+    with pytest.raises(WireFormatError):
+        decode_msg(truncated)
+    unknown = struct.pack("<ii", 12, 99) + b"\x00" * 4
+    with pytest.raises(WireFormatError) as ei:
+        decode_msg(unknown)
+    assert ei.value.unknown_type and ei.value.msg_type == 99
+
+
+# -- live engines: raw-socket frame injection ---------------------------------
+
+
+def _handshake(port, version=wire.WIRE_VERSION, src_port=55555):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(10)
+    s.sendall(wire._HELLO.pack(
+        wire._MAGIC,
+        wire._TYPE_BY_INDEX.index(ChannelType.RPC_REQUESTOR),
+        src_port, version,
+    ))
+    ack = s.recv(1)
+    return s, ack
+
+
+def _recv_eof(s, timeout=10):
+    s.settimeout(timeout)
+    try:
+        return s.recv(1) == b""
+    except OSError:
+        return True  # reset counts as closed
+
+
+def _rpc_frame(payload):
+    return wire._HDR.pack(wire.OP_RPC, len(payload)) + payload
+
+
+def _fuzz_one_engine(port, async_mode, wiredbg_engine):
+    """Shared engine harness: malformed RPC frames are dropped one by
+    one (channel survives), an unknown opcode kills only that channel,
+    and the node keeps accepting/dispatching afterwards."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportAsyncDispatcher": async_mode,
+    })
+    net = TcpNetwork()
+    node = Node(("127.0.0.1", port), conf)
+    net.register(node)
+    sentinel = CleanShuffleMsg(424242).encode()
+    seen = threading.Event()
+
+    def on_frame(_channel, frame):
+        if bytes(frame) == sentinel:
+            seen.set()
+
+    node.set_receive_listener(on_frame)
+
+    def rejected():
+        return counter(
+            "wire_frames_rejected_total",
+            engine=wiredbg_engine, opcode="rpc",
+        ).value
+
+    try:
+        base_rej = rejected()
+        s, ack = _handshake(port)
+        assert ack == b"\x01"
+        muts = _mutants(random.Random(0xBADF00D + port))
+        for m in muts:
+            s.sendall(_rpc_frame(m))
+        # the channel survived every malformed frame: a valid frame
+        # still reaches the application listener on the SAME socket
+        s.sendall(_rpc_frame(sentinel))
+        assert seen.wait(20), "valid frame lost after fuzz"
+        assert rejected() - base_rej > 100
+        # unknown opcode = desynced stream: THIS channel dies...
+        s.sendall(wire._HDR.pack(77, 0))
+        assert _recv_eof(s), "channel with desynced framing not closed"
+        # ...but the node is healthy: fresh connection, frame dispatched
+        seen.clear()
+        s2, ack2 = _handshake(port, src_port=55556)
+        assert ack2 == b"\x01"
+        s2.sendall(_rpc_frame(sentinel))
+        assert seen.wait(20), "node unhealthy after channel death"
+        s2.close()
+        s.close()
+    finally:
+        node.stop()
+        net.unregister(node)
+
+
+def test_threaded_engine_survives_frame_fuzz(wire_debug, ledger):
+    _fuzz_one_engine(BASE_PORT, "off", "tcp")
+    assert ledger.outstanding() == {}, ledger.outstanding()
+
+
+def test_async_engine_survives_frame_fuzz(wire_debug, ledger):
+    _fuzz_one_engine(BASE_PORT + 20, "on", "dispatcher")
+    assert ledger.outstanding() == {}, ledger.outstanding()
+
+
+# -- lying read-response bodies vs both requester state machines --------------
+
+
+def _lying_responder(port, ready, n_lie):
+    """Fake peer: acks the hello, reads the OP_READ_REQ frame, then
+    answers with a block-length prefix that exceeds the response body."""
+    srv = socket.create_server(("127.0.0.1", port))
+    ready.set()
+    sock, _addr = srv.accept()
+    sock.settimeout(10)
+    try:
+        hello = b""
+        while len(hello) < wire._HELLO.size:
+            hello += sock.recv(wire._HELLO.size - len(hello))
+        sock.sendall(b"\x01")
+        hdr = b""
+        while len(hdr) < wire._HDR.size:
+            hdr += sock.recv(wire._HDR.size - len(hdr))
+        opcode, length = wire._HDR.unpack(hdr)
+        assert opcode == wire.OP_READ_REQ
+        payload = b""
+        while len(payload) < length:
+            payload += sock.recv(length - len(payload))
+        req_id, _count = wire._REQ_HDR.unpack_from(payload, 0)
+        body = (
+            wire._RESP_HDR.pack(req_id, 0)
+            + wire._LEN.pack(n_lie)
+            + b"xx"  # far fewer bytes than the prefix claims
+        )
+        sock.sendall(wire._HDR.pack(wire.OP_READ_RESP, len(body)) + body)
+        _recv_eof(sock)  # hold the socket until the requester gives up
+    finally:
+        sock.close()
+        srv.close()
+
+
+@pytest.mark.parametrize("async_mode,port", [
+    ("off", BASE_PORT + 40),
+    ("on", BASE_PORT + 60),
+])
+def test_lying_block_length_prefix_fails_structured(
+    async_mode, port, wire_debug, ledger
+):
+    """A response whose block-length prefix exceeds the frame's actual
+    body must fail the read with a TransportError on both engines —
+    never allocate from the lie, never hang waiting for phantom
+    bytes."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportAsyncDispatcher": async_mode,
+        "spark.shuffle.tpu.connectTimeout": "5s",
+    })
+    net = TcpNetwork()
+    node = Node(("127.0.0.1", port), conf)
+    net.register(node)
+    ready = threading.Event()
+    peer_port = port + 7
+    t = threading.Thread(
+        target=_lying_responder, args=(peer_port, ready, 1 << 29),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(5)
+    done = threading.Event()
+    res = {}
+    try:
+        ch = node.get_channel(
+            ("127.0.0.1", peer_port),
+            ChannelType.READ_REQUESTOR, net.connect,
+        )
+        ch.read_blocks(
+            [BlockLocation(0, 100, 1)],
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("ok", blocks), done.set()),
+                lambda e: (res.setdefault("err", e), done.set()),
+            ),
+        )
+        assert done.wait(20), "lying response hung the requester"
+        assert "err" in res, res
+        assert isinstance(res["err"], TransportError)
+    finally:
+        node.stop()
+        net.unregister(node)
+        t.join(timeout=10)
+    assert ledger.outstanding() == {}, ledger.outstanding()
+
+
+# -- loopback plane: dropped frames must still return recv credits ------------
+
+
+def test_loopback_drops_bad_frames_and_credits_flow(wire_debug):
+    """With wireDebug on, the loopback dispatch plane drops malformed
+    frames (counted) while their recv slots are still consumed — far
+    more bad frames than any credit window must all complete, and a
+    trailing valid frame still arrives."""
+    net = LoopbackNetwork()
+    a = Node(("127.0.0.1", BASE_PORT + 80), TpuShuffleConf())
+    b = Node(("127.0.0.1", BASE_PORT + 87), TpuShuffleConf())
+    net.register(a)
+    net.register(b)
+    sentinel = CleanShuffleMsg(99).encode()
+    seen = threading.Event()
+    got = []
+
+    def on_frame(_channel, frame):
+        got.append(bytes(frame))
+        if bytes(frame) == sentinel:
+            seen.set()
+
+    b.set_receive_listener(on_frame)
+
+    def rejected():
+        return counter(
+            "wire_frames_rejected_total", engine="loopback", opcode="rpc"
+        ).value
+
+    base = rejected()
+    try:
+        ch = a.get_channel(b.address, ChannelType.RPC_REQUESTOR, net.connect)
+        bad = b"\xde\xad\xbe\xef"
+        sent = threading.Event()
+        for i in range(128):
+            ch.send_rpc([bad], FnCompletionListener())
+        ch.send_rpc([sentinel], FnCompletionListener(
+            lambda *_a: sent.set(), lambda _e: sent.set()
+        ))
+        assert sent.wait(20), "sends stalled: dropped frames leaked credits"
+        assert seen.wait(20), "valid frame lost behind dropped frames"
+        assert rejected() - base >= 128
+        assert bad not in got, "malformed frame reached the listener"
+    finally:
+        a.stop()
+        b.stop()
+        net.unregister(a)
+        net.unregister(b)
+
+
+# -- control plane: unknown msg_type is counted, not a crash ------------------
+
+
+def test_manager_counts_and_drops_unknown_control_frames(metrics_on):
+    """satellite 1: a control frame with an unknown MSG_TYPE (or a
+    malformed body) must be counted + dropped by the manager's receive
+    dispatch — a structured one-frame loss, never an exception up the
+    transport stack."""
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": BASE_PORT + 100,
+    })
+    driver = TpuShuffleManager(
+        conf, is_driver=True, network=LoopbackNetwork(),
+        port=BASE_PORT + 100, stage_to_device=False,
+    )
+    try:
+        def unknown_count(kind):
+            return counter(
+                "wire_unknown_frames_total", engine="control", kind=kind
+            ).value
+
+        base_t, base_m = unknown_count("msg_type"), unknown_count("malformed")
+        driver._receive(None, struct.pack("<ii", 12, 99) + b"\x00" * 4)
+        driver._receive(None, b"\x03")  # truncated header
+        hello = HelloMsg(_smid(1), channel_port=1).encode()
+        driver._receive(None, hello[:-2])  # schema underrun
+        assert unknown_count("msg_type") - base_t == 1
+        assert unknown_count("malformed") - base_m == 2
+    finally:
+        driver.stop()
+
+
+# -- hello/version handshake (satellite 2) ------------------------------------
+
+
+@pytest.mark.parametrize("async_mode,port", [
+    ("off", BASE_PORT + 120),
+    ("on", BASE_PORT + 140),
+])
+def test_old_version_hello_rejected_structurally(async_mode, port, metrics_on):
+    """A pre-upgrade hello (version 0 — what pre-versioning peers sent
+    in the old pad slot) gets the structured NAK naming both versions,
+    on both engines' accept paths."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportAsyncDispatcher": async_mode,
+    })
+    net = TcpNetwork()
+    node = Node(("127.0.0.1", port), conf)
+    net.register(node)
+    try:
+        base = counter("wire_version_rejects_total").value
+        s, ack = _handshake(port, version=0)
+        assert ack == b"\x00"
+        srv_ver, hello_ver = wire._HELLO_REJ.unpack(
+            s.recv(wire._HELLO_REJ.size)
+        )
+        assert (srv_ver, hello_ver) == (wire.WIRE_VERSION, 0)
+        assert _recv_eof(s)
+        assert counter("wire_version_rejects_total").value - base == 1
+        # the node still accepts current-version hellos
+        s2, ack2 = _handshake(port)
+        assert ack2 == b"\x01"
+        s2.close()
+    finally:
+        node.stop()
+        net.unregister(node)
+
+
+def test_connector_names_both_versions_on_rejection():
+    """The connecting side of a version NAK raises a TransportError
+    naming the peer's required version AND the hello's own."""
+    port = BASE_PORT + 160
+    ready = threading.Event()
+
+    def future_server():
+        srv = socket.create_server(("127.0.0.1", port))
+        ready.set()
+        sock, _addr = srv.accept()
+        hello = b""
+        while len(hello) < wire._HELLO.size:
+            hello += sock.recv(wire._HELLO.size - len(hello))
+        sock.sendall(b"\x00" + wire._HELLO_REJ.pack(9, wire.WIRE_VERSION))
+        sock.close()
+        srv.close()
+
+    t = threading.Thread(target=future_server, daemon=True)
+    t.start()
+    assert ready.wait(5)
+    net = TcpNetwork()
+    node = Node(("127.0.0.1", port + 7), TpuShuffleConf({
+        "spark.shuffle.tpu.connectTimeout": "5s",
+    }))
+    try:
+        with pytest.raises(TransportError) as ei:
+            net.connect(
+                node, ("127.0.0.1", port), ChannelType.RPC_REQUESTOR
+            )
+        msg = str(ei.value)
+        assert "wire version 9" in msg
+        assert f"spoke {wire.WIRE_VERSION}" in msg
+    finally:
+        node.stop()
+        t.join(timeout=10)

@@ -1,0 +1,467 @@
+"""Tier-1 wrapper + unit fixtures for the wire-protocol conformance
+gate (tools/wirecheck.py): the real tree must be clean with a nonempty
+schema census, and seeded wire-contract violations must each produce
+exactly their WC finding."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_wirecheck():
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_wirecheck", REPO / "tools" / "wirecheck.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _analyze_src(tmp_path, src: str):
+    wc = _load_wirecheck()
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(src))
+    return wc.analyze([f], root=tmp_path)
+
+
+def _codes(findings):
+    return sorted(code for _rel, _line, code, _msg in findings)
+
+
+# -- tier-1: the real tree ----------------------------------------------------
+
+
+def test_wire_surface_is_wirecheck_clean():
+    wc = _load_wirecheck()
+    findings = wc.analyze(wc.DEFAULT_PATHS)
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {code} {msg}" for rel, line, code, msg in findings
+    )
+
+
+def test_wire_census_is_nonempty():
+    """Clean AND nonempty: the analyzer actually discovered the wire
+    population (a discovery regression would pass vacuously).  Floor:
+    the 12 message schemas, the MSG_TYPES registry, the 3 transport
+    opcodes."""
+    wc = _load_wirecheck()
+    an = wc.Analyzer()
+    findings = an.analyze_paths(wc.DEFAULT_PATHS)
+    assert not findings
+    assert an.schema_count >= 12, an.schema_count
+    n_reg = sum(len(m.registry or ()) for m in an.modules.values())
+    n_ops = sum(len(m.op_consts) for m in an.modules.values())
+    assert n_reg >= 12, n_reg
+    assert n_ops >= 3, n_ops
+    assert len(an.struct_fmts) >= 10, sorted(an.struct_fmts)
+
+
+# -- WC01: pack/unpack asymmetry ----------------------------------------------
+
+
+def test_wc01_non_little_endian_format(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        HDR = struct.Struct(">iB")
+    """)
+    assert _codes(findings) == ["WC01"]
+
+
+def test_wc01_native_endianness_format(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        def enc(a):
+            return struct.pack("ii", a, a)
+    """)
+    assert _codes(findings) == ["WC01"]
+
+
+def test_wc01_pack_arity_mismatch(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        PAIR = struct.Struct("<ii")
+        def enc(a):
+            return PAIR.pack(a)
+    """)
+    assert _codes(findings) == ["WC01"]
+
+
+def test_wc01_unpack_target_count_mismatch(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        PAIR = struct.Struct("<ii")
+        def dec(buf):
+            a, b, c = PAIR.unpack_from(buf, 0)
+            return a, b, c
+    """)
+    assert _codes(findings) == ["WC01"]
+
+
+def test_wc01_matched_arity_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        PAIR = struct.Struct("<4sBHH")
+        def enc(m, c, p, v):
+            return PAIR.pack(m, c, p, v)
+        def dec(buf):
+            m, c, p, v = PAIR.unpack_from(buf, 0)
+            return m, c, p, v
+    """)
+    assert findings == []
+
+
+def test_wc01_derived_schema_shadowed_by_handwritten_codec(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        class Msg:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.i32("x"),)
+
+            def _payload(self):
+                return b""
+    """)
+    assert _codes(findings) == ["WC01"]
+
+
+def test_wc01_custom_schema_missing_codec_half(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        class Msg:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.custom("x", "<i"),)
+
+            def _payload(self):
+                return b""
+    """)
+    # missing _decode_payload AND _payload_size
+    assert _codes(findings) == ["WC01", "WC01"]
+
+
+def test_wc01_custom_codec_asymmetry(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+
+        class Msg:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.custom("x", "<i"),)
+
+            def _payload(self):
+                return struct.pack("<i", self.x)
+
+            def _payload_size(self):
+                return 8
+
+            @staticmethod
+            def _decode_payload(view):
+                (x,) = struct.unpack_from("<q", view, 0)
+                return Msg(x)
+    """)
+    # encoder writes '<i' never read; decoder reads '<q' never written
+    assert _codes(findings) == ["WC01", "WC01"]
+
+
+def test_wc01_symmetric_custom_codec_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+
+        class Msg:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.custom("xs", "<i count + count * <q"),)
+
+            def _payload(self):
+                buf = bytearray(struct.pack("<i", len(self.xs)))
+                for x in self.xs:
+                    buf += struct.pack("<q", x)
+                return bytes(buf)
+
+            def _payload_size(self):
+                return 4 + 8 * len(self.xs)
+
+            @staticmethod
+            def _decode_payload(view):
+                (n,) = struct.unpack_from("<i", view, 0)
+                if n * 8 > len(view):
+                    raise ValueError("count overruns buffer")
+                xs = struct.unpack_from(f"<{n}q", view, 4)
+                return Msg(xs)
+    """)
+    assert findings == []
+
+
+# -- WC02: MSG_TYPE registry integrity ----------------------------------------
+
+
+def test_wc02_duplicate_msg_type(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        class A:
+            MSG_TYPE = 5
+            WIRE_SCHEMA = (F.i32("x"),)
+
+        class B:
+            MSG_TYPE = 5
+            WIRE_SCHEMA = (F.i32("y"),)
+    """)
+    assert _codes(findings) == ["WC02"]
+
+
+def test_wc02_unregistered_message_class(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        class A:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.i32("x"),)
+
+        class B:
+            MSG_TYPE = 2
+            WIRE_SCHEMA = (F.i32("y"),)
+
+        MSG_TYPES = {1: A}
+    """)
+    assert _codes(findings) == ["WC02"]
+
+
+def test_wc02_registered_type_without_dispatch_handler(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        class A:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.i32("x"),)
+
+        class B:
+            MSG_TYPE = 2
+            WIRE_SCHEMA = (F.i32("y"),)
+
+        MSG_TYPES = {1: A, 2: B}
+
+        def _receive(node, msg):
+            if isinstance(msg, A):
+                return node.on_a(msg)
+    """)
+    assert _codes(findings) == ["WC02"]
+
+
+def test_wc02_full_registry_and_dispatch_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        class A:
+            MSG_TYPE = 1
+            WIRE_SCHEMA = (F.i32("x"),)
+
+        class B:
+            MSG_TYPE = 2
+            WIRE_SCHEMA = (F.i32("y"),)
+
+        MSG_TYPES = {1: A, 2: B}
+
+        def _receive(node, msg):
+            if isinstance(msg, (A, B)):
+                return node.handle(msg)
+    """)
+    assert findings == []
+
+
+# -- WC03: opcode/handler parity across engines -------------------------------
+
+
+def test_wc03_dead_opcode(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        OP_RPC = 1
+        OP_GHOST = 2
+
+        def _read_loop(self):
+            op = self.next_op()
+            if op == OP_RPC:
+                self.on_rpc()
+    """)
+    assert _codes(findings) == ["WC03"]
+
+
+def test_wc03_async_engine_missing_opcode(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        OP_RPC = 1
+        OP_READ = 2
+
+        def _read_loop(self):
+            op = self.next_op()
+            if op == OP_RPC:
+                self.on_rpc()
+            elif op == OP_READ:
+                self.on_read()
+
+        def _rx_dispatch(self):
+            op = self.next_op()
+            if op == OP_RPC:
+                self.on_rpc()
+    """)
+    assert _codes(findings) == ["WC03"]
+
+
+def test_wc03_loopback_without_analogs(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        OP_RPC = 1
+
+        def _read_loop(self):
+            op = self.next_op()
+            if op == OP_RPC:
+                self.on_rpc()
+
+        class LoopbackChannel:
+            def send(self, frame):
+                self.peer.deliver(frame)
+    """)
+    # no dispatch_frame analog AND no read_local_blocks analog
+    assert _codes(findings) == ["WC03", "WC03"]
+
+
+def test_wc03_parity_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        OP_RPC = 1
+        OP_READ = 2
+
+        def _read_loop(self):
+            op = self.next_op()
+            if op == OP_RPC:
+                self.on_rpc()
+            elif op == OP_READ:
+                self.on_read()
+
+        def _rx_dispatch(self):
+            op = self.next_op()
+            if op == OP_RPC:
+                self.on_rpc()
+            elif op == OP_READ:
+                self.on_read()
+
+        class LoopbackChannel:
+            def _deliver(self, frame):
+                self.remote.dispatch_frame(self, frame)
+
+            def _serve(self, req):
+                return self.node.read_local_blocks(req)
+    """)
+    assert findings == []
+
+
+# -- WC04: hand-written magic sizes -------------------------------------------
+
+
+def test_wc04_literal_size_constant(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        HEADER_SIZE = 8
+    """)
+    assert _codes(findings) == ["WC04"]
+
+
+def test_wc04_offset_advanced_by_literal(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        def dec(buf):
+            off = 0
+            off += 8
+            return buf[off]
+    """)
+    assert _codes(findings) == ["WC04"]
+
+
+def test_wc04_struct_derived_size_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        HDR = struct.Struct("<ii")
+        HEADER_SIZE = HDR.size
+
+        def dec(buf):
+            off = 0
+            off += HDR.size
+            return buf[off]
+    """)
+    assert findings == []
+
+
+# -- WC05: bounds discipline --------------------------------------------------
+
+
+def test_wc05_unguarded_count_sizes_a_loop(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        CNT = struct.Struct("<i")
+
+        def dec(buf):
+            (n,) = CNT.unpack_from(buf, 0)
+            return [read_one(buf, i) for i in range(n)]
+    """)
+    assert _codes(findings) == ["WC05"]
+
+
+def test_wc05_unguarded_length_sizes_a_slice(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        LEN = struct.Struct("<I")
+
+        def dec(buf, off):
+            (n,) = LEN.unpack_from(buf, off)
+            end = off + n
+            return buf[off:end]
+    """)
+    assert _codes(findings) == ["WC05"]
+
+
+def test_wc05_guard_call_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        CNT = struct.Struct("<i")
+
+        def dec(buf):
+            (n,) = CNT.unpack_from(buf, 0)
+            _check_count(n, 4, buf, CNT.size)
+            return [read_one(buf, i) for i in range(n)]
+    """)
+    assert findings == []
+
+
+def test_wc05_if_guard_that_raises_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        CNT = struct.Struct("<i")
+
+        def dec(buf):
+            (n,) = CNT.unpack_from(buf, 0)
+            if n < 0 or n > len(buf):
+                raise ValueError("count overruns buffer")
+            return bytearray(n)
+    """)
+    assert findings == []
+
+
+def test_wc05_try_containment_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        CNT = struct.Struct("<i")
+
+        def dec(buf):
+            try:
+                (n,) = CNT.unpack_from(buf, 0)
+                return bytearray(n)
+            except (ValueError, MemoryError):
+                return None
+    """)
+    assert findings == []
+
+
+def test_wc05_noqa_escape(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        CNT = struct.Struct("<i")
+
+        def dec(buf):
+            (n,) = CNT.unpack_from(buf, 0)
+            return bytearray(n)  # noqa: WC05
+    """)
+    assert findings == []
+
+
+def test_wrong_noqa_code_does_not_suppress(tmp_path):
+    findings = _analyze_src(tmp_path, """
+        import struct
+        CNT = struct.Struct("<i")
+
+        def dec(buf):
+            (n,) = CNT.unpack_from(buf, 0)
+            return bytearray(n)  # noqa: WC01
+    """)
+    assert _codes(findings) == ["WC05"]

@@ -29,6 +29,11 @@ Tenant-labeled QoS series (qos/broker.py) render as a per-tenant
 summary table (bytes served/decoded, in-flight, credit-wait time,
 admission rejections, degraded flag); ``--tenant NAME`` narrows every
 table to that tenant's series.
+
+Wire-validator series (utils/wiredbg.py, conf ``wireDebug``) render as
+a wire-health table — frames validated/rejected per engine and opcode,
+unknown-frame counts by kind, hello version rejections — so a snapshot
+diff shows exactly what the frame validator saw during a run.
 """
 
 from __future__ import annotations
@@ -421,6 +426,59 @@ def render_resources(counters: list, gauges: list) -> list:
     return out
 
 
+def render_wire_health(counters: list) -> list:
+    """Wire-health census (utils/wiredbg.py, conf wireDebug): one row
+    per engine/opcode pair — frames validated vs rejected — plus the
+    unknown-frame counts by kind (bad opcode, unknown msg_type,
+    malformed payload) and handshake version rejections.  A healthy
+    run shows zeros everywhere right of the validated column."""
+    rows: dict = {}
+    unknowns: dict = {}
+    version_rejects = 0.0
+    for c in counters:
+        labels = c.get("labels") or {}
+        if c["name"] in (
+            "wire_frames_validated_total", "wire_frames_rejected_total"
+        ):
+            key = (labels.get("engine", "?"), labels.get("opcode", "?"))
+            r = rows.setdefault(key, {"validated": 0.0, "rejected": 0.0})
+            field = (
+                "validated"
+                if c["name"] == "wire_frames_validated_total"
+                else "rejected"
+            )
+            r[field] += c["value"]
+        elif c["name"] == "wire_unknown_frames_total":
+            k = (labels.get("engine", "?"), labels.get("kind", "?"))
+            unknowns[k] = unknowns.get(k, 0.0) + c["value"]
+        elif c["name"] == "wire_version_rejects_total":
+            version_rejects += c["value"]
+    if not rows and not unknowns and not version_rejects:
+        return []
+    out = ["wire health (utils/wiredbg.py)"]
+    if rows:
+        width = max(
+            [len(f"{e}/{op}") for e, op in rows] + [12]
+        ) + 2
+        for (engine, opcode) in sorted(rows):
+            r = rows[(engine, opcode)]
+            rej = (
+                f"  REJECTED={r['rejected']:,.0f}" if r["rejected"] else ""
+            )
+            out.append(
+                f"  {f'{engine}/{opcode}':<{width}}"
+                f"validated={r['validated']:,.0f}{rej}"
+            )
+    for (engine, kind) in sorted(unknowns):
+        out.append(
+            f"  unknown frames ({engine}, {kind}): "
+            f"{unknowns[(engine, kind)]:,.0f}"
+        )
+    if version_rejects:
+        out.append(f"  hello version rejects: {version_rejects:,.0f}")
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
@@ -435,6 +493,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_decode_pipeline(counters))
     lines.extend(render_tier(counters, gauges))
     lines.extend(render_resources(counters, gauges))
+    lines.extend(render_wire_health(counters))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
     )

@@ -1,0 +1,768 @@
+#!/usr/bin/env python
+"""Wire-protocol conformance analyzer — the codec-symmetry gate.
+
+``make lint`` runs this next to tools/lint.py, tools/concheck.py and
+tools/flowcheck.py.  The control plane frames every message as ``4B
+length + 4B type`` over ``1B opcode + 4B length`` transport framing,
+and the review history of hand-written codecs is the usual one: a pack
+whose unpack reads one field fewer, a count field trusted before the
+bytes behind it exist, an offset advanced by a literal that silently
+drifts from the struct it mirrors.  rpc/messages.py now declares each
+message's layout as a ``WIRE_SCHEMA`` field table from which the codec
+pair is DERIVED — symmetry true by construction — and this pass checks
+everything the construction can't: the hand-written codecs, the
+type/opcode registries, and the bounds discipline of every decode
+path.  The runtime half is sparkrdma_tpu/utils/wiredbg.py (conf
+``spark.shuffle.tpu.wireDebug``).
+
+Findings:
+
+  WC01  pack/unpack asymmetry: a derived-schema class hand-writing
+        (shadowing) its codec, a custom-schema class missing one half
+        of the pair, encode/decode halves of a hand-written codec
+        using different struct layouts, a non-little-endian (no ``<``
+        prefix) struct format anywhere on the wire, or a
+        ``pack``/``unpack`` call whose argument/target count disagrees
+        with its struct's field count.
+  WC02  MSG_TYPE registry integrity: duplicate ids, a message class
+        the ``MSG_TYPES`` registry doesn't list, or a registered type
+        the receive dispatcher (``_receive``) never handles.
+  WC03  opcode/handler parity: every OP_* consumed by the threaded
+        reader loop must be consumed by the async recv machine with
+        the same sub-header structs, and the loopback plane must carry
+        both analogs (``dispatch_frame`` / ``read_local_blocks``).
+  WC04  hand-written magic sizes: a ``*_SIZE`` constant assigned an
+        integer literal, or offset arithmetic advancing by a literal,
+        where the value must derive from ``struct.Struct(...).size``.
+  WC05  bounds discipline: a count/length unpacked from the wire used
+        to size a loop, slice or allocation before any validation
+        against the received buffer (``_require``/``_check_count``, an
+        ``if``-guard that raises/returns, or a containing
+        ``try``/``except``).
+
+Suppressions are code-scoped: ``# noqa: WC05`` silences only WC05 on
+that line; a bare ``# noqa`` silences everything (discouraged).
+
+Usage: ``python tools/wirecheck.py [paths...]`` (default: the wire
+surface — rpc/, transport/, utils/types.py, utils/wiredbg.py,
+shuffle/manager.py).  Exit status 1 on any finding; on success prints
+the schema/opcode census.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+LIB = ROOT / "sparkrdma_tpu"
+DEFAULT_PATHS = [
+    LIB / "rpc",
+    LIB / "transport",
+    LIB / "utils" / "types.py",
+    LIB / "utils" / "wiredbg.py",
+    LIB / "shuffle" / "manager.py",
+]
+
+# ONE noqa grammar + suppression decision for all four gates:
+# tools/lint.py owns the definition (code-scoped sets, bare-noqa =
+# everything, alias handling)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from lint import _suppressed as _lint_suppressed  # noqa: E402
+
+Finding = Tuple[str, int, str, str]  # (rel, line, code, message)
+
+# sub-header structs whose consumption arity must match across engines
+_WIRE_HDRS = {"_HDR", "_REQ_HDR", "_RESP_HDR", "_LEN"}
+_GUARD_CALLS = {"_require", "_check_count"}
+_UNPACKS = {"unpack", "unpack_from"}
+
+
+def _last_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _fmt_value_count(fmt: str) -> Optional[int]:
+    """Number of Python values one struct format packs/unpacks."""
+    count, digits = 0, ""
+    for c in fmt.lstrip("<>=!@"):
+        if c.isdigit():
+            digits += c
+            continue
+        if c in "sp":
+            count += 1  # one bytes value regardless of repeat
+        elif c == "x":
+            pass  # pad byte: no value
+        elif c.isalpha() or c in "?":
+            count += int(digits) if digits else 1
+        else:
+            return None  # unrecognized (shouldn't happen on literals)
+        digits = ""
+    return count
+
+
+def _normalize_fmt(fmt: str) -> str:
+    """Layout signature for symmetry comparison: endianness prefix +
+    the letter codes, repeat counts dropped (``<4sBHH`` → ``<sBHH``,
+    ``<{e * e}q`` → ``<q``)."""
+    out = "<" if fmt.startswith("<") else ""
+    for c in fmt.lstrip("<>=!@"):
+        if c.isalpha() or c == "?":
+            out += c
+    return out
+
+
+def _literal_fmt(node: ast.AST) -> Optional[str]:
+    """Extract a format string from a Constant or an f-string whose
+    constant pieces carry the layout (placeholders are repeat counts)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return "".join(
+            v.value for v in node.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        )
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class MsgClass:
+    """One message class: its MSG_TYPE, schema shape, codec methods."""
+
+    def __init__(self, rel: str, name: str, line: int):
+        self.rel = rel
+        self.name = name
+        self.line = line
+        self.msg_type: Optional[int] = None
+        self.msg_type_line = line
+        self.schema_line = line
+        self.has_schema = False
+        self.has_custom = False
+        self.methods: Dict[str, ast.FunctionDef] = {}
+
+
+class ModuleInfo:
+    def __init__(self, rel: str, lines: List[str], tree: ast.Module):
+        self.rel = rel
+        self.lines = lines
+        self.tree = tree
+        self.structs: Dict[str, Tuple[str, int]] = {}  # name -> (fmt, line)
+        self.classes: List[MsgClass] = []
+        self.registry: Optional[List[str]] = None  # MSG_TYPES class names
+        self.registry_line = 0
+        self.dispatch_names: Optional[Set[str]] = None  # _receive isinstance
+        self.dispatch_line = 0
+        self.op_consts: Dict[str, int] = {}
+        self.op_lines: Dict[str, int] = {}
+        self.fns: Dict[str, ast.FunctionDef] = {}  # flat, by name
+        self.has_loopback = False
+        self.loopback_line = 0
+
+
+class Analyzer:
+    def __init__(self, root: pathlib.Path = ROOT):
+        self.root = root
+        self.findings: List[Finding] = []
+        self.modules: Dict[str, ModuleInfo] = {}
+        # merged struct registry: bare name -> set of formats seen
+        self.struct_fmts: Dict[str, Set[str]] = {}
+        self.schema_count = 0
+
+    def emit(self, rel: str, line: int, code: str, msg: str) -> None:
+        mod = self.modules.get(rel)
+        if mod is not None and _lint_suppressed(mod.lines, line, code):
+            return
+        self.findings.append((rel, line, code, msg))
+
+    # -- entry ---------------------------------------------------------------
+    def analyze_paths(self, paths) -> List[Finding]:
+        files: List[pathlib.Path] = []
+        for p in paths:
+            p = pathlib.Path(p)
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            else:
+                files.append(p)
+        for f in files:
+            self._load(f)
+        for mod in self.modules.values():
+            self._scan_structure(mod)
+        for mod in self.modules.values():
+            self._check_module(mod)
+        self._check_wc02()
+        self._check_wc03()
+        self.findings.sort(key=lambda x: (x[0], x[1], x[2]))
+        return self.findings
+
+    def _rel(self, path: pathlib.Path) -> str:
+        try:
+            return str(path.relative_to(self.root))
+        except ValueError:
+            return str(path)
+
+    def _load(self, path: pathlib.Path) -> None:
+        rel = self._rel(path)
+        try:
+            text = path.read_text()
+            tree = ast.parse(text)
+        except (UnicodeDecodeError, SyntaxError):
+            return  # tools/lint.py owns PY01
+        self.modules[rel] = ModuleInfo(rel, text.splitlines(), tree)
+
+    # -- structure pass ------------------------------------------------------
+    def _scan_structure(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                self._scan_assign(mod, node)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                plain = ast.Assign(targets=[node.target], value=node.value)
+                ast.copy_location(plain, node)
+                self._scan_assign(mod, plain)
+            elif isinstance(node, ast.FunctionDef):
+                mod.fns.setdefault(node.name, node)
+                if node.name == "_receive":
+                    mod.dispatch_names = self._isinstance_names(node)
+                    mod.dispatch_line = node.lineno
+            elif isinstance(node, ast.ClassDef):
+                if node.name == "LoopbackChannel":
+                    mod.has_loopback = True
+                    mod.loopback_line = node.lineno
+                self._scan_class(mod, node)
+
+    def _scan_assign(self, mod: ModuleInfo, node: ast.Assign) -> None:
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        v = node.value
+        if (isinstance(v, ast.Call) and _last_name(v.func) == "Struct"
+                and v.args):
+            fmt = _literal_fmt(v.args[0])
+            if fmt is not None:
+                mod.structs[name] = (fmt, node.lineno)
+                self.struct_fmts.setdefault(name, set()).add(fmt)
+        elif name == "MSG_TYPES":
+            mod.registry = self._registry_names(v)
+            mod.registry_line = node.lineno
+        elif (name.startswith("OP_")
+              and isinstance(v, ast.Constant) and isinstance(v.value, int)):
+            mod.op_consts[name] = v.value
+            mod.op_lines[name] = node.lineno
+
+    @staticmethod
+    def _registry_names(v: ast.AST) -> List[str]:
+        """Class names a MSG_TYPES registry lists — dict comprehension
+        over a tuple of classes, or a plain dict literal."""
+        if isinstance(v, ast.DictComp) and v.generators:
+            it = v.generators[0].iter
+            if isinstance(it, (ast.Tuple, ast.List)):
+                return [_last_name(e) for e in it.elts]
+        if isinstance(v, ast.Dict):
+            return [_last_name(e) for e in v.values]
+        return []
+
+    @staticmethod
+    def _isinstance_names(fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and _last_name(node.func) == "isinstance"
+                    and len(node.args) == 2):
+                t = node.args[1]
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                out.update(_last_name(e) for e in elts)
+        return out
+
+    def _scan_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        cls = MsgClass(mod.rel, node.name, node.lineno)
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef):
+                cls.methods[item.name] = item
+                mod.fns.setdefault(f"{node.name}.{item.name}", item)
+            target = value = None
+            if isinstance(item, ast.Assign) and len(item.targets) == 1:
+                target, value = item.targets[0], item.value
+            elif isinstance(item, ast.AnnAssign) and item.value is not None:
+                target, value = item.target, item.value
+            if not isinstance(target, ast.Name):
+                continue
+            if (target.id == "MSG_TYPE"
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, int)):
+                cls.msg_type = value.value
+                cls.msg_type_line = item.lineno
+            elif target.id == "WIRE_SCHEMA" and isinstance(
+                    value, (ast.Tuple, ast.List)) and value.elts:
+                cls.has_schema = True
+                cls.schema_line = item.lineno
+                for e in value.elts:
+                    if isinstance(e, ast.Call) and \
+                            _last_name(e.func) == "custom":
+                        cls.has_custom = True
+        if cls.msg_type is not None or cls.has_schema:
+            mod.classes.append(cls)
+            if cls.has_schema:
+                self.schema_count += 1
+
+    # -- per-module rules ----------------------------------------------------
+    def _check_module(self, mod: ModuleInfo) -> None:
+        self._check_wc01_formats(mod)
+        self._check_wc01_arity(mod)
+        self._check_wc04(mod)
+        for cls in mod.classes:
+            self._check_wc01_class(mod, cls)
+        for fn in mod.fns.values():
+            self._check_wc05(mod, fn)
+
+    # .. WC01: endianness of every wire format .............................
+    def _check_wc01_formats(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_name(node.func)
+            if name == "Struct" or (
+                name in {"pack", "pack_into", "calcsize"} | _UNPACKS
+                and isinstance(node.func, ast.Attribute)
+                and _last_name(node.func.value) == "struct"
+            ):
+                if not node.args:
+                    continue
+                fmt = _literal_fmt(node.args[0])
+                if fmt is not None and not fmt.startswith("<"):
+                    self.emit(
+                        mod.rel, node.lineno, "WC01",
+                        f"wire struct format {fmt!r} is not explicit "
+                        f"little-endian — prefix it with '<' (native "
+                        f"alignment/endianness is not a wire contract)",
+                    )
+
+    # .. WC01: pack/unpack arity vs the struct's field count ...............
+    def _resolve_fmt(self, node: ast.AST) -> Optional[str]:
+        """Format of the struct object a ``X.pack``/``X.unpack`` call
+        targets — only when the bare name resolves unambiguously."""
+        fmts = self.struct_fmts.get(_last_name(node))
+        return next(iter(fmts)) if fmts is not None and len(fmts) == 1 \
+            else None
+
+    def _check_wc01_arity(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth not in ("pack", "pack_into"):
+                    continue
+                fmt = self._resolve_fmt(node.func.value)
+                want = _fmt_value_count(fmt) if fmt is not None else None
+                if want is None:
+                    continue
+                args = node.args[2 if meth == "pack_into" else 0:]
+                if any(isinstance(a, ast.Starred) for a in args):
+                    continue
+                if len(args) != want:
+                    self.emit(
+                        mod.rel, node.lineno, "WC01",
+                        f"{_last_name(node.func.value)}.{meth} packs "
+                        f"{len(args)} value(s) but format {fmt!r} "
+                        f"carries {want}",
+                    )
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Tuple) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in _UNPACKS:
+                fmt = self._resolve_fmt(node.value.func.value)
+                want = _fmt_value_count(fmt) if fmt is not None else None
+                got = len(node.targets[0].elts)
+                if want is not None and got != want:
+                    self.emit(
+                        mod.rel, node.lineno, "WC01",
+                        f"{_last_name(node.value.func.value)}."
+                        f"{node.value.func.attr} unpacks into {got} "
+                        f"name(s) but format {fmt!r} carries {want}",
+                    )
+
+    # .. WC01: schema/codec shape + hand-written symmetry ...................
+    def _check_wc01_class(self, mod: ModuleInfo, cls: MsgClass) -> None:
+        if not cls.has_schema:
+            return
+        codec = {"_payload", "_decode_payload", "_payload_size"}
+        written = codec & set(cls.methods)
+        if not cls.has_custom:
+            for m in sorted(written):
+                self.emit(
+                    mod.rel, cls.methods[m].lineno, "WC01",
+                    f"{cls.name}.{m} hand-writes a codec the derived "
+                    f"WIRE_SCHEMA already provides — delete it or mark "
+                    f"the varying section as a custom field",
+                )
+            return
+        for m in sorted(codec - written):
+            self.emit(
+                mod.rel, cls.schema_line, "WC01",
+                f"{cls.name} declares custom wire sections but does "
+                f"not hand-write {m} — a one-sided codec cannot stay "
+                f"symmetric",
+            )
+        enc = cls.methods.get("_payload")
+        dec = cls.methods.get("_decode_payload")
+        if enc is None or dec is None:
+            return
+        enc_sig = self._codec_signature(enc, encode=True)
+        dec_sig = self._codec_signature(dec, encode=False)
+        for sig in sorted(enc_sig - dec_sig):
+            self.emit(
+                mod.rel, enc.lineno, "WC01",
+                f"{cls.name}._payload writes layout {sig!r} that "
+                f"_decode_payload never reads — pack/unpack asymmetry",
+            )
+        for sig in sorted(dec_sig - enc_sig):
+            self.emit(
+                mod.rel, dec.lineno, "WC01",
+                f"{cls.name}._decode_payload reads layout {sig!r} that "
+                f"_payload never writes — pack/unpack asymmetry",
+            )
+
+    def _codec_signature(self, fn: ast.FunctionDef,
+                         encode: bool) -> Set[str]:
+        """Normalized struct layouts one codec half touches.  Named
+        structs resolve through the registry; inline ``struct.*``
+        formats normalize directly; self-delimiting object codecs
+        (``x.write(buf)`` / ``Type.read(view, off)``) count as one
+        ``objcodec`` token."""
+        sigs: Set[str] = set()
+        half = ("pack", "pack_into") if encode else tuple(_UNPACKS)
+        obj_meth = "write" if encode else "read"
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            meth = node.func.attr
+            if meth in half:
+                if _last_name(node.func.value) == "struct":
+                    fmt = _literal_fmt(node.args[0]) if node.args else None
+                    if fmt is not None:
+                        sigs.add(_normalize_fmt(fmt))
+                else:
+                    fmt = self._resolve_fmt(node.func.value)
+                    if fmt is not None:
+                        sigs.add(_normalize_fmt(fmt))
+            elif meth == obj_meth:
+                sigs.add("objcodec")
+        return sigs
+
+    # .. WC02: MSG_TYPE registry integrity ..................................
+    def _check_wc02(self) -> None:
+        by_type: Dict[int, MsgClass] = {}
+        registry: Optional[Set[str]] = None
+        reg_mod: Optional[ModuleInfo] = None
+        dispatch: Optional[Set[str]] = None
+        all_classes: List[MsgClass] = []
+        for mod in self.modules.values():
+            all_classes.extend(mod.classes)
+            if mod.registry is not None:
+                registry = set(mod.registry)
+                reg_mod = mod
+            if mod.dispatch_names is not None:
+                dispatch = mod.dispatch_names
+        for cls in all_classes:
+            if not cls.msg_type:  # base class (0) is not a wire type
+                continue
+            prior = by_type.get(cls.msg_type)
+            if prior is not None:
+                self.emit(
+                    cls.rel, cls.msg_type_line, "WC02",
+                    f"duplicate MSG_TYPE {cls.msg_type}: {cls.name} "
+                    f"collides with {prior.name} "
+                    f"({prior.rel}:{prior.msg_type_line})",
+                )
+            else:
+                by_type[cls.msg_type] = cls
+            if registry is not None and cls.name not in registry:
+                self.emit(
+                    cls.rel, cls.msg_type_line, "WC02",
+                    f"{cls.name} (MSG_TYPE {cls.msg_type}) is not "
+                    f"listed in the MSG_TYPES registry — unregistered "
+                    f"frames decode as unknown-type errors",
+                )
+        if registry is not None and dispatch is not None and \
+                reg_mod is not None:
+            names = {c.name for c in all_classes}
+            for name in sorted(registry & names - dispatch):
+                self.emit(
+                    reg_mod.rel, reg_mod.registry_line, "WC02",
+                    f"registered type {name} has no isinstance handler "
+                    f"in the receive dispatcher (_receive) — its "
+                    f"frames decode and then vanish silently",
+                )
+
+    # .. WC03: opcode/handler parity across engines .........................
+    @staticmethod
+    def _consumed_ops(fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    name = _last_name(side)
+                    if name.startswith("OP_"):
+                        out.add(name)
+        return out
+
+    def _hdr_structs(self, fns: List[ast.FunctionDef]) -> Set[str]:
+        out: Set[str] = set()
+        for fn in fns:
+            for node in ast.walk(fn):
+                name = _last_name(node) if isinstance(
+                    node, (ast.Name, ast.Attribute)) else ""
+                if name in _WIRE_HDRS and name in self.struct_fmts:
+                    out.add(name)
+        return out
+
+    def _check_wc03(self) -> None:
+        threaded = async_mod = loopback = None
+        for mod in self.modules.values():
+            if "_read_loop" in mod.fns or any(
+                    k.endswith("._read_loop") for k in mod.fns):
+                if mod.op_consts:
+                    threaded = mod
+            if any(k.split(".")[-1] == "_rx_dispatch" for k in mod.fns):
+                async_mod = mod
+            if mod.has_loopback:
+                loopback = mod
+        if threaded is None:
+            return
+        t_fns = [fn for k, fn in threaded.fns.items()
+                 if k.split(".")[-1] in ("_read_loop", "_recv_read_resp")]
+        t_ops: Set[str] = set()
+        for fn in t_fns:
+            t_ops |= self._consumed_ops(fn)
+        defined = set(threaded.op_consts)
+        for op in sorted(defined - t_ops):
+            self.emit(
+                threaded.rel, threaded.op_lines[op], "WC03",
+                f"{op} is defined but the threaded reader loop never "
+                f"consumes it — dead opcode or missing handler branch",
+            )
+        if async_mod is not None:
+            a_fns = [fn for k, fn in async_mod.fns.items()
+                     if k.split(".")[-1].startswith("_rx_")]
+            a_ops: Set[str] = set()
+            for fn in a_fns:
+                a_ops |= self._consumed_ops(fn)
+            line = next(
+                (fn.lineno for k, fn in async_mod.fns.items()
+                 if k.split(".")[-1] == "_rx_dispatch"), 1)
+            for op in sorted((defined & t_ops) - a_ops):
+                self.emit(
+                    async_mod.rel, line, "WC03",
+                    f"{op} is consumed by the threaded reader loop but "
+                    f"not by the async recv machine — the engines "
+                    f"speak different protocols",
+                )
+            t_hdrs = self._hdr_structs(t_fns)
+            a_hdrs = self._hdr_structs(a_fns)
+            if t_hdrs != a_hdrs:
+                self.emit(
+                    async_mod.rel, line, "WC03",
+                    f"recv sub-header arity mismatch: threaded engine "
+                    f"reads {sorted(t_hdrs)}, async engine reads "
+                    f"{sorted(a_hdrs)}",
+                )
+        if loopback is not None:
+            called = {
+                _last_name(n.func) for n in ast.walk(loopback.tree)
+                if isinstance(n, ast.Call)
+            }
+            for analog, role in (
+                ("dispatch_frame", "the OP_RPC dispatch plane"),
+                ("read_local_blocks", "the OP_READ_REQ serve plane"),
+            ):
+                if analog not in called:
+                    self.emit(
+                        loopback.rel, loopback.loopback_line, "WC03",
+                        f"loopback engine never calls {analog} — "
+                        f"{role} has no in-process analog",
+                    )
+
+    # .. WC04: magic sizes ..................................................
+    def _check_wc04(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.isupper() \
+                    and node.targets[0].id.endswith("SIZE") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                self.emit(
+                    mod.rel, node.lineno, "WC04",
+                    f"{node.targets[0].id} is a hand-written integer "
+                    f"literal — derive it from struct.Struct(...).size "
+                    f"so it cannot drift from the layout",
+                )
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Add) \
+                    and self._is_offset(node.target) \
+                    and self._magic_int(node.value):
+                self.emit(
+                    mod.rel, node.lineno, "WC04",
+                    f"offset advanced by integer literal — advance by "
+                    f"the struct's .size so the stride cannot drift "
+                    f"from the layout",
+                )
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Add) \
+                    and (self._is_offset(node.left)
+                         and self._magic_int(node.right)
+                         or self._is_offset(node.right)
+                         and self._magic_int(node.left)):
+                self.emit(
+                    mod.rel, node.lineno, "WC04",
+                    f"offset arithmetic with an integer literal — use "
+                    f"the struct's .size so the stride cannot drift "
+                    f"from the layout",
+                )
+
+    @staticmethod
+    def _is_offset(node: ast.AST) -> bool:
+        name = _last_name(node)
+        return name in ("off", "offset") or name.endswith(("_off",
+                                                           "_offset"))
+
+    @staticmethod
+    def _magic_int(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Constant)
+                and isinstance(node.value, int)
+                and node.value >= 2)
+
+    # .. WC05: bounds discipline ............................................
+    def _check_wc05(self, mod: ModuleInfo, fn: ast.FunctionDef) -> None:
+        tainted: Set[str] = set()
+        guarded: Set[str] = set()
+
+        def live(names: Set[str]) -> Set[str]:
+            return {n for n in names & tainted if n not in guarded}
+
+        def guard_stmt(stmt: ast.stmt) -> None:
+            # a _require/_check_count call mentioning a tainted name
+            # validates it; an if-test mentioning one whose body
+            # raises/returns/continues is an inline guard
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        _last_name(node.func) in _GUARD_CALLS:
+                    for a in node.args:
+                        guarded.update(_names_in(a) & tainted)
+            if isinstance(stmt, ast.If) and any(
+                isinstance(n, (ast.Raise, ast.Return, ast.Continue))
+                for b in stmt.body for n in ast.walk(b)
+            ):
+                guarded.update(_names_in(stmt.test) & tainted)
+
+        def use_sites(stmt: ast.stmt, contained: bool) -> None:
+            if contained:
+                return  # a surrounding except handler fail-scopes it
+            for node in ast.walk(stmt):
+                bad: Set[str] = set()
+                where = ""
+                if isinstance(node, ast.Call):
+                    name = _last_name(node.func)
+                    if name in ("range", "bytearray"):
+                        for a in node.args:
+                            bad |= live(_names_in(a))
+                        where = f"{name}()"
+                elif isinstance(node, ast.Subscript) and isinstance(
+                        node.slice, ast.Slice):
+                    for part in (node.slice.lower, node.slice.upper,
+                                 node.slice.step):
+                        if part is not None:
+                            bad |= live(_names_in(part))
+                    where = "a slice"
+                for n in sorted(bad):
+                    guarded.add(n)  # report each name once
+                    self.emit(
+                        mod.rel, node.lineno, "WC05",
+                        f"wire-supplied value {n!r} sizes {where} "
+                        f"before any bounds check against the received "
+                        f"buffer — validate it first (_require / "
+                        f"_check_count / an if-guard that raises)",
+                    )
+
+        def walk(body: List[ast.stmt], contained: bool) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # scanned as their own functions
+                guard_stmt(stmt)
+                if isinstance(stmt, ast.Assign):
+                    v = stmt.value
+                    is_unpack = (isinstance(v, ast.Call)
+                                 and isinstance(v.func, ast.Attribute)
+                                 and v.func.attr in _UNPACKS)
+                    targets: Set[str] = set()
+                    for t in stmt.targets:
+                        targets |= _names_in(t)
+                    if is_unpack:
+                        tainted.update(targets)
+                        guarded.difference_update(targets)
+                    elif live(_names_in(v)):
+                        tainted.update(targets)  # taint propagates
+                        guarded.difference_update(targets)
+                # compound statements: only their header expressions are
+                # use sites here — their suites get their own visit (with
+                # the right try-containment) via the recursion below
+                if isinstance(stmt, (ast.If, ast.While)):
+                    use_sites(stmt.test, contained)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    use_sites(stmt.iter, contained)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        use_sites(item.context_expr, contained)
+                elif not isinstance(stmt, ast.Try):
+                    use_sites(stmt, contained)
+                if isinstance(stmt, ast.Try):
+                    inner = contained or bool(stmt.handlers)
+                    walk(stmt.body, inner)
+                    for h in stmt.handlers:
+                        walk(h.body, contained)
+                    walk(stmt.orelse, inner)
+                    walk(stmt.finalbody, contained)
+                elif isinstance(stmt, (ast.If, ast.While, ast.For,
+                                       ast.AsyncFor, ast.With,
+                                       ast.AsyncWith)):
+                    walk(stmt.body, contained)
+                    walk(getattr(stmt, "orelse", []), contained)
+
+        walk(fn.body, False)
+
+
+def analyze(paths, root: pathlib.Path = ROOT) -> List[Finding]:
+    return Analyzer(root=root).analyze_paths(paths)
+
+
+def main(argv) -> int:
+    paths = [pathlib.Path(a) for a in argv[1:]] or DEFAULT_PATHS
+    an = Analyzer()
+    findings = an.analyze_paths(paths)
+    for rel, line, code, msg in findings:
+        print(f"{rel}:{line}: {code} {msg}")
+    if findings:
+        print(f"wirecheck: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    n_ops = sum(len(m.op_consts) for m in an.modules.values())
+    n_reg = sum(len(m.registry or ()) for m in an.modules.values())
+    print(f"wirecheck: clean ({an.schema_count} message schema(s), "
+          f"{n_reg} registered type(s), {n_ops} opcode(s), "
+          f"{len(an.struct_fmts)} named wire struct(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
