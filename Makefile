@@ -53,6 +53,8 @@ bench-smoke:
 	python benchmarks/bench_terasort.py --out-of-core
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_qos.py
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	python benchmarks/bench_skew.py
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

@@ -19,7 +19,13 @@ import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import ROCE_LINE_RATE_GBPS, emit, maybe_spoof_cpu, time_iters
+from benchmarks.common import (
+    ROCE_LINE_RATE_GBPS,
+    emit,
+    maybe_spoof_cpu,
+    time_iters,
+    zipf_keys,
+)
 
 from sparkrdma_tpu.models.wordcount import WordCounter
 from sparkrdma_tpu.parallel.mesh import make_mesh
@@ -32,9 +38,10 @@ def main():
     mesh = make_mesh()
     wc = WordCounter(mesh)
     rng = np.random.default_rng(7)
-    # Zipf-ish word ids: heavy keys exercise the skew/capacity machinery
+    # Zipf word ids (rank-preserving): heavy keys exercise the
+    # skew/capacity machinery with an intact distribution head
     keys = jax.device_put(
-        (rng.zipf(1.3, n) % 100_000).astype(np.int32), wc.sharding
+        zipf_keys(rng, 1.3, n, 100_000, dtype=np.int32), wc.sharding
     )
     vals = jax.device_put(jnp.ones(n, jnp.int32), wc.sharding)
     n_local = n // wc.n_devices

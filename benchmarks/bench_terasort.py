@@ -35,6 +35,7 @@ from benchmarks.common import (
     maybe_spoof_cpu,
     time_iters,
     write_bench_json,
+    zipf_keys,
 )
 
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))
@@ -264,14 +265,22 @@ def main():
     from sparkrdma_tpu.parallel.mesh import make_mesh
 
     maybe_spoof_cpu()
-    log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    zipf = "--zipf" in sys.argv
+    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    log2 = int(argv[0]) if argv else 24
     n = 1 << log2
     mesh = make_mesh()
     sorter = TeraSorter(mesh)
     rng = np.random.default_rng(42)
-    keys = jax.device_put(
-        rng.integers(0, 1 << 31, n, dtype=np.int32), sorter.sharding
-    )
+    if zipf:
+        # Zipfian key column (rank-preserving, s=1.5): the sampled
+        # range partition has to cope with a head that dwarfs the
+        # median — the device-plane face of the skew/ subsystem's
+        # workload
+        host_keys = zipf_keys(rng, 1.5, n, 1 << 20, dtype=np.int32)
+    else:
+        host_keys = rng.integers(0, 1 << 31, n, dtype=np.int32)
+    keys = jax.device_put(host_keys, sorter.sharding)
     vals = jax.device_put(
         rng.integers(0, 1 << 31, n, dtype=np.int32), sorter.sharding
     )
@@ -283,9 +292,10 @@ def main():
     dt = time_iters(run, iters=20)
     n_chips = len(list(mesh.devices.flat))
     gbps_chip = n * 8 / dt / 1e9 / n_chips
+    label = "zipf s=1.5 keys" if zipf else "uniform keys"
     emit(
         f"terasort shuffle+sort throughput per chip ({n} records, "
-        f"{n_chips} chip(s))",
+        f"{label}, {n_chips} chip(s))",
         gbps_chip, "GB/s/chip", gbps_chip / ROCE_LINE_RATE_GBPS,
     )
 

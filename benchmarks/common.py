@@ -158,6 +158,23 @@ def ensure_multidevice(script_path: str, min_devices: int = 4) -> None:
     ))
 
 
+def zipf_keys(rng, s: float, n: int, n_keys: int = 100_000,
+              dtype=np.int64) -> np.ndarray:
+    """Rank-preserving bounded Zipf sample: key id == frequency rank
+    (key 0 is the hottest).  Draws via inverse CDF over ranks
+    1..n_keys, so P(key=r) ∝ 1/(r+1)^s exactly.
+
+    This replaces the old ``rng.zipf(s, n) % n_keys`` idiom, which
+    folds the unbounded tail onto arbitrary residues: the fold lands
+    huge rank samples on top of small key ids at random, flattening
+    the head and breaking the rank-frequency law the benchmark means
+    to model."""
+    ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+    cdf = np.cumsum(ranks ** -s)
+    cdf /= cdf[-1]
+    return np.searchsorted(cdf, rng.random(n)).astype(dtype)
+
+
 def canonical_record_workload(n_records: int = 1_000_000, payload: int = 64,
                               n_keys: int = 512, seed: int = 0):
     """The shared record-plane workload (keys, S-payload vals) so the

@@ -679,6 +679,58 @@ class TpuShuffleConf:
         to release registered bytes before proceeding degraded."""
         return self._time_ms("qosAdmissionWait", 100)
 
+    # -- skew-adaptive partitioning (sparkrdma_tpu/skew/) -------------------
+    @property
+    def skew_enabled(self) -> bool:
+        """Skew-adaptive partitioning (skew/): writers classify
+        partitions at commit from the streaming size/record sketch, and
+        a partition over ``skewSplitThreshold`` (or ``skewSplitFactor``
+        x the map output's median partition) commits as independently
+        sorted SUB-BLOCKS at serializer frame boundaries — distinct
+        map-output entries the reader fetches interleaved across the
+        stripe/lane plan and k-way-merges as extra sorted runs.  Off by
+        default: the writer commits one block per partition and the
+        reader's plan is byte-identical to the pre-skew tree.  Only the
+        pull read plane (``readPlane=host``) splits — the collective
+        planes move whole partition blocks by construction."""
+        return self._bool("skewEnabled", False)
+
+    @property
+    def skew_split_threshold(self) -> int:
+        """Absolute hot-partition cutoff AND the sub-block target size:
+        a partition at least this large always splits, into sub-blocks
+        of roughly this many bytes each (whole serializer frames — a
+        single frame larger than the target cannot split further)."""
+        return self._bytes_in_range("skewSplitThreshold", 8 << 20,
+                                    4 << 10, 1 << 40)
+
+    @property
+    def skew_split_factor(self) -> float:
+        """Relative cutoff: a partition over this multiple of the map
+        output's median non-empty partition size also splits (Zipfian
+        heads dwarf the median long before any absolute threshold
+        trips).  0 disables the relative test."""
+        raw = self.get("skewSplitFactor", 4.0)
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            return 4.0
+        return max(0.0, min(1e6, v))
+
+    @property
+    def skew_max_sub_blocks(self) -> int:
+        """Cap on sub-blocks per split partition (each costs one
+        16-byte location entry and one fetch-plan slot)."""
+        return self._int_in_range("skewMaxSubBlocks", 16, 2, 1024)
+
+    @property
+    def skew_sample_stride(self) -> int:
+        """Heavy-hitter sketch sampling stride on aggregating writers:
+        every Nth record's key feeds the Misra-Gries sketch whose top
+        share is published in the shuffle's skew telemetry (hot-KEY
+        attribution — splitting itself keys off partition bytes)."""
+        return self._int_in_range("skewSampleStride", 64, 1, 1 << 20)
+
     # -- observability ------------------------------------------------------
     @property
     def metrics_http_port(self) -> int:

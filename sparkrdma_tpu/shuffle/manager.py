@@ -37,6 +37,7 @@ from sparkrdma_tpu.metrics import (
     write_prometheus,
 )
 from sparkrdma_tpu.qos import WeightedCreditBroker, get_qos
+from sparkrdma_tpu.skew import get_skew
 from sparkrdma_tpu.utils.dbglock import dbg_lock, dbg_rlock
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
@@ -89,6 +90,15 @@ _PLAN_WAIT = object()
 
 # driver keeps per-shuffle telemetry for this many recent shuffles
 _TELEMETRY_KEEP = 64
+
+
+def _fold_telemetry(acc, key: str, v):
+    """Telemetry merge rule, applied identically at every aggregation
+    layer (task→executor, executor→driver per-host, per-host→total):
+    ``max_``-prefixed keys are maxima (summing a max across tasks or
+    hosts corrupts it — the skew partition-balance stats ride this),
+    everything else sums."""
+    return max(acc, v) if key.startswith("max_") else acc + v
 
 
 @dataclass
@@ -277,6 +287,15 @@ class TpuShuffleManager:
         if conf.qos_enabled:
             self.qos = get_qos()
             self.qos.enabled = True
+        # skew-adaptive partitioning (skew/): same process-global
+        # registry flip — writers consult it at commit to split hot
+        # partitions into sub-blocks, readers to resolve the markers.
+        # None (the default) keeps every commit and fetch bit-identical
+        # to the pre-skew path.
+        self.skew = None
+        if conf.skew_enabled:
+            self.skew = get_skew()
+            self.skew.enabled = True
         # live scrape endpoint (qos/http.py): serves /metrics,
         # /metrics.json and /tenants for the manager's lifetime
         self.metrics_http = None
@@ -821,6 +840,12 @@ class TpuShuffleManager:
             msg.shuffle_id, msg.shuffle_manager_id, msg.map_id,
             msg.total_num_partitions,
         )
+        # skew-split outputs publish EXTRA sub-block rows past the
+        # logical partition count, but an early fetch-status query may
+        # have pre-created this table at the logical size — widen to
+        # the sender's row count BEFORE any segment lands, so the fill
+        # future can only complete at the extended threshold
+        mto.ensure_capacity(msg.total_num_partitions)
         mto.put_range(
             msg.first_reduce_id, msg.last_reduce_id, msg.entries,
             epoch=msg.epoch,
@@ -1673,6 +1698,23 @@ class TpuShuffleManager:
             write_time_ms=wm.write_time_ms,
         )
 
+    def record_shuffle_skew(self, shuffle_id: int, snap: Dict) -> None:
+        """Writer commit hook: fold one map task's partition-balance /
+        split snapshot (skew/registry.py's ``record_commit`` return)
+        into the shuffle's telemetry, ``skew_``-prefixed so the report
+        can find them.  Rides the PR 1 telemetry plane — published even
+        when splitting is off, so ``metrics_report.py`` shows a
+        partition-balance view either way."""
+        if not self.conf.metrics_enabled or not snap:
+            return
+        self._telemetry_add(
+            shuffle_id,
+            **{
+                (k if k.startswith("max_") else f"skew_{k}"): v
+                for k, v in snap.items()
+            },
+        )
+
     def record_shuffle_read(self, shuffle_id: int, rm) -> None:
         """Reader completion hook: fold one reduce task's ReadMetrics
         into the shuffle's telemetry accumulator."""
@@ -1694,7 +1736,7 @@ class TpuShuffleManager:
         with self._telemetry_lock:
             d = self._telemetry.setdefault(shuffle_id, {})
             for k, v in kv.items():
-                d[k] = d.get(k, 0) + v
+                d[k] = _fold_telemetry(d.get(k, 0), k, v)
 
     def _publish_shuffle_telemetry(self, shuffle_id: int) -> None:
         """Ship this manager's accumulated per-shuffle telemetry to the
@@ -1736,7 +1778,7 @@ class TpuShuffleManager:
             )
             mine = per_host.setdefault(exec_id, {})
             for k, v in snap.items():
-                mine[k] = mine.get(k, 0) + v
+                mine[k] = _fold_telemetry(mine.get(k, 0), k, v)
             while len(self._shuffle_telemetry) > _TELEMETRY_KEEP:
                 oldest = min(self._shuffle_telemetry)
                 del self._shuffle_telemetry[oldest]
@@ -1756,7 +1798,7 @@ class TpuShuffleManager:
         total: Dict[str, float] = {}
         for m in per_host.values():
             for k, v in m.items():
-                total[k] = total.get(k, 0) + v
+                total[k] = _fold_telemetry(total.get(k, 0), k, v)
         return {"per_host": per_host, "total": total}
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
@@ -1797,6 +1839,9 @@ class TpuShuffleManager:
             # back under quota leaves degraded mode, queued admissions
             # re-check
             self.qos.release_shuffle(shuffle_id)
+        # drop the shuffle's skew accounting (written even with
+        # splitting off when telemetry is on)
+        get_skew().release_shuffle(shuffle_id)
         if self.is_driver:
             # broadcast so every executor releases its OWN side of the
             # shuffle (registered segments, block-store mkeys, QoS

@@ -151,6 +151,34 @@ class MapTaskOutput:
             ]
         return epoch, out
 
+    def ensure_capacity(self, num_partitions: int) -> None:
+        """Grow the table to at least ``num_partitions`` rows (never
+        shrinks).  Skew-split map outputs publish EXTRA sub-block rows
+        past the logical partition count, but the driver may have
+        pre-created this table at the logical size from an early
+        fetch-status query — the publish handler calls this with the
+        sender's row count before installing segments, so the fill
+        threshold is raised to the extended count BEFORE any row can
+        land (a table must never complete at the narrow size and then
+        widen)."""
+        with self._lock:
+            extra = num_partitions - self.num_partitions
+            if extra <= 0:
+                return
+            # replace rather than resize in place: readers snapshot
+            # memoryview(self._buf) outside the lock, and resizing a
+            # bytearray with a live export raises BufferError
+            buf = bytearray(num_partitions * LOCATION_ENTRY_SIZE)
+            buf[: len(self._buf)] = self._buf
+            self._buf = buf
+            self._filled_flags = self._filled_flags + bytes(extra)
+            self._dirty = self._dirty + bytes(extra)
+            if self._entry_epochs is not None:
+                self._entry_epochs = self._entry_epochs + array(
+                    "i", bytes(4 * extra)
+                )
+            self.num_partitions = num_partitions
+
     def mark_dirty(self, first: int, last: int) -> None:
         """Re-flag [first, last] for the next ``take_delta`` — the
         publish path calls this from a send-failure callback so a

@@ -29,6 +29,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
+from sparkrdma_tpu.skew import is_split_marker
 from sparkrdma_tpu.transport.channel import FnCompletionListener
 from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
@@ -105,6 +106,11 @@ class _PendingFetch:
     host: ShuffleManagerId
     locations: List[BlockLocation]
     total_bytes: int
+    # aligned with ``locations`` when the group carries skew sub-blocks:
+    # ``(map_id, reduce_id, sub_idx, num_subs)`` per split entry, None
+    # per ordinary block; None for an all-ordinary group (the default
+    # path allocates nothing)
+    tags: Optional[List[Any]] = None
     qos_granted: int = 0
     # resource-ledger tickets (utils/ledger.py) for the window bytes /
     # brokered credits this fetch holds while on the wire
@@ -113,13 +119,15 @@ class _PendingFetch:
 
 
 class _Result:
-    __slots__ = ("blocks", "host", "error", "latency_ms")
+    __slots__ = ("blocks", "host", "error", "latency_ms", "tags")
 
-    def __init__(self, blocks=None, host=None, error=None, latency_ms=0.0):
+    def __init__(self, blocks=None, host=None, error=None, latency_ms=0.0,
+                 tags=None):
         self.blocks = blocks
         self.host = host
         self.error = error
         self.latency_ms = latency_ms
+        self.tags = tags  # sub-block tags aligned with blocks (or None)
 
 
 class ShuffleReader:
@@ -174,9 +182,20 @@ class ShuffleReader:
         # resource: reader.qos_inflight_bytes (brokered fetch credits)
         self._inflight = manager.qos_inflight_broker()
         self._pump_registered = False
+        # skew sub-block sequencing (skew/): a split partition's
+        # sub-blocks are interleaved across the fetch plan on purpose,
+        # but the merge must see the partition as one contiguous
+        # in-sub-order stream for the bit-exactness argument to hold —
+        # landed sub-blocks park here until the set completes.  Peak
+        # residency is bounded by what the unsplit path holds as ONE
+        # block payload anyway.
+        # resource: reader.skew_reorder_bytes (parked sub-block payloads)
+        # (mid, rid) -> {sub index: (payload, ledger ticket)}
+        self._sub_buf: Dict[Any, Dict[int, Any]] = {}
         self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
         self._m_local_read = histogram("shuffle_local_read_ms")
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
+        self._m_merge_fanin = histogram("skew_merge_fanin")
 
     # -- fetch machinery ----------------------------------------------------
     def _start_remote_fetches(self) -> Iterator:
@@ -189,7 +208,6 @@ class ShuffleReader:
         RSS on the 50 GB assembled run), while remote fetches overlap
         the local consumption either way."""
         local_map_ids: List[int] = []
-        conf = self.manager.conf
         if self._inflight is not None and not self._pump_registered:
             # brokered window: a credit release anywhere re-pumps this
             # reader's pending queue (unregistered at cleanup)
@@ -206,58 +224,11 @@ class ShuffleReader:
                 continue
             with self._pending_lock:
                 self._awaiting_hosts += 1
-            t0 = time.monotonic()
-            timer = threading.Timer(
-                conf.partition_location_fetch_timeout_ms / 1000.0,
-                self._on_metadata_timeout,
-                args=(host,),
+            self._query_locations(
+                host, pairs,
+                lambda locs, host=host, pairs=pairs:
+                    self._on_primary_locations(host, pairs, locs),
             )
-            timer.daemon = True
-            self._timers.append(timer)
-
-            def on_locations(locs, host=host, timer=timer, t0=t0):
-                timer.cancel()
-                rtt_ms = (time.monotonic() - t0) * 1000
-                self._m_rpc_rtt.observe(rtt_ms)
-                logger.debug(
-                    "locations for %s resolved in %.1fms",
-                    host.host, rtt_ms,
-                )
-                self._enqueue_fetches(host, locs)
-
-            def on_status_failed(reason, host=host, timer=timer):
-                # driver answered negatively (executor lost / shuffle
-                # unregistered): fail NOW, not at the timeout
-                timer.cancel()
-                self._fail(MetadataFetchFailedError(
-                    host.host, self.handle.shuffle_id, reason
-                ))
-
-            cb_id = self.manager.register_fetch_callback(
-                on_locations, on_status_failed
-            )
-            self._callback_ids.append(cb_id)
-            msg = FetchMapStatusMsg(
-                self.manager.local_smid, host, self.handle.shuffle_id,
-                cb_id, pairs,
-            )
-            timer.start()
-            try:
-                # _send_driver_msg retries once if the cached driver
-                # channel was evicted from the bounded cache between
-                # lookup and post (reconnects transparently)
-                self.manager._send_driver_msg(
-                    msg,
-                    on_failure=lambda e, host=host: self._fail(
-                        MetadataFetchFailedError(
-                            host.host, self.handle.shuffle_id,
-                            f"status rpc failed: {e}",
-                        )
-                    ),
-                )
-            except Exception as e:
-                self._fail(MetadataFetchFailedError(
-                    host.host, self.handle.shuffle_id, str(e)))
 
         def _iter_local() -> Iterator:
             # local_blocks/local_bytes count in _iter_block_bytes at
@@ -296,16 +267,168 @@ class ShuffleReader:
             )
         )
 
+    def _query_locations(self, host: ShuffleManagerId, pairs, on_ok) -> None:
+        """One fetch-status round against the driver for ``pairs`` =
+        (map_id, table row) index pairs; ``on_ok`` receives the resolved
+        locations.  Shared by the primary round (map × reduce pairs) and
+        the skew follow-up round, whose rows are sub-block entries in
+        the extended table (skew/) — the driver plane serves both
+        identically, which is why splitting needs zero wire change."""
+        conf = self.manager.conf
+        t0 = time.monotonic()
+        timer = threading.Timer(
+            conf.partition_location_fetch_timeout_ms / 1000.0,
+            self._on_metadata_timeout,
+            args=(host,),
+        )
+        timer.daemon = True
+        self._timers.append(timer)
+
+        def on_locations(locs, timer=timer, t0=t0):
+            timer.cancel()
+            rtt_ms = (time.monotonic() - t0) * 1000
+            self._m_rpc_rtt.observe(rtt_ms)
+            logger.debug(
+                "locations for %s resolved in %.1fms",
+                host.host, rtt_ms,
+            )
+            on_ok(locs)
+
+        def on_status_failed(reason, timer=timer):
+            # driver answered negatively (executor lost / shuffle
+            # unregistered): fail NOW, not at the timeout
+            timer.cancel()
+            self._fail(MetadataFetchFailedError(
+                host.host, self.handle.shuffle_id, reason
+            ))
+
+        cb_id = self.manager.register_fetch_callback(
+            on_locations, on_status_failed
+        )
+        self._callback_ids.append(cb_id)
+        msg = FetchMapStatusMsg(
+            self.manager.local_smid, host, self.handle.shuffle_id,
+            cb_id, pairs,
+        )
+        timer.start()
+        try:
+            # _send_driver_msg retries once if the cached driver
+            # channel was evicted from the bounded cache between
+            # lookup and post (reconnects transparently)
+            self.manager._send_driver_msg(
+                msg,
+                on_failure=lambda e: self._fail(
+                    MetadataFetchFailedError(
+                        host.host, self.handle.shuffle_id,
+                        f"status rpc failed: {e}",
+                    )
+                ),
+            )
+        except Exception as e:
+            self._fail(MetadataFetchFailedError(
+                host.host, self.handle.shuffle_id, str(e)))
+
+    def _on_primary_locations(self, host: ShuffleManagerId, pairs,
+                              locs) -> None:
+        """Primary fetch-status response.  A split partition answers
+        with a MARKER entry (skew/) naming its sub-block rows in the
+        extended table; resolving those costs ONE more fetch-status
+        round against the same plane, after which the sub-blocks join
+        this host's fetch plan as ordinary blocks.  ``_awaiting_hosts``
+        stays elevated across the second round — ``_enqueue_fetches``
+        is the sole decrementer and still runs exactly once per host."""
+        markers = [
+            (i, loc) for i, loc in enumerate(locs) if is_split_marker(loc)
+        ]
+        if not markers:
+            self._enqueue_fetches(host, locs)
+            return
+        # aux rows in enumeration order, matching the writer's
+        # ascending-pid aux allocation (resolver._put_partition_entry)
+        aux_pairs = [
+            (pairs[i][0], loc.address + j)
+            for i, loc in markers
+            for j in range(loc.length)
+        ]
+        self._query_locations(
+            host, aux_pairs,
+            lambda aux_locs: self._on_aux_locations(
+                host, pairs, locs, markers, aux_locs
+            ),
+        )
+
+    def _on_aux_locations(self, host: ShuffleManagerId, pairs, locs,
+                          markers, aux_locs) -> None:
+        """Second-round response: substitute each marker's sub-blocks
+        and interleave.  Sub-blocks are dealt depth-wise round-robin
+        across per-origin queues so one hot partition's bytes spread
+        over fetch groups instead of arriving as one serial lump — the
+        balanced-fetch half of the skew story — while the
+        ``(map, reduce, sub, of)`` tags let the consumer re-sequence
+        them for the bit-exact merge."""
+        marker_at = dict(markers)
+        cursor = 0
+        # one queue per origin block: ordinary entries (empties
+        # included — _enqueue_fetches skips them but the wake-up /
+        # termination accounting wants the full list) are singletons,
+        # split partitions contribute their sub-blocks in sub order
+        origins: List[List] = []
+        for i, loc in enumerate(locs):
+            m = marker_at.get(i)
+            if m is None:
+                origins.append([(loc, None)])
+                continue
+            mid, rid = pairs[i]
+            subs = aux_locs[cursor:cursor + m.length]
+            cursor += m.length
+            if len(subs) != m.length or any(
+                s.is_empty or is_split_marker(s) for s in subs
+            ):
+                # a sub row that is empty, missing, or itself a marker
+                # means the table we resolved against is torn — treat
+                # it as a metadata failure so the stage retries
+                self._fail(MetadataFetchFailedError(
+                    host.host, self.handle.shuffle_id,
+                    f"bad sub-block rows for map {mid} partition {rid}",
+                ))
+                return
+            origins.append([
+                (sub, (mid, rid, j, m.length))
+                for j, sub in enumerate(subs)
+            ])
+        out_locs: List[BlockLocation] = []
+        out_tags: List[Any] = []
+        depth = 0
+        while True:
+            row = [org[depth] for org in origins if depth < len(org)]
+            if not row:
+                break
+            for loc, tag in row:
+                out_locs.append(loc)
+                out_tags.append(tag)
+            depth += 1
+        self._enqueue_fetches(host, out_locs, out_tags)
+
     def _enqueue_fetches(self, host: ShuffleManagerId,
-                         locations: Sequence[BlockLocation]) -> None:
+                         locations: Sequence[BlockLocation],
+                         tags: Optional[Sequence[Any]] = None) -> None:
         """Group locations into bounded fetches
-        (RdmaShuffleFetcherIterator.scala:214-240)."""
+        (RdmaShuffleFetcherIterator.scala:214-240).  ``tags`` rides
+        along per location (skew sub-block identity or None)."""
         conf = self.manager.conf
         group: List[BlockLocation] = []
+        gtags: List[Any] = []
         group_bytes = 0
         new_fetches: List[_PendingFetch] = []
         nonempty = 0
-        for loc in locations:
+
+        def close_group():
+            new_fetches.append(_PendingFetch(
+                host, group, group_bytes,
+                tags=gtags if any(t is not None for t in gtags) else None,
+            ))
+
+        for idx, loc in enumerate(locations):
             if loc.is_empty:
                 continue
             nonempty += 1
@@ -313,12 +436,13 @@ class ShuffleReader:
                 group_bytes + loc.length > conf.shuffle_read_block_size
                 or group_bytes + loc.length > conf.max_agg_block
             ):
-                new_fetches.append(_PendingFetch(host, group, group_bytes))
-                group, group_bytes = [], 0
+                close_group()
+                group, gtags, group_bytes = [], [], 0
             group.append(loc)
+            gtags.append(tags[idx] if tags is not None else None)
             group_bytes += loc.length
         if group:
-            new_fetches.append(_PendingFetch(host, group, group_bytes))
+            close_group()
         with self._pending_lock:
             self._outstanding_blocks += nonempty
             self._pending.extend(new_fetches)
@@ -498,7 +622,8 @@ class ShuffleReader:
                 # the byte accounting identical) in the same order
                 blocks = [stream.submit_block(b) for b in blocks]
             self._results.put(
-                _Result(blocks=blocks, host=fetch.host, latency_ms=latency)
+                _Result(blocks=blocks, host=fetch.host, latency_ms=latency,
+                        tags=fetch.tags)
             )
             self._pump()
 
@@ -581,14 +706,61 @@ class ShuffleReader:
                     continue  # wake-up marker
                 with self._pending_lock:
                     self._outstanding_blocks -= len(res.blocks)
-                for data in res.blocks:
-                    self.metrics.remote_blocks += 1
-                    self.metrics.remote_bytes += len(data)
-                    yield data
+                for i, data in enumerate(res.blocks):
+                    tag = res.tags[i] if res.tags is not None else None
+                    if tag is None:
+                        self.metrics.remote_blocks += 1
+                        self.metrics.remote_bytes += len(data)
+                        yield data
+                    else:
+                        # skew sub-block: deliver in sub-index order so
+                        # the merge sees each split partition as the
+                        # exact unsplit payload cut at frame boundaries
+                        yield from self._sequence_sub_block(tag, data)
         finally:
             # runs on normal exhaustion, fetch failure, AND abandoned
             # iteration (GeneratorExit) — timers and callbacks never leak
             self._cleanup()
+
+    def _sequence_sub_block(self, tag, item) -> Iterator:
+        """Park one landed sub-block of a split partition (skew/) and,
+        once ALL its siblings have landed, emit the whole partition
+        contiguously in sub-index order.  Contiguity — not just sub
+        order — is what keeps the merge bit-exact with the unsplit
+        path: each sub-run is a stable slice of the map task's sorted
+        partition payload, so emitting them back-to-back reconstructs
+        the exact record stream of the original block at ONE stream
+        position, just as an unsplit fetch would have delivered it;
+        draining subs early would interleave the partition's records
+        with other blocks and flip equal-key order under the stable
+        merge.  Items are raw payloads or decode tickets; both report
+        their payload size via ``len()``, and peak parked residency is
+        bounded by what the unsplit path holds as one block payload."""
+        mid, rid, sub_idx, num_subs = tag
+        key = (mid, rid)
+        # owns: reader.skew_reorder_bytes -> _sequence_sub_block
+        # owns: reader.skew_reorder_bytes -> _cleanup
+        tkt = ledger_acquire(
+            "reader.skew_reorder_bytes", len(item)
+        )  # acquires: reader.skew_reorder_bytes
+        buf = self._sub_buf.setdefault(key, {})
+        buf[sub_idx] = (item, tkt)
+        if len(buf) < num_subs:
+            return
+        # complete: release every ticket and clear state BEFORE the
+        # first yield, so an abandoned iteration (GeneratorExit
+        # mid-yield) can't double-release a parked ticket
+        del self._sub_buf[key]
+        self._m_merge_fanin.observe(num_subs)
+        ready = []
+        for j in range(num_subs):
+            parked, t = buf.pop(j)
+            t.release()  # releases: reader.skew_reorder_bytes  # one-shot
+            ready.append(parked)
+        for it in ready:
+            self.metrics.remote_blocks += 1
+            self.metrics.remote_bytes += len(it)
+            yield it
 
     def _iter_raw(self) -> Iterator[Record]:
         """Serial decode on the task thread (decodeThreads=0): blocks
@@ -622,6 +794,12 @@ class ShuffleReader:
     def _cleanup(self) -> None:
         for t in self._timers:
             t.cancel()
+        # parked sub-blocks an abandoned or failed iteration never
+        # drained still hold reorder-buffer tickets
+        for buf in self._sub_buf.values():
+            for _item, tkt in buf.values():
+                tkt.release()  # releases: reader.skew_reorder_bytes  # one-shot
+        self._sub_buf.clear()
         for cb_id in self._callback_ids:
             self.manager.unregister_fetch_callback(cb_id)
         if self._pump_registered:

@@ -24,11 +24,57 @@ import numpy as np
 from sparkrdma_tpu.memory.arena import ArenaManager, DeviceSegment
 from sparkrdma_tpu.memory.device_arena import ROW_BYTES as _ROW_BYTES
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.skew.splitter import (
+    collapse_sub_locations,
+    is_split_marker,
+    make_marker,
+)
 from sparkrdma_tpu.transport.node import Node
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.types import BlockLocation
 
 logger = logging.getLogger(__name__)
+
+# skew sub-block table layout (skew/splitter.py): a split partition's
+# primary row is a marker naming aux rows past the logical partition
+# count; these helpers keep every commit path emitting that shape
+# identically.
+
+
+def _split_extra(split_spans) -> int:
+    """How many aux table rows a commit's split plan needs."""
+    return sum(len(v) for v in split_spans.values()) if split_spans else 0
+
+
+def _put_partition_entry(
+    mto: MapTaskOutput, pid: int, off: int, n: int, mkey: int,
+    spans, aux: int,
+) -> int:
+    """Install partition ``pid``'s table entry at payload (off, n) in
+    segment ``mkey``: an ordinary location, or — when ``spans`` carries
+    the partition's sub-block plan — a marker plus one aux row per
+    sub-span.  Returns the advanced aux cursor."""
+    if n == 0:
+        mto.put(pid, BlockLocation.EMPTY)
+        return aux
+    if spans:
+        mto.put(pid, make_marker(aux, len(spans)))
+        for rel, ln in spans:
+            mto.put(aux, BlockLocation(off + rel, ln, mkey))
+            aux += 1
+        return aux
+    mto.put(pid, BlockLocation(off, n, mkey))
+    return aux
+
+
+def _resolve_marker(mto: MapTaskOutput, loc: BlockLocation) -> BlockLocation:
+    """Collapse a sub-block marker for LOCAL serving: the sub-spans
+    tile the partition payload contiguously in one segment, so the
+    local read is exactly the unsplit block."""
+    if not is_split_marker(loc):
+        return loc
+    subs = mto.get_locations(loc.address, loc.address + loc.length - 1)
+    return collapse_sub_locations(subs)
 
 
 class ChunkedPayload:
@@ -229,6 +275,7 @@ class ShuffleBlockResolver:
         map_id: int,
         partition_bytes: Sequence,
         prefer_file_backed: bool = False,
+        split_spans: Optional[Dict[int, List[Tuple[int, int]]]] = None,
     ) -> MapTaskOutput:
         """Stage one map task's serialized partitions into a registered
         segment and build its location table.  Each partition payload is
@@ -238,7 +285,14 @@ class ShuffleBlockResolver:
         ``prefer_file_backed`` routes the commit to the mmap path even
         below ``file_backed_threshold`` — set by writers whose output
         already spilled to disk, so the commit never re-materializes in
-        one in-memory buffer what spilling was bounding."""
+        one in-memory buffer what spilling was bounding.
+
+        ``split_spans`` is the writer's skew split plan
+        (:func:`sparkrdma_tpu.skew.splitter.plan_commit_splits`):
+        ``{pid: [(rel_off, rel_len), ...]}`` sub-block spans within the
+        partition payload.  Those partitions register a marker entry
+        plus one aux table row per sub-block; the payload bytes land
+        exactly where they would have anyway."""
         num_partitions = len(partition_bytes)
         sd = self._get_or_create(shuffle_id, num_partitions)
         use_arena = self.stage_to_device and self.device_arena is not None
@@ -252,7 +306,8 @@ class ShuffleBlockResolver:
             self.file_backed_threshold and total >= self.file_backed_threshold
         ):
             return self._commit_file_backed(
-                sd, shuffle_id, map_id, partition_bytes, total
+                sd, shuffle_id, map_id, partition_bytes, total,
+                split_spans=split_spans,
             )
         # arena commits split into write-block-sized segments (chunked
         # registration, RdmaMappedFile.java:95-171): greedy groups of
@@ -271,7 +326,8 @@ class ShuffleBlockResolver:
                     gsize += an
         else:
             groups = [list(range(num_partitions))]
-        mto = MapTaskOutput(num_partitions)
+        mto = MapTaskOutput(num_partitions + _split_extra(split_spans))
+        aux = num_partitions  # sub-block rows allocated in pid order
         segs: Dict[int, DeviceSegment] = {}
         try:
             for pids in groups:
@@ -288,10 +344,9 @@ class ShuffleBlockResolver:
                 )
                 segs[seg.mkey] = seg
                 for p, (o, n) in zip(pids, g_offsets):
-                    mto.put(
-                        p,
-                        BlockLocation.EMPTY if n == 0
-                        else BlockLocation(o, n, seg.mkey),
+                    aux = _put_partition_entry(
+                        mto, p, o, n, seg.mkey,
+                        split_spans.get(p) if split_spans else None, aux,
                     )
         except BaseException:
             for seg in segs.values():
@@ -392,18 +447,21 @@ class ShuffleBlockResolver:
     def commit_assembled(
         self, shuffle_id: int, map_id: int, buf: np.ndarray,
         ranges: Sequence[Tuple[int, int]],
+        split_spans: Optional[Dict[int, List[Tuple[int, int]]]] = None,
     ) -> MapTaskOutput:
         """Commit a writer-assembled contiguous buffer: ``ranges[pid] =
         (offset, length)`` within ``buf``.  The writer gathered records
         straight into ``buf``, so this path adds NO further copy on the
         host plane (the buffer itself becomes the registered segment);
-        device staging is the one ``jnp.asarray`` transfer."""
+        device staging is the one ``jnp.asarray`` transfer.
+        ``split_spans`` as in :meth:`commit_map_output`."""
         sd = self._get_or_create(shuffle_id, len(ranges))
         total = int(buf.shape[0])
         if self.file_backed_threshold and total >= self.file_backed_threshold:
             return self._commit_file_backed(
                 sd, shuffle_id, map_id,
                 [buf[off : off + n] for off, n in ranges], total,
+                split_spans=split_spans,
             )
         span = (
             self._alloc_span_or_none(total, shuffle_id, map_id)
@@ -436,11 +494,12 @@ class ShuffleBlockResolver:
             )
         if self.node is not None:
             self.node.register_block_store(seg.mkey, self.arena)
-        mto = MapTaskOutput(len(ranges))
+        mto = MapTaskOutput(len(ranges) + _split_extra(split_spans))
+        aux = len(ranges)
         for pid, (off, n) in enumerate(ranges):
-            mto.put(
-                pid,
-                BlockLocation.EMPTY if n == 0 else BlockLocation(off, n, seg.mkey),
+            aux = _put_partition_entry(
+                mto, pid, off, n, seg.mkey,
+                split_spans.get(pid) if split_spans else None, aux,
             )
         self._install(sd, map_id, mto, seg)
         return mto
@@ -448,6 +507,7 @@ class ShuffleBlockResolver:
     def _commit_file_backed(
         self, sd: "_ShuffleData", shuffle_id: int, map_id: int,
         partition_bytes: Sequence, total: int,
+        split_spans: Optional[Dict[int, List[Tuple[int, int]]]] = None,
     ) -> MapTaskOutput:
         """Large-output commit: stream the map task's partitions into
         one data file and serve it through the tiered block store
@@ -493,12 +553,16 @@ class ShuffleBlockResolver:
             raise
         if self.node is not None:
             self.node.register_block_store(seg.mkey, self.arena)
-        mto = MapTaskOutput(len(partition_bytes))
+        # the tier store keeps whole partitions as its residency blocks
+        # (sub-block reads are in-block sub-ranges, which it already
+        # serves with promotion), so split plans change only the table
+        mto = MapTaskOutput(len(partition_bytes) + _split_extra(split_spans))
+        aux = len(partition_bytes)
         for pid, (off, n) in enumerate(spans):
-            if n == 0:
-                mto.put(pid, BlockLocation.EMPTY)
-            else:
-                mto.put(pid, BlockLocation(off, n, seg.mkey))
+            aux = _put_partition_entry(
+                mto, pid, off, n, seg.mkey,
+                split_spans.get(pid) if split_spans else None, aux,
+            )
         self._install(sd, map_id, mto, seg)
         return mto
 
@@ -605,7 +669,7 @@ class ShuffleBlockResolver:
                 f"no committed output for shuffle={shuffle_id} map={map_id}"
             )
         mto, segs = entry
-        loc = mto.get_location(reduce_id)
+        loc = _resolve_marker(mto, mto.get_location(reduce_id))
         if loc.is_empty:
             return b""
         return segs[loc.mkey].read(loc.address, loc.length)
@@ -629,7 +693,9 @@ class ShuffleBlockResolver:
                 f"no committed output for shuffle={shuffle_id} map={map_id}"
             )
         mto, segs = entry
-        locs = [mto.get_location(r) for r in reduce_ids]
+        locs = [
+            _resolve_marker(mto, mto.get_location(r)) for r in reduce_ids
+        ]
         # one batched read per backing segment (multi-segment map
         # outputs exist under write_block_size splitting)
         by_seg: Dict[int, List[Tuple[int, int]]] = {}

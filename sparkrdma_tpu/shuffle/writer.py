@@ -22,6 +22,12 @@ import numpy as np
 from sparkrdma_tpu.memory.staging import native_hash_partition_order
 from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.skew import (
+    HeavyHitterSketch,
+    PartitionSketch,
+    get_skew,
+    plan_commit_splits,
+)
 from sparkrdma_tpu.shuffle.partitioner import (
     HashPartitioner,
     RangePartitioner,
@@ -91,6 +97,21 @@ class ShuffleWriter:
         self._spill_appenders = None
         self._spill_io = None  # shared 1-thread flush executor
         self._spill_direct = False
+        # skew detection (skew/): streaming per-partition record
+        # sketch, plus a Misra-Gries hot-key sketch on aggregating
+        # shuffles (hot-KEY attribution in telemetry).  Both None
+        # unless skewEnabled, so the default record path pays one
+        # predictable None check per record and nothing else
+        self._psketch = self._hot_keys = None
+        self._skew_stride = 1
+        self._skew_seen = 0
+        if manager.skew is not None and manager.skew.enabled:
+            self._psketch = PartitionSketch(
+                handle.partitioner.num_partitions
+            )
+            self._skew_stride = manager.conf.skew_sample_stride
+            if handle.aggregator is not None:
+                self._hot_keys = HeavyHitterSketch()
 
     # -- write --------------------------------------------------------------
     def write(self, records) -> None:
@@ -244,6 +265,15 @@ class ShuffleWriter:
                 order = korder[porder]  # pid-major, key-sorted within
                 counts = np.bincount(pids, minlength=P).astype(np.int64)
             self._col_pending.append((batch, order, counts))
+        if self._psketch is not None:
+            counts = self._col_pending[-1][2]
+            for pid, cnt in enumerate(counts):
+                if cnt:
+                    self._psketch.add(pid, int(cnt))
+            if self._hot_keys is not None:
+                # strided key sample (vectorized slice, scalar adds)
+                for k in batch.keys[:: self._skew_stride]:
+                    self._hot_keys.add(k.item() if hasattr(k, "item") else k)
         self.metrics.records_written += n
         self._records_in_memory += n
         if (self._spill_threshold
@@ -284,24 +314,34 @@ class ShuffleWriter:
                 "must stay on a single record plane"
             )
         part = self.handle.partitioner.partition
+        psk = self._psketch
         if self._combined is not None:
             agg = self.handle.aggregator
             for k, v in records:
-                d = self._combined[part(k)]
+                pid = part(k)
+                d = self._combined[pid]
                 if k in d:
                     d[k] = agg.merge_value(d[k], v)
                 else:
                     d[k] = agg.create_combiner(v)
                     self._records_in_memory += 1
                 self.metrics.records_written += 1
+                if psk is not None:
+                    psk.add(pid)
+                    self._skew_seen += 1
+                    if self._skew_seen % self._skew_stride == 0:
+                        self._hot_keys.add(k)
                 if (self._spill_threshold
                         and self._records_in_memory >= self._spill_threshold):
                     self.spill()
         else:
             for rec in records:
-                self._buckets[part(rec[0])].append(rec)
+                pid = part(rec[0])
+                self._buckets[pid].append(rec)
                 self._records_in_memory += 1
                 self.metrics.records_written += 1
+                if psk is not None:
+                    psk.add(pid)
                 if (self._spill_threshold
                         and self._records_in_memory >= self._spill_threshold):
                     self.spill()
@@ -533,6 +573,40 @@ class ShuffleWriter:
             counter("shuffle_spill_bytes_total").inc(m.bytes_spilled)
         self.manager.record_shuffle_write(self.handle.shuffle_id, m)
 
+    # -- skew detection + split planning (skew/) -----------------------------
+    def _split_plan(self, payloads, sizes):
+        """Commit-hook into the skew subsystem: classify hot partitions
+        from the EXACT committed sizes and frame-walk their contiguous
+        payloads into sub-block spans.  None (no entry changes) unless
+        skewEnabled — and only on the pull read plane: the collective
+        planes iterate primary table rows and move whole partitions by
+        construction, so markers must never reach them."""
+        mgr = self.manager
+        if mgr.skew is None or not mgr.skew.enabled:
+            return None
+        if mgr.conf.read_plane != "host":
+            return None
+        return plan_commit_splits(
+            mgr.serializer, payloads, sizes, mgr.conf
+        ) or None
+
+    def _record_skew_commit(self, sizes, split_plan) -> None:
+        """Fold this task's partition-balance snapshot into the skew
+        registry and the per-shuffle telemetry plane — published even
+        when splitting is off (or found nothing), so the driver's
+        report can show partition balance either way."""
+        mgr = self.manager
+        if mgr.skew is None and not mgr.conf.metrics_enabled:
+            return
+        snap = get_skew().record_commit(
+            self.handle.shuffle_id, sizes, split_plan,
+            hot_key_share=(
+                self._hot_keys.top_share() if self._hot_keys else 0.0
+            ),
+            records=self._psketch.records() if self._psketch else None,
+        )
+        mgr.record_shuffle_skew(self.handle.shuffle_id, snap)
+
     def _commit(self) -> MapTaskOutput:
         t0 = time.monotonic()
         serializer = self.manager.serializer
@@ -664,8 +738,18 @@ class ShuffleWriter:
             cursors[pid] = c
         ranges = [(int(starts[p]), int(sizes[p])) for p in range(P)]
         self.metrics.bytes_written = int(sizes.sum())  # payload, not gaps
+        psizes = [n for _o, n in ranges]
+        split_plan = self._split_plan(
+            {
+                p: buf[o : o + n]
+                for p, (o, n) in enumerate(ranges) if n
+            },
+            psizes,
+        )
+        self._record_skew_commit(psizes, split_plan)
         mto = self.manager.resolver.commit_assembled(
             self.handle.shuffle_id, self.map_id, buf[:total], ranges,
+            split_spans=split_plan,
         )
         self.manager.publish_map_output(
             self.handle.shuffle_id, self.map_id, mto
@@ -692,6 +776,11 @@ class ShuffleWriter:
             entries.append((app.path, n))
             total += n
         appenders, self._spill_appenders = self._spill_appenders, None
+        # spill-file commits never split (their payloads are on disk,
+        # not walkable views) — counted as unsplit in the balance stats
+        self._record_skew_commit(
+            [0 if e is None else e[1] for e in entries], None
+        )
         try:
             mto = self.manager.resolver.commit_spilled_files(
                 self.handle.shuffle_id, self.map_id, entries
@@ -710,16 +799,26 @@ class ShuffleWriter:
         return mto
 
     def _commit_payloads(self, partition_bytes, t0: float) -> MapTaskOutput:
-        from sparkrdma_tpu.shuffle.resolver import _payload_len
+        from sparkrdma_tpu.shuffle.resolver import ChunkedPayload, _payload_len
 
-        self.metrics.bytes_written = sum(
-            _payload_len(b) for b in partition_bytes
+        sizes = [_payload_len(b) for b in partition_bytes]
+        self.metrics.bytes_written = sum(sizes)
+        # only contiguous finals are frame-walkable; chunked payloads
+        # (spill merges, streamed columnar) commit unsplit
+        split_plan = self._split_plan(
+            {
+                pid: b for pid, b in enumerate(partition_bytes)
+                if not isinstance(b, ChunkedPayload) and len(b)
+            },
+            sizes,
         )
+        self._record_skew_commit(sizes, split_plan)
         mto = self.manager.resolver.commit_map_output(
             self.handle.shuffle_id, self.map_id, partition_bytes,
             # spilled output is already on disk: commit via the mmap
             # path so peak memory stays bounded by the spill threshold
             prefer_file_backed=self._spill_file is not None,
+            split_spans=split_plan,
         )
         self.manager.publish_map_output(self.handle.shuffle_id, self.map_id, mto)
         self.metrics.write_time_ms += (time.monotonic() - t0) * 1000

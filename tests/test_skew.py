@@ -1,0 +1,561 @@
+"""Skew-adaptive partitioning (sparkrdma_tpu/skew/): hot-partition
+classification, frame-boundary sub-block planning, the extended-table
+marker encoding, and the reader's interleaved fetch + re-sequenced merge
+— from pure-function units up through split-vs-unsplit bit-exact e2e
+shuffles on every transport engine, with mid-fetch sub-block failure and
+delta-sync republish of split entries."""
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.shuffle.reader import FetchFailedError
+from sparkrdma_tpu.skew import (
+    SPLIT_MKEY,
+    HeavyHitterSketch,
+    PartitionSketch,
+    collapse_sub_locations,
+    get_skew,
+    is_split_marker,
+    plan_commit_splits,
+    split_targets,
+    sub_spans,
+)
+from sparkrdma_tpu.skew.splitter import make_marker
+from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+from sparkrdma_tpu.utils.columns import ColumnBatch
+from sparkrdma_tpu.utils.ledger import NOOP_TICKET
+from sparkrdma_tpu.utils.serde import PickleSerializer
+from sparkrdma_tpu.utils.types import BlockLocation
+
+BASE_PORT = 33500
+
+
+@pytest.fixture(autouse=True)
+def registry_on():
+    prev = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    skew = get_skew()
+    prev_skew = skew.enabled
+    skew.reset()
+    yield GLOBAL_REGISTRY
+    GLOBAL_REGISTRY.enabled = prev
+    skew.enabled = prev_skew
+    skew.reset()
+
+
+# ---------------------------------------------------------------------------
+# classification + span planning units
+# ---------------------------------------------------------------------------
+
+def test_split_targets_absolute_and_relative():
+    # absolute: >= threshold; relative: >= factor * median(nonzero)
+    sizes = [100, 0, 5000, 100, 120]
+    assert split_targets(sizes, 5000, 0.0, 16) == [2]
+    # median of nonzero [100, 100, 120, 5000] (lower middle) = 100;
+    # factor 4 → cutoff 400 catches the 5000 even with a huge threshold
+    assert split_targets(sizes, 1 << 30, 4.0, 16) == [2]
+    # factor <= 0 disables relative detection
+    assert split_targets(sizes, 1 << 30, 0.0, 16) == []
+    # degenerate knobs never classify
+    assert split_targets(sizes, 0, 4.0, 16) == []
+    assert split_targets(sizes, 5000, 4.0, 1) == []
+    assert split_targets([], 100, 4.0, 16) == []
+
+
+def test_sub_spans_packing_and_caps():
+    frames = [(0, 10), (10, 20), (20, 30), (30, 40)]  # four 10B frames
+    # target 20 → pairs
+    assert sub_spans(frames, 20, 16) == [(0, 20), (20, 20)]
+    # an oversized frame keeps a span of its own (frames indivisible)
+    assert sub_spans([(0, 50), (50, 60)], 20, 16) == [(0, 50), (50, 10)]
+    # max_subs cap: the last span absorbs the remainder
+    assert sub_spans(frames, 10, 3) == [(0, 10), (10, 10), (20, 20)]
+    # single frame / everything fits one target → no split
+    assert sub_spans([(0, 40)], 10, 16) is None
+    assert sub_spans(frames, 100, 16) is None
+    assert sub_spans(frames, 0, 16) is None
+
+
+def test_plan_commit_splits_pickle_frames():
+    ser = PickleSerializer(batch_size=100)
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.skewEnabled": "true",
+        "spark.shuffle.tpu.skewSplitThreshold": "4k",
+    })
+    hot = ser.serialize([(i, b"x" * 40) for i in range(500)])  # 5 frames
+    cold = ser.serialize([(i, b"x" * 40) for i in range(50)])
+    sizes = [len(cold), len(hot), 0]
+    plan = plan_commit_splits(ser, {0: cold, 1: hot}, sizes, conf)
+    assert list(plan) == [1]
+    spans = plan[1]
+    assert len(spans) >= 2
+    # spans tile the payload contiguously and each is deserializable
+    off = 0
+    recs = []
+    for rel, ln in spans:
+        assert rel == off
+        recs.extend(ser.deserialize(hot[rel:rel + ln]))
+        off += ln
+    assert off == len(hot)
+    assert recs == list(ser.deserialize(hot))
+    # a payload the serializer cannot frame-walk is skipped, not fatal
+    plan = plan_commit_splits(ser, {1: b"\xff" * len(hot)}, sizes, conf)
+    assert plan == {}
+
+
+def test_marker_encoding_and_collapse():
+    m = make_marker(8, 3)
+    assert is_split_marker(m) and m.mkey == SPLIT_MKEY
+    assert not m.is_empty  # length carries num_subs >= 2
+    assert not is_split_marker(BlockLocation.EMPTY)
+    assert not is_split_marker(BlockLocation(0, 10, 1))
+    # markers survive the 16B wire entry round-trip (signed mkey)
+    rt = BlockLocation.read(memoryview(m.pack()))
+    assert rt == m and is_split_marker(rt)
+    subs = [BlockLocation(128, 100, 7), BlockLocation(228, 50, 7)]
+    assert collapse_sub_locations(subs) == BlockLocation(128, 150, 7)
+
+
+def test_sketches():
+    ps = PartitionSketch(4)
+    for pid, n in [(0, 1), (2, 5), (2, 3)]:
+        ps.add(pid, n)
+    assert ps.records() == [1, 0, 8, 0]
+    assert ps.max_records() == 8
+    hh = HeavyHitterSketch(capacity=2)
+    for ch in "aaaaaabbbc":
+        hh.add(ch)
+    top = dict(hh.top(2))
+    assert max(top, key=top.get) == "a"
+    assert hh.top_share() >= 0.5  # MG undercount: 5/10 for 6 true a's
+
+
+def test_registry_accounting_and_max_fold():
+    skew = get_skew()
+    s1 = skew.record_commit(7, [10, 900, 0], {1: [(0, 450), (450, 450)]},
+                            hot_key_share=0.25)
+    assert s1["partitions_split"] == 1 and s1["sub_blocks"] == 2
+    assert s1["split_bytes"] == 900 and s1["max_partition_bytes"] == 900
+    assert s1["max_hot_key_share_pct"] == 25.0
+    skew.record_commit(7, [700, 20, 0], None, hot_key_share=0.1)
+    acc = skew.shuffle_stats(7)
+    # sums for counts, maxima for max_ keys
+    assert acc["partitions_split"] == 1
+    assert acc["partitions_nonzero"] == 4
+    assert acc["max_partition_bytes"] == 900
+    assert acc["max_hot_key_share_pct"] == 25.0
+    skew.release_shuffle(7)
+    assert skew.shuffle_stats(7) == {}
+
+
+def test_map_output_ensure_capacity():
+    mto = MapTaskOutput(4)
+    # a reader snapshot taken BEFORE the grow must not make the grow
+    # raise (bytearray resize with a live export → BufferError)
+    view = memoryview(mto._buf)
+    mto.ensure_capacity(7)
+    assert mto.num_partitions == 7
+    assert len(view) == 4 * 16  # old snapshot intact
+    mto.ensure_capacity(5)  # shrink is a no-op
+    assert mto.num_partitions == 7
+    for p in range(7):
+        mto.put(p, BlockLocation(p * 100, 10, 1))
+    assert mto.fill_future.done()
+    assert mto.get_location(6) == BlockLocation(600, 10, 1)
+
+
+# ---------------------------------------------------------------------------
+# e2e: split vs unsplit bit-exactness, every engine
+# ---------------------------------------------------------------------------
+
+NUM_PARTS = 8
+HOT_PID = HashPartitioner(NUM_PARTS).partition("hot-0")
+
+
+def _hot_key_pool(m, n=40):
+    """``n`` distinct sortable keys for map ``m`` that ALL hash into
+    HOT_PID — many keys per hot partition keeps the reduce-side k-way
+    merge honest, and the per-map namespace keeps cross-map outputs
+    byte-comparable (see _hot_records)."""
+    part = HashPartitioner(NUM_PARTS)
+    out, i = [], 0
+    while len(out) < n:
+        k = f"hot-m{m}-{i:04d}"
+        if part.partition(k) == HOT_PID:
+            out.append(k)
+        i += 1
+    return out
+
+
+HOT_KEYS = {m: _hot_key_pool(m) for m in range(4)}
+
+
+def _conf(driver_port, skew_on, extra=None):
+    d = {
+        "spark.shuffle.tpu.driverPort": driver_port,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "10s",
+        "spark.shuffle.tpu.connectTimeout": "5s",
+        "spark.shuffle.tpu.skewEnabled": skew_on,
+        # far below the hot partition, above the uniform ones
+        "spark.shuffle.tpu.skewSplitThreshold": "16k",
+        "spark.shuffle.tpu.metrics": True,
+    }
+    if extra:
+        d.update(extra)
+    return TpuShuffleConf(d)
+
+
+@contextmanager
+def _cluster(netkind, driver_port, skew_on, extra=None):
+    extra = dict(extra or {})
+    if netkind == "tcp-threaded":
+        extra["spark.shuffle.tpu.transportAsyncDispatcher"] = "off"
+    if netkind == "loopback":
+        shared = LoopbackNetwork()
+
+        def mknet():
+            return shared
+    else:
+        def mknet():
+            return TcpNetwork()
+    driver = TpuShuffleManager(
+        _conf(driver_port, skew_on, extra), is_driver=True,
+        network=mknet(), port=driver_port, stage_to_device=False,
+    )
+    executors = [
+        TpuShuffleManager(
+            _conf(driver_port, skew_on, extra), is_driver=False,
+            network=mknet(), port=driver_port + 10 + i * 10,
+            executor_id=str(i), stage_to_device=False,
+        )
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == 2 for e in executors):
+            break
+        time.sleep(0.01)
+    try:
+        yield driver, executors
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+def _hot_records(m, n_hot=9000, n_cold=300):
+    """One map task's records: ~30x skew into HOT_PID across 40
+    distinct sortable keys, plus a uniform tail.  >1 pickle batch per
+    hot bucket so the commit has frames to cut at, and each key repeats
+    across batches so equal keys SPAN sub-block boundaries — the case
+    the reader's sub sequencing must keep stable.  Keys are unique per
+    MAP (``-m`` suffix): cross-map equal-key order is fetch-arrival-
+    dependent in the pre-PR path already (the existing e2e suites
+    compare per-key multisets for that reason), so byte-comparing
+    whole outputs is only sound without cross-map key collisions."""
+    pool = HOT_KEYS[m]
+    recs = [
+        (pool[j % len(pool)], bytes([m, j % 251]) * 30)
+        for j in range(n_hot)
+    ]
+    recs += [
+        (f"k{j % 61}-m{m}", bytes([m, j % 251]) * 30)
+        for j in range(n_cold)
+    ]
+    return recs
+
+
+def _run_shuffle(driver, executors, shuffle_id, key_ordering=True):
+    """Write 4 skewed map tasks across both executors, read every
+    partition from both sides; returns (per-reduce ordered outputs,
+    commit-time skew stats)."""
+    num_maps = 4
+    handle = driver.register_shuffle(
+        shuffle_id, num_maps, HashPartitioner(NUM_PARTS),
+        key_ordering=key_ordering,
+    )
+    maps_by_host = defaultdict(list)
+    for m in range(num_maps):
+        ex = executors[m % 2]
+        w = ex.get_writer(handle, m)
+        w.write(_hot_records(m))
+        w.stop(True)
+        maps_by_host[ex.local_smid].append(m)
+    stats = get_skew().shuffle_stats(shuffle_id)
+    out = []
+    for i, ex in enumerate(executors):
+        reader = ex.get_reader(
+            handle, i * 4, i * 4 + 4, dict(maps_by_host)
+        )
+        out.append(list(reader.read()))
+    return out, stats
+
+
+@pytest.mark.parametrize("netkind,port_off", [
+    ("loopback", 0),
+    ("tcp-async", 40),
+    ("tcp-threaded", 80),
+])
+@pytest.mark.parametrize("decode_threads", [0, 4])
+def test_split_vs_unsplit_bit_exact(netkind, port_off, decode_threads):
+    """The PR's core invariant: skewEnabled=on produces BYTE-identical
+    reduce output to =off — same records, same order (key_ordering
+    makes the order fully determined) — while actually splitting and
+    re-sequencing sub-blocks, on every engine, serial and pipelined
+    decode."""
+    port = BASE_PORT + port_off + (0 if decode_threads else 160)
+    extra = {"spark.shuffle.tpu.decodeThreads": decode_threads}
+    with _cluster(netkind, port, False, extra) as (driver, executors):
+        golden, stats_off = _run_shuffle(driver, executors, 11)
+    assert stats_off.get("partitions_split", 0) == 0
+    get_skew().reset()
+    fanin0 = GLOBAL_REGISTRY.histogram("skew_merge_fanin").count
+    with _cluster(netkind, port + 400, True, extra) as (driver, executors):
+        got, stats_on = _run_shuffle(driver, executors, 11)
+    assert stats_on["partitions_split"] >= 4  # hot pid split on all maps
+    assert stats_on["sub_blocks"] >= 2 * stats_on["partitions_split"]
+    assert got == golden  # bit-exact: same records, same order
+    # at least one reader actually merged a split partition's sub-runs
+    assert GLOBAL_REGISTRY.histogram("skew_merge_fanin").count > fanin0
+
+
+def test_columnar_split_bit_exact_loopback():
+    """The columnar zero-copy commit (_commit_direct) splits at its
+    per-(batch, partition) frame boundaries and stays bit-exact."""
+    extra = {"spark.shuffle.tpu.serializer": "columnar"}
+    port = BASE_PORT + 320
+
+    def run(skew_on, port):
+        with _cluster("loopback", port, skew_on, extra) as (drv, exs):
+            handle = drv.register_shuffle(
+                5, 2, HashPartitioner(NUM_PARTS), key_ordering=True,
+            )
+            maps_by_host = defaultdict(list)
+            rng = np.random.default_rng(3)
+            for m in range(2):
+                ex = exs[m % 2]
+                w = ex.get_writer(handle, m)
+                for _ in range(6):  # several batches → several frames
+                    keys = np.where(
+                        rng.random(4000) < 0.9,
+                        np.int64(HOT_PID),
+                        rng.integers(0, 1000, 4000),
+                    )
+                    w.write_columns(ColumnBatch(
+                        keys,
+                        rng.integers(0, 1 << 40, 4000).astype(np.int64),
+                    ))
+                w.stop(True)
+                maps_by_host[ex.local_smid].append(m)
+            stats = get_skew().shuffle_stats(5)
+            out = []
+            for i, ex in enumerate(exs):
+                r = ex.get_reader(
+                    handle, i * 4, i * 4 + 4, dict(maps_by_host)
+                )
+                out.append([(int(k), int(v)) for k, v in r.read()])
+            return out, stats
+
+    golden, _ = run(False, port)
+    get_skew().reset()
+    got, stats = run(True, port + 40)
+    assert stats["partitions_split"] >= 1
+    assert got == golden
+
+
+def test_uniform_workload_is_identity_noop():
+    """skewEnabled=on with uniform partition sizes: nothing classifies,
+    no markers are emitted, output matches =off exactly."""
+    def run(skew_on, port):
+        with _cluster("loopback", port, skew_on) as (drv, exs):
+            handle = drv.register_shuffle(
+                9, 2, HashPartitioner(NUM_PARTS), key_ordering=True,
+            )
+            maps_by_host = defaultdict(list)
+            for m in range(2):
+                ex = exs[m % 2]
+                w = ex.get_writer(handle, m)
+                w.write([
+                    (f"k{j % 200}", bytes([m, j % 251]) * 20)
+                    for j in range(2000)
+                ])
+                w.stop(True)
+                maps_by_host[ex.local_smid].append(m)
+            stats = get_skew().shuffle_stats(9)
+            out = []
+            for i, ex in enumerate(exs):
+                r = ex.get_reader(
+                    handle, i * 4, i * 4 + 4, dict(maps_by_host)
+                )
+                out.append(list(r.read()))
+            return out, stats
+
+    golden, _ = run(False, BASE_PORT + 480)
+    get_skew().reset()
+    got, stats = run(True, BASE_PORT + 520)
+    assert stats.get("partitions_split", 0) == 0
+    assert got == golden
+    # balance telemetry still recorded (satellite: skew view while off)
+    assert stats.get("partitions_nonzero", 0) > 0
+
+
+def test_subblock_fetch_failure_fails_stage_and_releases_reorder():
+    """Mid-fetch failure of a group carrying a sub-block: the reader
+    surfaces FetchFailedError (stage retry) instead of hanging on the
+    never-arriving sub-run, and cleanup releases any parked reorder
+    tickets."""
+    port = BASE_PORT + 560
+    with _cluster("tcp-async", port, True) as (driver, executors):
+        handle = driver.register_shuffle(
+            13, 2, HashPartitioner(NUM_PARTS), key_ordering=True,
+        )
+        maps_by_host = defaultdict(list)
+        for m in range(2):
+            ex = executors[m % 2]
+            w = ex.get_writer(handle, m)
+            w.write(_hot_records(m))
+            w.stop(True)
+            maps_by_host[ex.local_smid].append(m)
+        assert get_skew().shuffle_stats(13)["partitions_split"] >= 2
+        reader = executors[0].get_reader(
+            handle, 0, NUM_PARTS, dict(maps_by_host)
+        )
+        orig_issue = reader._issue
+        state = {"tripped": False}
+
+        def failing_issue(fetch):
+            if fetch.tags is not None and not state["tripped"]:
+                state["tripped"] = True
+                with reader._pending_lock:
+                    reader._bytes_in_flight -= fetch.total_bytes
+                fetch.win_tkt.release()
+                if fetch.qos_tkt is not NOOP_TICKET:
+                    fetch.qos_tkt.release()
+                reader._fail(FetchFailedError(
+                    fetch.host.host, 13, "injected sub-block loss"
+                ))
+                return
+            orig_issue(fetch)
+
+        reader._issue = failing_issue
+        with pytest.raises(FetchFailedError):
+            list(reader.read())
+        assert state["tripped"]
+        assert not reader._sub_buf
+
+
+def test_delta_republish_of_split_entries():
+    """Delta-sync republish (epoch-tagged dirty runs) of a table
+    holding markers + aux rows: the driver re-applies the extended
+    table and reads stay bit-exact — the wire plane never learned
+    about splitting."""
+    port = BASE_PORT + 600
+    with _cluster("tcp-async", port, True) as (driver, executors):
+        handle = driver.register_shuffle(
+            17, 2, HashPartitioner(NUM_PARTS), key_ordering=True,
+        )
+        maps_by_host = defaultdict(list)
+        mtos = []
+        for m in range(2):
+            ex = executors[m % 2]
+            w = ex.get_writer(handle, m)
+            w.write(_hot_records(m))
+            mtos.append((ex, m, w.stop(True)))
+            maps_by_host[ex.local_smid].append(m)
+
+        def read_all():
+            out = []
+            for i, ex in enumerate(executors):
+                r = ex.get_reader(
+                    handle, i * 4, i * 4 + 4, dict(maps_by_host)
+                )
+                out.append(list(r.read()))
+            return out
+
+        first = read_all()
+        # dirty EVERY entry (markers and aux rows included) and
+        # republish: ships as a fresh full-table delta at epoch+1
+        for ex, m, mto in mtos:
+            assert mto.num_partitions > NUM_PARTS  # table extended
+            mto.mark_dirty(0, mto.num_partitions - 1)
+            ex.publish_map_output(17, m, mto)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            tbl = driver._get_or_create_mto(
+                17, mtos[0][0].local_smid, mtos[0][1]
+            )
+            if tbl.fill_future.done():
+                break
+            time.sleep(0.01)
+        assert read_all() == first
+
+
+def test_local_reads_collapse_markers():
+    """A driver-local (single-manager) shuffle with splits: local reads
+    resolve markers via collapse (one whole-span read), never fetch
+    sub-blocks, and stay bit-exact."""
+    port = BASE_PORT + 640
+    mgr = TpuShuffleManager(
+        _conf(port, True), is_driver=True,
+        network=LoopbackNetwork(), port=port, stage_to_device=False,
+    )
+    try:
+        handle = mgr.register_shuffle(
+            19, 1, HashPartitioner(NUM_PARTS), key_ordering=True,
+        )
+        recs = _hot_records(0)
+        w = mgr.get_writer(handle, 0)
+        w.write(recs)
+        w.stop(True)
+        assert get_skew().shuffle_stats(19)["partitions_split"] >= 1
+        reader = mgr.get_reader(
+            handle, 0, NUM_PARTS, {mgr.local_smid: [0]}
+        )
+        got = list(reader.read())
+        assert got == sorted(recs, key=lambda kv: kv[0])
+        assert reader.metrics.remote_blocks == 0
+    finally:
+        mgr.stop()
+
+
+def test_sequence_sub_block_reorders_and_accounts():
+    """Unit drive of the reorder buffer: every sub-block parks
+    (ledger-tracked) until the full sibling set lands, then the whole
+    partition emits contiguously in sub order and all per-partition
+    state clears."""
+    port = BASE_PORT + 680
+    mgr = TpuShuffleManager(
+        _conf(port, True), is_driver=True,
+        network=LoopbackNetwork(), port=port, stage_to_device=False,
+    )
+    try:
+        handle = mgr.register_shuffle(23, 1, HashPartitioner(2))
+        r = mgr.get_reader(handle, 0, 1, {})
+        assert list(r._sequence_sub_block((5, 0, 1, 3), b"B")) == []
+        assert list(r._sequence_sub_block((5, 0, 0, 3), b"A")) == []
+        assert r._sub_buf and r.metrics.remote_blocks == 0
+        assert list(r._sequence_sub_block((5, 0, 2, 3), b"C")) == [
+            b"A", b"B", b"C",
+        ]
+        assert not r._sub_buf
+        assert r.metrics.remote_blocks == 3
+        # independent partitions sequence independently
+        assert list(r._sequence_sub_block((5, 1, 0, 2), b"x")) == []
+        assert list(r._sequence_sub_block((6, 0, 1, 2), b"y")) == []
+        assert set(r._sub_buf) == {(5, 1), (6, 0)}
+        assert list(r._sequence_sub_block((6, 0, 0, 2), b"z")) == [
+            b"z", b"y",
+        ]
+        # _cleanup releases tickets parked by the abandoned (5, 1) set
+        r._cleanup()
+        assert not r._sub_buf
+    finally:
+        mgr.stop()

@@ -426,6 +426,59 @@ def render_resources(counters: list, gauges: list) -> list:
     return out
 
 
+def render_skew(counters: list, hists: list) -> list:
+    """Skew-adaptive partitioning census (skew/): split decisions
+    (partitions split, sub-blocks committed, bytes re-routed through
+    sub-blocks), the write-time detection histogram
+    (``skew_partition_bytes`` — the distribution the split threshold
+    cuts), the writer-side split fan-out, and the reader's merge
+    fan-in (sub-blocks re-sequenced per split partition).  A uniform
+    run renders only the detection histogram; a Zipfian run with
+    splitting on shows all four."""
+    vals = {}
+    for c in counters:
+        if not c.get("labels"):
+            vals[c["name"]] = c["value"]
+    by_name = {
+        h["name"]: h for h in hists if not h.get("labels")
+    }
+    detect = by_name.get("skew_partition_bytes")
+    splits = vals.get("skew_partitions_split_total", 0)
+    if (detect is None or detect["count"] <= 0) and not splits:
+        return []
+    out = ["skew-adaptive partitioning (skew/)"]
+    out.append(
+        f"  partitions split={splits:,.0f}  "
+        f"sub-blocks={vals.get('skew_sub_blocks_total', 0):,.0f}  "
+        f"split bytes={_fmt_num(vals.get('skew_split_bytes_total', 0))}B"
+    )
+    if detect is not None and detect["count"] > 0:
+        n = detect["count"]
+        p50 = _percentile(detect["edges"], detect["counts"], n, 0.50)
+        p99 = _percentile(detect["edges"], detect["counts"], n, 0.99)
+        line = (
+            f"  detection: {n:,.0f} nonzero partition(s), "
+            f"{_fmt_num(detect['sum'])}B total"
+        )
+        if p50 > 0:
+            line += (
+                f", p50~{_fmt_num(p50)}B p99~{_fmt_num(p99)}B "
+                f"(p99/p50 {p99 / p50:.1f}x)"
+            )
+        out.append(line)
+    for name, label in (
+        ("skew_split_fanout", "writer split fan-out"),
+        ("skew_merge_fanin", "reader merge fan-in"),
+    ):
+        h = by_name.get(name)
+        if h is not None and h["count"] > 0:
+            out.append(
+                f"  {label}: {h['count']:,.0f} partition(s), "
+                f"mean {h['sum'] / h['count']:.1f} sub-block(s)"
+            )
+    return out
+
+
 def render_wire_health(counters: list) -> list:
     """Wire-health census (utils/wiredbg.py, conf wireDebug): one row
     per engine/opcode pair — frames validated vs rejected — plus the
@@ -493,6 +546,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_decode_pipeline(counters))
     lines.extend(render_tier(counters, gauges))
     lines.extend(render_resources(counters, gauges))
+    lines.extend(render_skew(counters, hists))
     lines.extend(render_wire_health(counters))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
