@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck flowcheck wirecheck test bench bench-smoke dryrun clean
+.PHONY: all native lint concheck flowcheck wirecheck test bench bench-smoke chaos dryrun clean
 
 all: native
 
@@ -55,6 +55,16 @@ bench-smoke:
 	python benchmarks/bench_qos.py
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_skew.py
+	$(MAKE) chaos
+
+# the seeded chaos soak alone (faults/, conf faultInject): the full
+# engine matrix — loopback / tcp-threaded / tcp-async × decode
+# threads × skew — under a mixed fault spec with resourceDebug +
+# lockDebug on; every run must be bit-exact or a clean
+# FetchFailedError with zero leaks and zero rank violations
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
+	-p no:cacheprovider -k chaos
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

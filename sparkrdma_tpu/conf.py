@@ -60,6 +60,9 @@ class TpuShuffleConf:
         # devices — it maps onto the dispatcher-thread affinity knob,
         # keeping deviceList free for mesh-device selection
         "cpuList": "dispatcherCpuList",
+        # the reference's connect-attempt knob maps onto the jittered
+        # retry policy (connectRetries + connectBackoffMs)
+        "maxConnectionAttempts": "connectRetries",
     }
 
     def __init__(self, conf: Optional[Mapping[str, object]] = None):
@@ -882,8 +885,77 @@ class TpuShuffleConf:
         return self._time_ms("teardownListenTimeout", 50)
 
     @property
-    def max_connection_attempts(self) -> int:
-        return self._int_in_range("maxConnectionAttempts", 5, 1, 100)
+    def connect_retries(self) -> int:
+        """Connect attempts per channel before the peer is declared
+        unreachable (reference: maxConnectionAttempts, accepted as a
+        legacy alias; an older ``spark.shuffle.tpu.maxConnectionAttempts``
+        setting still applies when ``connectRetries`` is unset)."""
+        legacy = self._int_in_range("maxConnectionAttempts", 5, 1, 100)
+        return self._int_in_range("connectRetries", legacy, 1, 100)
+
+    @property
+    def connect_backoff_ms(self) -> int:
+        """Base backoff between connect attempts; doubles per attempt
+        with equal jitter, capped at 16x base.  The wait stays
+        stop-interruptible (node teardown never blocks on it)."""
+        return self._time_ms("connectBackoffMs", 50)
+
+    # -- fault injection & in-task recovery ---------------------------------
+    @property
+    def fault_inject(self) -> str:
+        """Seeded deterministic fault-injection spec, e.g.
+        ``connect:p=0.1;read_resp:p=0.05;serve_delay:ms=30;seed=42``
+        (see faults/injector.py for the grammar and the point list).
+        Empty (the default) compiles every woven point to a no-op
+        bool check."""
+        return str(self.get("faultInject", ""))
+
+    @property
+    def fetch_retry_count(self) -> int:
+        """In-task retries per failed block fetch before converting to
+        FetchFailedError (0 = the reference posture: first failure is
+        terminal, byte-identical to the pre-retry path)."""
+        return self._int_in_range("fetchRetryCount", 3, 0, 100)
+
+    @property
+    def fetch_retry_wait_ms(self) -> int:
+        """Base fetch-retry backoff; doubles per attempt with equal
+        jitter (Spark lineage: spark.shuffle.io.retryWait)."""
+        return self._time_ms("fetchRetryWaitMs", 50)
+
+    @property
+    def fetch_retry_max_ms(self) -> int:
+        """Total retry deadline budget per fetch: attempts stop when
+        the elapsed retry time crosses this, whatever fetchRetryCount
+        still allows."""
+        return self._time_ms("fetchRetryMaxMs", 10_000)
+
+    @property
+    def fetch_breaker_failures(self) -> int:
+        """Consecutive terminal-bound failures against one peer that
+        trip its circuit breaker (further fetches fail fast instead of
+        each burning the full backoff budget); 0 disables the
+        breaker."""
+        return self._int_in_range("fetchBreakerFailures", 4, 0, 1000)
+
+    @property
+    def fetch_breaker_reset_ms(self) -> int:
+        """Open-breaker hold time before a single half-open probe
+        fetch is admitted (success closes, failure re-opens)."""
+        return self._time_ms("fetchBreakerResetMs", 2_000)
+
+    @property
+    def stripe_demote_failures(self) -> int:
+        """Consecutive striped-lane failures against one peer that
+        demote its large reads to the unstriped small-read lane; 0
+        disables demotion."""
+        return self._int_in_range("stripeDemoteFailures", 2, 0, 1000)
+
+    @property
+    def stripe_demote_ms(self) -> int:
+        """How long a stripe demotion lasts before striped reads are
+        re-attempted against the peer."""
+        return self._time_ms("stripeDemoteMs", 5_000)
 
     # -- device placement (reference: cpuList comp-vector pinning) ----------
     @property

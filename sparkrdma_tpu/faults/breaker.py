@@ -1,0 +1,167 @@
+"""Per-peer failure containment: circuit breaker + stripe health.
+
+Both live on the Node keyed by peer (``node.peer_health``) — NOT on a
+ReadGroup, which the failure path destroys (``invalidate_read_group``)
+on every error, exactly when history must survive.
+
+:class:`CircuitBreaker` — repeated fetch failures against one peer
+trip the breaker OPEN; while open, remaining fetches to that peer fail
+fast instead of serially burning the full backoff budget each.  After
+``reset_ms`` the breaker goes HALF_OPEN and admits ONE probe fetch:
+success closes it, failure re-opens (and restarts the clock).
+
+:class:`StripeHealth` — repeated striped-lane failures demote the
+peer's large reads to the unstriped small-read lane for a window
+(PR 7's dry-pool fallback generalized to a health signal); a
+successful read while not demoted clears the strike count.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Tuple
+
+from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.utils.dbglock import dbg_lock
+
+_CLOSED, _OPEN, _HALF_OPEN = 0, 1, 2
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing.
+
+    ``failures=0`` disables the breaker: :meth:`allow` is always true
+    and nothing ever trips.  ``clock`` is injectable for tests."""
+
+    def __init__(self, failures: int, reset_ms: float, name: str = "",
+                 clock: Callable[[], float] = time.monotonic):
+        self.failures = int(failures)
+        self.reset_s = float(reset_ms) / 1000.0
+        self.name = name
+        self._clock = clock
+        self._lock = dbg_lock("faults.breaker", 47)
+        self._state = _CLOSED  # guarded-by: _lock
+        self._strikes = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self.trips = 0  # guarded-by: _lock
+
+    def allow(self) -> bool:
+        """May a fetch proceed?  OPEN past ``reset_ms`` transitions to
+        HALF_OPEN and admits exactly one probe; a HALF_OPEN breaker
+        with its probe outstanding refuses further fetches."""
+        if self.failures <= 0:
+            return True
+        with self._lock:
+            if self._state == _CLOSED:
+                return True
+            if self._state == _OPEN:
+                if self._clock() - self._opened_at >= self.reset_s:
+                    self._state = _HALF_OPEN
+                    return True  # the probe
+                return False
+            return False  # HALF_OPEN: probe already out
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._strikes = 0
+            self._state = _CLOSED
+
+    def record_failure(self) -> None:
+        if self.failures <= 0:
+            return
+        tripped = False
+        with self._lock:
+            self._strikes += 1
+            if self._state == _HALF_OPEN:
+                # the probe failed: straight back to OPEN, clock restarts
+                self._state = _OPEN
+                self._opened_at = self._clock()
+            elif self._state == _CLOSED and self._strikes >= self.failures:
+                self._state = _OPEN
+                self._opened_at = self._clock()
+                self.trips += 1
+                tripped = True
+        if tripped:
+            counter("transport_breaker_trips_total", peer=self.name).inc()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return ("closed", "open", "half-open")[self._state]
+
+
+class StripeHealth:
+    """Consecutive striped-lane failure tracker driving demotion.
+
+    ``failures=0`` disables demotion.  Demotion lasts ``demote_ms``;
+    each demoted read counts ``transport_stripe_demotions_total``
+    at the decision site (ReadGroup), not here."""
+
+    def __init__(self, failures: int, demote_ms: float, name: str = "",
+                 clock: Callable[[], float] = time.monotonic):
+        self.failures = int(failures)
+        self.demote_s = float(demote_ms) / 1000.0
+        self.name = name
+        self._clock = clock
+        self._lock = dbg_lock("faults.stripe_health", 47)
+        self._strikes = 0  # guarded-by: _lock
+        self._demoted_until = 0.0  # guarded-by: _lock
+
+    def note_lane_failure(self) -> None:
+        if self.failures <= 0:
+            return
+        with self._lock:
+            self._strikes += 1
+            if self._strikes >= self.failures:
+                self._demoted_until = self._clock() + self.demote_s
+                self._strikes = 0
+
+    def note_success(self) -> None:
+        with self._lock:
+            if self._clock() >= self._demoted_until:
+                self._strikes = 0
+                self._demoted_until = 0.0
+
+    def demoted(self) -> bool:
+        if self.failures <= 0:
+            return False
+        with self._lock:
+            return self._clock() < self._demoted_until
+
+
+class PeerHealth:
+    """One peer's breaker + stripe health, built from conf knobs."""
+
+    __slots__ = ("breaker", "stripes")
+
+    def __init__(self, peer: Tuple[str, int], conf,
+                 clock: Callable[[], float] = time.monotonic):
+        name = f"{peer[0]}:{peer[1]}"
+        self.breaker = CircuitBreaker(
+            conf.fetch_breaker_failures, conf.fetch_breaker_reset_ms,
+            name=name, clock=clock)
+        self.stripes = StripeHealth(
+            conf.stripe_demote_failures, conf.stripe_demote_ms,
+            name=name, clock=clock)
+
+
+class PeerHealthRegistry:
+    """Node-resident ``peer -> PeerHealth`` map.  Lives on the Node
+    (rank 43, below the per-health locks at 47) so health survives
+    ReadGroup invalidation across retry attempts."""
+
+    def __init__(self, conf):
+        self._conf = conf
+        self._lock = dbg_lock("node.peer_health", 43)
+        self._peers: Dict[Tuple[str, int], PeerHealth] = {}  # guarded-by: _lock
+
+    def get(self, peer: Tuple[str, int]) -> PeerHealth:
+        with self._lock:
+            h = self._peers.get(peer)
+            if h is None:
+                h = self._peers[peer] = PeerHealth(peer, self._conf)
+            return h
+
+    def clear(self) -> None:
+        with self._lock:
+            self._peers.clear()

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.memory.staging import alloc_row_gc
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.transport.channel import TransportError
@@ -683,6 +684,11 @@ class TieredBlockStore:
         """Cold-tier read (NO lock held — concheck DISK_BLOCKING):
         O_DIRECT pread for large spans, the lazily created mmap view
         otherwise/fallback."""
+        if FAULTS.enabled:
+            # models a failed/slow spill read: surfaces through the
+            # same TransportError path as the freed-entry race below,
+            # so the serve side converts it to a retryable failure
+            FAULTS.check("disk_read")
         mf = entry.mf
         if length >= TIER_DIRECT_READ_MIN:
             got = mf.pread(offset, length)

@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.qos import CreditLedger
 from sparkrdma_tpu.utils.dbglock import dbg_condition
@@ -406,6 +407,10 @@ class DecodePool:
                 item._state = _DECODING
             t0 = time.monotonic()
             try:
+                if FAULTS.enabled:
+                    # models a poisoned payload: surfaces through the
+                    # ticket's error slot like any decode_fn raise
+                    FAULTS.check("decode")
                 item._result = item._fn(item._data)
             except BaseException as e:
                 item._error = e

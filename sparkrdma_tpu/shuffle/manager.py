@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.faults.injector import FAULTS, FaultInjectedError
 from sparkrdma_tpu.memory.arena import ArenaManager
 from sparkrdma_tpu.memory.staging import StagingPool
 from sparkrdma_tpu.metrics import (
@@ -279,6 +280,16 @@ class TpuShuffleManager:
             from sparkrdma_tpu.utils.wiredbg import set_wire_debug
 
             set_wire_debug(True)
+        # deterministic fault plane (faults/): arm the process-global
+        # injector from the seeded spec BEFORE building the node, so
+        # every fault point the transport/memory/control planes pass
+        # through sees the schedule from the first call.  Empty spec
+        # (the default) leaves FAULTS.enabled False — each woven point
+        # costs one attribute check and nothing else.
+        self._faults_armed = False
+        if conf.fault_inject:
+            FAULTS.arm(conf.fault_inject)
+            self._faults_armed = True
         # multi-tenant QoS (qos/): flip the process-global tenant
         # registry on BEFORE building the node, exactly like the
         # metrics registry — the node's pools classify/broker through
@@ -530,6 +541,13 @@ class TpuShuffleManager:
             except Exception as e:
                 node.stop()  # release the failed node's dispatcher threads
                 last_err = e
+                # a silent move is a debugging nightmare: every peer
+                # that dials the CONFIGURED port sees dead refusals,
+                # so the move must at least be visible in the log
+                logger.warning(
+                    "bind at %s:%d failed (%s) — retrying at %d",
+                    host, base + attempt, e, base + attempt + 1,
+                )
         raise RuntimeError(f"could not bind node near {host}:{base}") from last_err
 
     # -- control-plane send helpers -----------------------------------------
@@ -659,6 +677,12 @@ class TpuShuffleManager:
                             smid.block_manager_id.executor_id, now - last,
                         )
                         self.remove_executor(smid)
+                        continue
+                    if FAULTS.enabled and FAULTS.fires("heartbeat"):
+                        # dropped probe, NOT a raised error: a raised
+                        # send failure would prune the executor, but
+                        # this point models a lost packet — the peer
+                        # stays alive and the next sweep probes again
                         continue
                     try:
                         # _send_via retries once on the eviction race:
@@ -1668,6 +1692,13 @@ class TpuShuffleManager:
                     )
                     mto.mark_dirty(first, last)
 
+                if FAULTS.enabled and FAULTS.fires("publish"):
+                    # a LOST publish, not a raised one: the run
+                    # re-dirties (delta plane's self-heal) and ships
+                    # with the next publish instead of failing the
+                    # commit — this point exercises exactly that path
+                    requeue(FaultInjectedError("publish"))
+                    continue
                 try:
                     self._send_driver_msg(msg, on_failure=requeue)
                 except BaseException:
@@ -2044,3 +2075,10 @@ class TpuShuffleManager:
             from sparkrdma_tpu.utils.ledger import get_resource_ledger
 
             get_resource_ledger().stop(raise_on_leak=False)
+        if self._faults_armed:
+            # owner-counted like the ledger: only the LAST armed
+            # manager in the process disarms the injector, so an
+            # in-process cluster keeps one deterministic stream alive
+            # until every member has stopped
+            FAULTS.stop()
+            self._faults_armed = False

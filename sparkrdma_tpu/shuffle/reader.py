@@ -26,6 +26,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
+from sparkrdma_tpu.faults.injector import FAULTS
+from sparkrdma_tpu.faults.retry import RetryPolicy, is_transient
 from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
@@ -116,6 +118,10 @@ class _PendingFetch:
     # brokered credits this fetch holds while on the wire
     win_tkt: Any = NOOP_TICKET
     qos_tkt: Any = NOOP_TICKET
+    # in-task retry state (faults/retry.py): failures observed so far
+    # and the monotonic stamp of the first one (the deadline anchor)
+    attempts: int = 0
+    first_failure_at: float = 0.0
 
 
 class _Result:
@@ -192,6 +198,25 @@ class ShuffleReader:
         # resource: reader.skew_reorder_bytes (parked sub-block payloads)
         # (mid, rid) -> {sub index: (payload, ledger ticket)}
         self._sub_buf: Dict[Any, Dict[int, Any]] = {}
+        # in-task fetch retry (faults/): transient transport failures
+        # back off and requeue through the normal _pump path instead of
+        # converting straight to FetchFailedError.  fetchRetryCount=0
+        # keeps the reference posture — the first-failure path is then
+        # byte-identical to the pre-retry reader (no health recording,
+        # no breaker consultation, same conversion)
+        conf = manager.conf
+        self._retry = RetryPolicy(
+            conf.fetch_retry_count, conf.fetch_retry_wait_ms,
+            conf.fetch_retry_max_ms,
+        )
+        # peers this READER already sent through an open breaker as its
+        # one probe — the breaker is node-resident and outlives the
+        # task, but a fresh reader (a stage retry on a healed fleet)
+        # must never be fast-failed on stale state alone: its first
+        # fetch per peer always goes out, and only after THAT fails do
+        # the remaining fetches take the fast path
+        # (guarded-by: _pending_lock)
+        self._breaker_probes: set = set()
         self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
         self._m_local_read = histogram("shuffle_local_read_ms")
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
@@ -312,6 +337,8 @@ class ShuffleReader:
         )
         timer.start()
         try:
+            if FAULTS.enabled:
+                FAULTS.check("location_rpc")
             # _send_driver_msg retries once if the cached driver
             # channel was evicted from the bounded cache between
             # lookup and post (reconnects transparently)
@@ -561,8 +588,16 @@ class ShuffleReader:
         t0 = time.monotonic()
         progressed = [0]
         settled = [False]
+        done = [False]
         broker = self._inflight
         qos_left = [fetch.qos_granted]
+        peer = (fetch.host.host, fetch.host.port)
+        # per-peer recovery state, consulted only with retry on (the
+        # fetchRetryCount=0 path must stay byte-identical)
+        health = (
+            self.manager.node.peer_health(peer)
+            if self._retry.enabled else None
+        )
 
         def on_progress(n):
             # stripe-granular window accounting: each landed stripe (or
@@ -604,9 +639,26 @@ class ShuffleReader:
                 broker.release(rel, self._tenant)
             fetch.qos_tkt.release()  # releases: reader.qos_inflight_bytes  # one-shot
 
+        def finish_once() -> bool:
+            # the group's FIRST outcome wins: a channel torn down while
+            # its completion is in flight can fail a listener from both
+            # the reads drain and the outstanding drain (or fail after
+            # a success already landed) — a second outcome must neither
+            # deliver blocks twice nor schedule a second retry timer
+            # for the same fetch
+            with self._pending_lock:
+                if done[0]:
+                    return False
+                done[0] = True
+                return True
+
         def on_success(blocks):
+            if not finish_once():
+                return
             latency = (time.monotonic() - t0) * 1000
             settle()
+            if health is not None:
+                health.breaker.record_success()
             if self.manager.stats is not None:
                 self.manager.stats.update(fetch.host.host, latency)
             self._m_fetch_latency.observe(latency)
@@ -628,6 +680,8 @@ class ShuffleReader:
             self._pump()
 
         def on_failure(err):
+            if not finish_once():
+                return
             settle()
             # the peer's striped group just failed a read: drop its
             # cached read group so the retried stage (or the next
@@ -636,12 +690,73 @@ class ShuffleReader:
             self.manager.node.invalidate_read_group(
                 (fetch.host.host, fetch.host.port)
             )
+            if health is None:
+                self._fail(
+                    FetchFailedError(
+                        fetch.host.host, self.handle.shuffle_id, str(err)
+                    )
+                )
+                return
+            health.breaker.record_failure()
+            now = time.monotonic()
+            if fetch.attempts == 0:
+                # the retry deadline is budgeted from the FIRST failure,
+                # not per attempt — a peer limping along cannot stretch
+                # the task past fetchRetryMaxMs by failing slowly
+                fetch.first_failure_at = now
+            fetch.attempts += 1
+            elapsed_ms = (now - fetch.first_failure_at) * 1000.0
+            delay_ms = self._retry.next_delay_ms(fetch.attempts, elapsed_ms)
+            if (
+                is_transient(err)
+                and delay_ms is not None
+                and self._failed is None
+                and health.breaker.allow()
+            ):
+                counter("shuffle_fetch_retries_total").inc()
+                counter("shuffle_fetch_retry_ms_total").inc(int(delay_ms))
+                get_tracer().instant(
+                    "shuffle.fetch.retry", host=fetch.host.host,
+                    attempt=fetch.attempts, delay_ms=round(delay_ms, 1),
+                )
+                tm = threading.Timer(
+                    delay_ms / 1000.0, self._requeue, args=(fetch,)
+                )
+                tm.daemon = True
+                with self._pending_lock:
+                    self._timers.append(tm)
+                tm.start()
+                return
+            counter("shuffle_fetch_failures_total").inc()
             self._fail(
                 FetchFailedError(
                     fetch.host.host, self.handle.shuffle_id, str(err)
                 )
             )
 
+        if health is not None and not health.breaker.allow():
+            # breaker open: this peer burned its failure budget — fail
+            # the remaining fetches fast instead of paying another full
+            # connect+backoff cycle against a peer known bad.  But the
+            # breaker outlives the task (node-resident by design), and
+            # a stage retry's fresh reader must not inherit a fast-fail
+            # for a peer that may have healed: each reader's FIRST
+            # fetch per open peer goes out as the probe — success
+            # closes the breaker, failure arms the fast path for the
+            # fetches behind it.
+            with self._pending_lock:
+                probed = peer in self._breaker_probes
+                self._breaker_probes.add(peer)
+            if probed:
+                settle()
+                counter("shuffle_fetch_failures_total").inc()
+                self._fail(
+                    FetchFailedError(
+                        fetch.host.host, self.handle.shuffle_id,
+                        "circuit breaker open for %s:%d" % peer,
+                    )
+                )
+                return
         try:
             group = self.manager.node.get_read_group(
                 (fetch.host.host, fetch.host.port),
@@ -659,6 +774,18 @@ class ShuffleReader:
     def _fail(self, err: FetchFailedError) -> None:
         self._failed = err
         self._results.put(_Result(error=err))
+
+    def _requeue(self, fetch: _PendingFetch) -> None:
+        # timer callback: the backoff elapsed, put the fetch back at
+        # the HEAD of the pending queue (it already waited its turn)
+        # and let the normal pump re-acquire window + QoS tickets for
+        # the new attempt.  _outstanding_blocks never dropped, so the
+        # consumer keeps blocking through the backoff window.
+        with self._pending_lock:
+            if self._failed is not None:
+                return
+            self._pending.insert(0, fetch)
+        self._pump()
 
     # -- consumption --------------------------------------------------------
     def _iter_block_bytes(self) -> Iterator:

@@ -34,7 +34,50 @@ from sparkrdma_tpu.utils.types import BlockLocation
 
 
 class TransportError(Exception):
-    """Raised for channel/node failures (connect, send, read, teardown)."""
+    """Raised for channel/node failures (connect, send, read, teardown).
+
+    ``transient`` classifies the failure for the reader's in-task
+    retry policy: transient errors (the default — connection drops,
+    lane deaths, injected faults) are worth retrying; fatal ones
+    (:class:`FatalTransportError` — protocol violations, missing
+    block stores) convert straight to ``FetchFailedError``.
+    """
+
+    transient = True
+
+
+class FatalTransportError(TransportError):
+    """A transport failure retrying cannot fix (bad frame, unknown
+    mkey, wire-version mismatch)."""
+
+    transient = False
+
+
+def is_transient(err: BaseException) -> bool:
+    """Retry classification: only transport errors marked transient
+    qualify — anything else (decode bugs, serialization errors) is a
+    program error a retry would just repeat."""
+    return isinstance(err, TransportError) and err.transient
+
+
+_FATAL_PREFIX = "FATAL:"
+
+
+def encode_remote_error(err: BaseException) -> str:
+    """Serve-side error -> status-frame reason string.  Fatal errors
+    carry a classification prefix so the requester's taxonomy survives
+    the wire without a frame change."""
+    reason = str(err)
+    if not is_transient(err) and isinstance(err, TransportError):
+        return _FATAL_PREFIX + reason
+    return reason
+
+
+def decode_remote_error(reason: str) -> TransportError:
+    """Status-frame reason string -> classified transport error."""
+    if reason.startswith(_FATAL_PREFIX):
+        return FatalTransportError(reason[len(_FATAL_PREFIX):])
+    return TransportError(reason)
 
 
 class ChannelType(enum.Enum):
@@ -214,10 +257,25 @@ class Channel:
     def _enqueue(self, post_fn: Callable[[], None], listener: CompletionListener):
         if self._budget.acquire(blocking=False):
             self._track(listener)
+            if self._state == ChannelState.STOPPED:
+                # raced stop() between _check_usable and _track: its
+                # outstanding drain may have run before this op was
+                # visible, so nothing would ever fail it — fail it
+                # here (a drain that DID see it double-fails, which
+                # listeners absorb as first-outcome-wins)
+                self._fail(listener, TransportError("channel stopped"))
+                self._budget.release()
+                return
             self._run_post(post_fn, listener)
         else:
             with self._pending_lock:
-                self._pending.append((post_fn, listener))
+                if self._state != ChannelState.STOPPED:
+                    self._pending.append((post_fn, listener))
+                    return
+            # stop() set STOPPED before draining _pending under this
+            # same lock: reaching here means the drain already ran and
+            # an append would be orphaned on a dead channel forever
+            self._fail(listener, TransportError("channel stopped"))
 
     def _run_post(self, post_fn, listener) -> None:
         try:

@@ -61,6 +61,7 @@ blocking-call analysis re-aimed at the loop's callback plane).
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import select
@@ -73,6 +74,7 @@ from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, gauge, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
@@ -80,6 +82,7 @@ from sparkrdma_tpu.transport.channel import (
     ChannelType,
     CompletionListener,
     TransportError,
+    decode_remote_error,
 )
 from sparkrdma_tpu.transport import tcp as wire
 from sparkrdma_tpu.utils import wiredbg
@@ -509,6 +512,14 @@ class Dispatcher:
                 pass
 
 
+# accept() errnos that mean the LISTENING socket itself is gone —
+# anything else (ECONNABORTED, EMFILE, ENFILE, ENOBUFS, ...) is a
+# per-connection or transient-pressure failure the listener survives
+_FATAL_ACCEPT_ERRNOS = frozenset(
+    (errno.EBADF, errno.EINVAL, errno.ENOTSOCK)
+)
+
+
 class Acceptor:
     """The listening socket on the loop — the CM listener with no
     thread.  Fresh connections enter a :class:`_Handshake` continuation;
@@ -533,8 +544,17 @@ class Acceptor:
                 sock, addr = self._sock.accept()
             except (BlockingIOError, InterruptedError):
                 return
-            except OSError:
-                self.loop_close(None)
+            except OSError as e:
+                if self._closed or e.errno in _FATAL_ACCEPT_ERRNOS:
+                    self.loop_close(None)
+                    return
+                # transient: ECONNABORTED (the peer reset before we
+                # accepted — routine when a connect attempt dies
+                # mid-handshake) or fd/buffer pressure.  The LISTENER
+                # is still healthy; closing it here would refuse every
+                # future peer on this node forever.  Level-triggered
+                # readiness re-fires for anything still queued.
+                counter("transport_accept_transient_errors_total").inc()
                 return
             try:
                 sock.setblocking(False)
@@ -1108,6 +1128,12 @@ class AsyncTcpChannel(Channel):
                 self._complete(listener, None)
             self._release_budget()
 
+        if FAULTS.enabled:
+            try:
+                FAULTS.check("send")
+            except TransportError as e:
+                done(e)
+                return
         self._post_op(_SendOp(views, total, len(frames), done))
 
     def _post_read(self, locations: List[BlockLocation],
@@ -1137,6 +1163,12 @@ class AsyncTcpChannel(Channel):
                 self._release_budget()
             # success: budget released when the response arrives
 
+        if FAULTS.enabled:
+            try:
+                FAULTS.check("send")
+            except TransportError as e:
+                done(e)
+                return
         self._post_op(self._frame_op(wire.OP_READ_REQ, (payload,), 1, done))
 
     # -- send machine (loop side) -------------------------------------------
@@ -1346,6 +1378,10 @@ class AsyncTcpChannel(Channel):
         state = self._rx_state
         if state == self._HDR:
             opcode, length = wire._HDR.unpack(bytes(self._rx_store))
+            if FAULTS.enabled:
+                # raising here rides the _rx_pump failure path: the
+                # channel dies and outstanding reads fail structured
+                FAULTS.check("recv")
             if length > wire._MAX_FRAME:
                 raise TransportError(f"oversized frame: {length}B")
             if wiredbg.wire_debug_enabled():
@@ -1369,6 +1405,8 @@ class AsyncTcpChannel(Channel):
                 else:
                     self._arm_fixed(self._REQ, length)
             elif opcode == wire.OP_READ_RESP:
+                if FAULTS.enabled:
+                    FAULTS.check("read_resp")
                 if length < wire._RESP_HDR.size:
                     raise TransportError(f"short read response: {length}B")
                 self._rx_frame_len = length
@@ -1407,7 +1445,7 @@ class AsyncTcpChannel(Channel):
             self._rx_block_done(self._rx_block, self._rx_view.nbytes)
         elif state == self._RESP_ERR:
             reason = bytes(self._rx_store).decode("utf-8", "replace")
-            self._rx_settle(None, TransportError(reason))
+            self._rx_settle(None, decode_remote_error(reason))
         else:  # pragma: no cover - state machine exhaustive
             raise TransportError(f"bad recv state {state}")
 

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
@@ -150,6 +151,11 @@ class LoopbackChannel(Channel):
             # fail fast BEFORE consuming credits: a dead channel must not
             # burn credits it can never get reported back
             err = self._check_deliverable()
+            if err is None and FAULTS.enabled:
+                try:
+                    FAULTS.check("send")
+                except TransportError as e:
+                    err = e
             if err is not None:
                 self._error(err)
                 self._fail(listener, err)
@@ -270,6 +276,12 @@ class LoopbackChannel(Channel):
                     )
                 if self.state != ChannelState.CONNECTED:
                     raise TransportError("channel not connected")
+                if FAULTS.enabled:
+                    FAULTS.check("serve_delay")
+                    FAULTS.check("serve")
+                    # loopback has no response frame to cut, so the
+                    # read_resp point fires here on the reply boundary
+                    FAULTS.check("read_resp")
                 data = self.remote.read_local_blocks(locations)
             except BaseException as e:
                 fail(e)
@@ -371,6 +383,8 @@ class LoopbackNetwork:
         counter(
             "transport_connect_attempts_total", transport="loopback"
         ).inc()
+        if FAULTS.enabled:
+            FAULTS.check("connect")
         dst = self.lookup(peer)
         if dst is None:
             counter(

@@ -22,12 +22,14 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.faults.breaker import PeerHealthRegistry
 from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.qos import (
     BULK,
@@ -42,6 +44,7 @@ from sparkrdma_tpu.transport.channel import (
     BlockStore,
     Channel,
     ChannelType,
+    FatalTransportError,
     TransportError,
 )
 from sparkrdma_tpu.utils.types import BlockLocation
@@ -366,6 +369,11 @@ class Node:
         # per-peer striped read groups (lazy; share the channel cache)
         self._read_groups: Dict[Address, object] = {}  # guarded-by: _read_groups_lock
         self._read_groups_lock = dbg_lock("node.read_groups", 44)
+        # per-peer recovery state (faults/breaker.py): circuit breaker
+        # + stripe health.  Node-resident — NOT on the ReadGroup, which
+        # invalidate_read_group destroys on exactly the failures this
+        # history must survive
+        self._peer_health = PeerHealthRegistry(self.conf)
         self._passive: List[Channel] = []  # guarded-by: _passive_lock
         self._passive_lock = dbg_lock("node.passive", 46)
         # completion/dispatch pool — the RdmaThread analog: completions and
@@ -577,7 +585,9 @@ class Node:
         with self._block_store_lock:
             store = self._block_stores.get(location.mkey)
         if store is None:
-            raise TransportError(
+            # fatal: the shuffle was unregistered (or never registered)
+            # here — a retry would just re-ask the same dead question
+            raise FatalTransportError(
                 f"{self}: no block store registered for mkey={location.mkey}"
             )
         return store.read_block(location)
@@ -591,7 +601,7 @@ class Node:
             for i, loc in enumerate(locations):
                 store = self._block_stores.get(loc.mkey)
                 if store is None:
-                    raise TransportError(
+                    raise FatalTransportError(
                         f"{self}: no block store registered for "
                         f"mkey={loc.mkey}"
                     )
@@ -622,7 +632,9 @@ class Node:
         ``connect`` is the backend's connector.  Mirrors the reference's
         racy-create + retry loop (RdmaNode.java:277-351): concurrent
         callers race benignly, losers close their extra channel; dead
-        cached channels are replaced up to max_connection_attempts.
+        cached channels are replaced up to ``connectRetries`` attempts
+        with jittered exponential backoff (``connectBackoffMs`` base,
+        doubling per attempt, capped at 16x).
         ``slot`` distinguishes the parallel data lanes of a striped
         channel group — each slot is its own cached connection.
 
@@ -638,7 +650,8 @@ class Node:
         """
         attempts = 0
         last_err: Optional[BaseException] = None
-        max_attempts = self.conf.max_connection_attempts if must_retry else 1
+        max_attempts = self.conf.connect_retries if must_retry else 1
+        backoff_s = self.conf.connect_backoff_ms / 1000.0
         key = (peer, channel_type, slot)
         while attempts < max_attempts and not self._stopped.is_set():
             attempts += 1
@@ -653,10 +666,15 @@ class Node:
                 new_ch = connect(self, peer, channel_type)
             except BaseException as e:
                 last_err = e
-                # backoff on the stop event, not time.sleep: node
-                # teardown mid-retry interrupts the wait immediately
-                # instead of blocking stop() up to 0.5s per attempt
-                if self._stopped.wait(min(0.05 * attempts, 0.5)):
+                # jittered exponential backoff (equal jitter: half
+                # fixed, half uniform — lockstep reconnect storms after
+                # a shared-fabric blip decorrelate) on the stop event,
+                # not time.sleep: node teardown mid-retry interrupts
+                # the wait immediately instead of blocking stop()
+                base = min(backoff_s * (2.0 ** (attempts - 1)),
+                           backoff_s * 16.0)
+                delay = base / 2.0 + random.uniform(0.0, base / 2.0)
+                if self._stopped.wait(delay):
                     break
                 continue
             with self._active_lock:
@@ -805,6 +823,12 @@ class Node:
                 gauge("transport_read_groups").inc()
         return group
 
+    def peer_health(self, peer: Address):
+        """``peer``'s recovery state (breaker + stripe health) —
+        created on first use, survives read-group invalidation, cleared
+        only at node stop."""
+        return self._peer_health.get(peer)
+
     def invalidate_read_group(self, peer: Address) -> None:
         """Drop ``peer``'s cached read group (dead peer / evicted
         lanes): a group object already held by a reader keeps working —
@@ -916,6 +940,7 @@ class Node:
             self._read_groups.clear()
         if n_groups:
             gauge("transport_read_groups").dec(n_groups)
+        self._peer_health.clear()
         with self._block_store_lock:
             self._block_stores.clear()
 

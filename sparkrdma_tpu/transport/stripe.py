@@ -46,6 +46,7 @@ from typing import List, Sequence
 
 import numpy as np
 
+from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.transport.channel import (
@@ -170,6 +171,8 @@ class ReadGroup:
         once if the cached channel was evicted between the cache lookup
         and the post (``read_blocks`` raises synchronously BEFORE
         touching the listener, so a retry can never double-deliver)."""
+        if FAULTS.enabled and slot > 0:
+            FAULTS.check("stripe")
         for attempt in (0, 1):
             ch = self.channel(slot)
             try:
@@ -179,11 +182,18 @@ class ReadGroup:
                     ch.read_blocks(
                         locs, listener, dest=dest, on_progress=on_progress
                     )
-                return
             except TransportError:
                 if attempt:
                     raise
                 self._m_evict_races.inc()
+                continue
+            if (FAULTS.enabled and slot > 0
+                    and FAULTS.fires("lane_kill")):
+                # mid-read lane death: the sub-read was posted, now the
+                # lane dies under it — _fail_outstanding surfaces the
+                # structured failure exactly like a real cut socket
+                ch.stop()
+            return
 
     def read_blocks(
         self,
@@ -208,6 +218,12 @@ class ReadGroup:
              if loc.length > self.threshold]
             if scatter and self.num_stripes > 1 else []
         )
+        if striped and self.node.peer_health(self.peer).stripes.demoted():
+            # repeated lane failures against this peer: demote to the
+            # unstriped small-read lane for the health window (the
+            # dry-pool fallback below, driven by a health signal)
+            counter("transport_stripe_demotions_total").inc()
+            striped = []
         lanes_borrowed = 0
         if striped:
             # borrow this read's stripe width from the node-wide pool;
@@ -295,11 +311,21 @@ class ReadGroup:
             pending=len(live_lanes) + (1 if small else 0),
             on_finish=release_lanes,
         )
+        health = self.node.peer_health(self.peer).stripes
+
+        def lane_done(_blocks) -> None:
+            health.note_success()
+            state.part_done()
+
+        def lane_fail(err: BaseException) -> None:
+            # striped-lane failure feeds the peer's demotion signal
+            # BEFORE the group fails, so the retry attempt already
+            # sees the updated health
+            health.note_lane_failure()
+            state.fail(err)
 
         def lane_listener():
-            return FnCompletionListener(
-                lambda _blocks: state.part_done(), state.fail
-            )
+            return FnCompletionListener(lane_done, lane_fail)
 
         def small_done(blocks):
             for idx, b in zip(small, blocks):

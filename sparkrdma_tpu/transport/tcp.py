@@ -37,6 +37,7 @@ versions in the error instead of desyncing mid-stream.
 
 from __future__ import annotations
 
+import errno
 import logging
 import socket
 import struct
@@ -44,6 +45,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, gauge, histogram
 from sparkrdma_tpu.transport.channel import (
     Channel,
@@ -51,6 +53,8 @@ from sparkrdma_tpu.transport.channel import (
     ChannelType,
     CompletionListener,
     TransportError,
+    decode_remote_error,
+    encode_remote_error,
 )
 from sparkrdma_tpu.transport.node import Address, Node
 from sparkrdma_tpu.utils import wiredbg
@@ -154,6 +158,9 @@ def build_read_response_parts(node, payload: bytes, peer) -> Optional[List]:
             addr, length, mkey = _LOC.unpack_from(payload, off)
             off += _LOC.size
             locs.append(BlockLocation(addr, length, mkey))
+        if FAULTS.enabled:
+            FAULTS.check("serve_delay")
+            FAULTS.check("serve")
         blocks = node.read_local_blocks(locs)
         parts: List = [_RESP_HDR.pack(req_id, 0)]
         for b in blocks:
@@ -163,7 +170,7 @@ def build_read_response_parts(node, payload: bytes, peer) -> Optional[List]:
     except BaseException as e:
         parts = [
             _RESP_HDR.pack(req_id, 1),
-            str(e).encode("utf-8", "replace"),
+            encode_remote_error(e).encode("utf-8", "replace"),
         ]
     return parts
 
@@ -284,6 +291,8 @@ class TcpChannel(Channel):
         concatenated into an intermediate buffer (``parts`` is a
         sequence of buffer-likes).  ``transportScatterGather=off``
         falls back to the legacy concat+sendall wire path."""
+        if FAULTS.enabled:
+            FAULTS.check("send")
         views = [v for v in map(_as_view, parts) if v.nbytes]
         length = sum(v.nbytes for v in views)
         hdr = _HDR.pack(opcode, length)
@@ -377,6 +386,10 @@ class TcpChannel(Channel):
         try:
             while True:
                 opcode, length = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
+                if FAULTS.enabled:
+                    # a recv fault models a desynced/cut stream: the
+                    # channel dies, outstanding reads fail structured
+                    FAULTS.check("recv")
                 if length > _MAX_FRAME:
                     raise TransportError(f"oversized frame: {length}B")
                 if wiredbg.wire_debug_enabled():
@@ -443,6 +456,8 @@ class TcpChannel(Channel):
         destination row via ``recv_into`` — reassembly happens in the
         kernel copy, with no intermediate frame buffer; plain reads
         land in one pooled buffer and complete as zero-copy slices."""
+        if FAULTS.enabled:
+            FAULTS.check("read_resp")
         if length < _RESP_HDR.size:
             raise TransportError(f"short read response: {length}B")
         req_id, status = _RESP_HDR.unpack(
@@ -464,7 +479,7 @@ class TcpChannel(Channel):
                 reason = _recv_exact(self._sock, body).decode(
                     "utf-8", "replace"
                 )
-                err: BaseException = TransportError(reason)
+                err: BaseException = decode_remote_error(reason)
             elif dest is None:
                 payload = self._recv_payload(body)
                 blocks, off, err = [], 0, None
@@ -589,7 +604,18 @@ class TcpChannel(Channel):
         try:
             self._send_msg(OP_READ_RESP, parts)
         except BaseException:
-            logger.warning("read response to %s failed", self.peer)
+            # a response the requester will never see — and possibly a
+            # half-written frame desyncing the byte stream.  The
+            # channel must die (the wire blast-radius contract): the
+            # peer's read loop sees the cut and fails its outstanding
+            # reads promptly, which is exactly the signal the in-task
+            # retry plane recovers from.  Swallowing this would strand
+            # the requester's fetch forever on a healthy-looking
+            # socket.
+            logger.warning(
+                "read response to %s failed — closing channel", self.peer
+            )
+            self.stop()
 
     def reply_channel(self) -> Channel:
         """Replies ride the same socket."""
@@ -678,8 +704,19 @@ class TcpNetwork:
         while True:
             try:
                 sock, addr = srv.accept()
-            except OSError:
-                return  # listener closed
+            except OSError as e:
+                if srv.fileno() == -1 or e.errno in (
+                    errno.EBADF, errno.EINVAL, errno.ENOTSOCK
+                ):
+                    return  # listener closed
+                # transient: ECONNABORTED (peer reset before accept)
+                # or fd/buffer pressure — exiting here would orphan
+                # the still-open listener and strand every future
+                # connect in its backlog.  Back off briefly so fd
+                # exhaustion does not become a hot spin.
+                counter("transport_accept_transient_errors_total").inc()
+                time.sleep(0.01)
+                continue
             try:
                 magic, type_idx, src_port, version = _HELLO.unpack(
                     _recv_exact(sock, _HELLO.size)
@@ -720,9 +757,16 @@ class TcpNetwork:
                 channel_type: ChannelType) -> Channel:
         timeout_s = src.conf.connect_timeout_ms / 1000.0
         counter("transport_connect_attempts_total", transport="tcp").inc()
+        if FAULTS.enabled:
+            FAULTS.check("connect")
         try:
             sock = socket.create_connection(peer, timeout=timeout_s)
             sock.settimeout(timeout_s)
+            if FAULTS.enabled and FAULTS.fires("hello"):
+                # a handshake fault dies between socket and ack — the
+                # half-open socket closes through the OSError path
+                sock.close()
+                raise OSError("injected fault at point 'hello'")
             sock.sendall(_HELLO.pack(
                 _MAGIC, _TYPE_BY_INDEX.index(channel_type),
                 src.address[1], WIRE_VERSION,

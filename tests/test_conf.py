@@ -32,7 +32,12 @@ def test_defaults():
     assert c.max_agg_prealloc == 0
     assert c.collect_shuffle_reader_stats is False
     assert c.partition_location_fetch_timeout_ms == 120_000
-    assert c.max_connection_attempts == 5
+    assert c.connect_retries == 5
+    assert c.connect_backoff_ms == 50
+    assert c.fetch_retry_count == 3
+    assert c.fetch_retry_wait_ms == 50
+    assert c.fetch_retry_max_ms == 10_000
+    assert c.fault_inject == ""
 
 
 def test_clamping_and_fallback():
@@ -44,6 +49,22 @@ def test_clamping_and_fallback():
     assert c.recv_queue_depth == 256
     assert c.send_queue_depth == 4096
     assert c.shuffle_read_block_size == 16 << 10
+
+
+def test_connect_retry_conf_fallbacks():
+    # new name wins; the old one still works (two spellings: the tpu
+    # key feeds the default chain, the rdma key rides LEGACY_RENAMES)
+    c = TpuShuffleConf({"spark.shuffle.tpu.connectRetries": "9"})
+    assert c.connect_retries == 9
+    c = TpuShuffleConf({"spark.shuffle.tpu.maxConnectionAttempts": "7"})
+    assert c.connect_retries == 7
+    c = TpuShuffleConf({"spark.shuffle.rdma.maxConnectionAttempts": "4"})
+    assert c.connect_retries == 4
+    c = TpuShuffleConf({
+        "spark.shuffle.tpu.connectRetries": "9",
+        "spark.shuffle.tpu.maxConnectionAttempts": "7",
+    })
+    assert c.connect_retries == 9
 
 
 def test_set_and_get():

@@ -479,6 +479,55 @@ def render_skew(counters: list, hists: list) -> list:
     return out
 
 
+def render_recovery(counters: list) -> list:
+    """Recovery census (faults/ + the reader retry plane): injected
+    faults per point (conf ``faultInject``), in-task fetch retries and
+    the backoff time they spent, terminal fetch failures, stripe
+    demotions and per-peer breaker trips.  A fault-free run with
+    retries enabled renders nothing — every counter here moves only
+    when something actually failed."""
+    injected: dict = {}
+    trips_by_peer: dict = {}
+    vals: dict = {}
+    for c in counters:
+        labels = c.get("labels") or {}
+        if c["name"] == "fault_injected_total" and "point" in labels:
+            injected[labels["point"]] = (
+                injected.get(labels["point"], 0.0) + c["value"])
+        elif c["name"] == "transport_breaker_trips_total":
+            peer = labels.get("peer", "?")
+            trips_by_peer[peer] = trips_by_peer.get(peer, 0.0) + c["value"]
+        elif not labels:
+            vals[c["name"]] = c["value"]
+    retries = vals.get("shuffle_fetch_retries_total", 0)
+    failures = vals.get("shuffle_fetch_failures_total", 0)
+    demotions = vals.get("transport_stripe_demotions_total", 0)
+    if not injected and not trips_by_peer and not retries \
+            and not failures and not demotions \
+            and not vals.get("transport_accept_transient_errors_total"):
+        return []
+    out = ["recovery (faults/ + in-task fetch retry)"]
+    if injected:
+        total = sum(injected.values())
+        per_point = "  ".join(
+            f"{p}={n:,.0f}" for p, n in sorted(injected.items()))
+        out.append(f"  faults injected: {total:,.0f}  ({per_point})")
+    out.append(
+        f"  fetch retries={retries:,.0f}  "
+        f"backoff={vals.get('shuffle_fetch_retry_ms_total', 0):,.0f}ms  "
+        f"terminal failures={failures:,.0f}"
+    )
+    out.append(f"  stripe demotions={demotions:,.0f}")
+    aborted = vals.get("transport_accept_transient_errors_total", 0)
+    if aborted:
+        out.append(f"  transient accept errors survived={aborted:,.0f}")
+    if trips_by_peer:
+        per_peer = "  ".join(
+            f"{p}={n:,.0f}" for p, n in sorted(trips_by_peer.items()))
+        out.append(f"  breaker trips: {per_peer}")
+    return out
+
+
 def render_wire_health(counters: list) -> list:
     """Wire-health census (utils/wiredbg.py, conf wireDebug): one row
     per engine/opcode pair — frames validated vs rejected — plus the
@@ -547,6 +596,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_tier(counters, gauges))
     lines.extend(render_resources(counters, gauges))
     lines.extend(render_skew(counters, hists))
+    lines.extend(render_recovery(counters))
     lines.extend(render_wire_health(counters))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
