@@ -55,6 +55,7 @@ bench-smoke:
 	python benchmarks/bench_qos.py
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_skew.py
+	python tools/bench_gate.py
 	$(MAKE) chaos
 
 # the seeded chaos soak alone (faults/, conf faultInject): the full

@@ -32,7 +32,14 @@ from sparkrdma_tpu.api import TpuShuffleContext
 # code paths, minutes → seconds, JSON written to /tmp instead of the
 # committed BENCH_*.json results
 SMOKE = bool(os.environ.get("BENCH_SMOKE"))
-SMOKE_DIR = "/tmp" if SMOKE else None
+
+# SPARKRDMA_TPU_BENCH_TRACE=1: run with the tracer and flight recorder
+# held open and a fresh root span on every fetch — the trace-ON leg of
+# the observability overhead A/B.  Traced numbers are a measurement of
+# the tracer, not of the transport, so they land in /tmp and never
+# overwrite the committed BENCH_*.json results.
+TRACE = bool(os.environ.get("SPARKRDMA_TPU_BENCH_TRACE"))
+SMOKE_DIR = "/tmp" if (SMOKE or TRACE) else None
 
 N_RECORDS = 30_000 if SMOKE else 300_000
 N_KEYS = 1024
@@ -96,6 +103,15 @@ def _teardown_config(cfg):
     cfg["net"].unregister(cfg["b"])
 
 
+def _trace_ctx():
+    """Fresh per-fetch root span (None when the A/B runs trace-off)."""
+    if not TRACE:
+        return None
+    from sparkrdma_tpu.obs import TRACING
+
+    return TRACING.start()
+
+
 def _read_once(cfg, size, timeout=120):
     from sparkrdma_tpu.transport.channel import FnCompletionListener
     from sparkrdma_tpu.utils.types import BlockLocation
@@ -108,6 +124,7 @@ def _read_once(cfg, size, timeout=120):
             lambda blocks: done.set(),
             lambda e: (err.append(e), done.set()),
         ),
+        ctx=_trace_ctx(),
     )
     if not done.wait(timeout):
         raise RuntimeError("fetch hung")
@@ -160,6 +177,7 @@ def _fetch_throughput_windowed(cfg, size, window=4):
             FnCompletionListener(
                 lambda blocks: settle(), lambda e: settle(e)
             ),
+            ctx=_trace_ctx(),
         )
     if not done.wait(180):
         raise RuntimeError("windowed fetch hung")
@@ -731,6 +749,14 @@ def decode_pipeline_sweep():
 
 
 def main():
+    if TRACE:
+        # hold both planes open for the whole run: every read carries a
+        # live span and the recorder rings absorb the event traffic,
+        # the worst-case (sampleRate=1.0) tracing cost
+        from sparkrdma_tpu.obs import RECORDER, TRACING
+
+        TRACING.retain(1.0)
+        RECORDER.retain(ring_size=4096)
     maybe_spoof_cpu()
     rng = np.random.default_rng(1)
     records = [(int(k), 1) for k in rng.integers(0, N_KEYS, N_RECORDS)]

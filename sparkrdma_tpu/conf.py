@@ -122,6 +122,17 @@ class TpuShuffleConf:
         except ValueError:
             return default_ms
 
+    def _float_in_range(self, key: str, default: float, lo: float,
+                        hi: float) -> float:
+        raw = self.get(key)
+        if raw is None:
+            return default
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            return default
+        return max(lo, min(hi, v))
+
     # -- transport / control-plane queues (reference: recv/sendQueueDepth) --
     @property
     def recv_queue_depth(self) -> int:
@@ -821,6 +832,50 @@ class TpuShuffleConf:
         counters into the Tracer.counter() stream (Perfetto counter
         tracks) at shuffle unregister and manager stop."""
         return self._bool("metricsTraceBridge", True)
+
+    @property
+    def trace_enabled(self) -> bool:
+        """Distributed fetch tracing (obs/): readers mint a trace
+        context per reduce task and stamp every fetch-status RPC and
+        read request with it (the v2 wire tail), so serve-side events
+        on remote peers join the requester's trace.  Off by default —
+        every instrumentation site then short-circuits on one
+        attribute read, and all wire frames stay byte-identical to the
+        trace-off encoding."""
+        return self._bool("traceEnabled", False)
+
+    @property
+    def trace_sample_rate(self) -> float:
+        """Fraction of reduce tasks that mint a trace context when
+        ``traceEnabled`` (1.0 = every task).  Sampled-out tasks pay
+        the same near-zero cost as tracing off."""
+        return self._float_in_range("traceSampleRate", 1.0, 0.0, 1.0)
+
+    @property
+    def flight_recorder(self) -> bool:
+        """Flight recorder (obs/recorder.py): per-plane bounded rings
+        of structured events (transport, reader, decode, tier, qos,
+        faults), dumped to JSON automatically on FetchFailed / breaker
+        trip / ledger leak / wire reject and on demand via the metrics
+        server's ``/flightrecorder`` endpoint.  On by default — the
+        black box should be recording when the incident happens; each
+        event costs one deque append under an uncontended per-plane
+        lock."""
+        return self._bool("flightRecorder", True)
+
+    @property
+    def flight_recorder_ring_size(self) -> int:
+        """Events retained per plane ring (oldest drop first, drops
+        counted in ``obs_events_dropped_total{plane=}``)."""
+        return self._int_in_range("flightRecorderRingSize", 4096, 64, 1 << 20)
+
+    @property
+    def flight_recorder_dump_path(self) -> str:
+        """Directory for flight-recorder dumps (pid- and sequence-
+        tagged filenames, so one fleet's processes never collide).
+        Empty (the default) disables automatic dumps — the rings still
+        record and ``/flightrecorder`` still serves them."""
+        return str(self.get("flightRecorderDumpPath", ""))
 
     @property
     def collect_shuffle_reader_stats(self) -> bool:

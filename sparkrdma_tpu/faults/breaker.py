@@ -22,6 +22,7 @@ import time
 from typing import Callable, Dict, Tuple
 
 from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 
 _CLOSED, _OPEN, _HALF_OPEN = 0, 1, 2
@@ -51,15 +52,23 @@ class CircuitBreaker:
         with its probe outstanding refuses further fetches."""
         if self.failures <= 0:
             return True
+        probe = False
         with self._lock:
             if self._state == _CLOSED:
                 return True
             if self._state == _OPEN:
                 if self._clock() - self._opened_at >= self.reset_s:
                     self._state = _HALF_OPEN
-                    return True  # the probe
-                return False
-            return False  # HALF_OPEN: probe already out
+                    probe = True
+                else:
+                    return False
+            elif self._state == _HALF_OPEN:
+                return False  # probe already out
+        if probe:
+            if RECORDER.enabled:
+                fr_event("faults", "breaker_probe", peer=self.name)
+            return True
+        return False
 
     def record_success(self) -> None:
         with self._lock:
@@ -72,6 +81,7 @@ class CircuitBreaker:
         tripped = False
         with self._lock:
             self._strikes += 1
+            strikes = self._strikes
             if self._state == _HALF_OPEN:
                 # the probe failed: straight back to OPEN, clock restarts
                 self._state = _OPEN
@@ -83,6 +93,15 @@ class CircuitBreaker:
                 tripped = True
         if tripped:
             counter("transport_breaker_trips_total", peer=self.name).inc()
+            if RECORDER.enabled:
+                fr_event(
+                    "faults", "breaker_trip",
+                    peer=self.name, strikes=strikes,
+                )
+                # a tripped breaker means a peer just burned its whole
+                # failure budget — snapshot the lead-up while the rings
+                # still hold it
+                RECORDER.auto_dump("breaker_trip")
 
     @property
     def state(self) -> str:

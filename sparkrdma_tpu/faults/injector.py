@@ -38,6 +38,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.obs import RECORDER, fr_event
 
 # NOTE: transport.channel is imported at the BOTTOM of this module.
 # The transport package's __init__ imports engines that import FAULTS
@@ -210,6 +211,8 @@ class FaultInjector:
             hit = c.decide() if c is not None else False
         if hit:
             counter("fault_injected_total", point=point).inc()
+            if RECORDER.enabled:
+                fr_event("faults", "fault_fired", point=point, form="fires")
         return hit
 
     def check(self, point: str) -> None:
@@ -222,6 +225,11 @@ class FaultInjector:
         if not hit:
             return
         counter("fault_injected_total", point=point).inc()
+        if RECORDER.enabled:
+            fr_event(
+                "faults", "fault_fired", point=point,
+                form="delay" if ms is not None else "raise",
+            )
         if ms is not None:
             time.sleep(ms / 1000.0)
             return

@@ -47,6 +47,7 @@ import numpy as np
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.memory.staging import alloc_row_gc
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport.channel import TransportError
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 
@@ -436,6 +437,8 @@ class TieredBlockStore:
         n = 0
         for blk in entry.blocks_overlapping(offset, offset + length):
             n += self._warm_block(entry, blk)
+        if n and RECORDER.enabled:
+            fr_event("tier", "warm", mkey=mkey, blocks=n)
         return n
 
     def would_warm(self, mkey: int) -> bool:
@@ -643,6 +646,8 @@ class TieredBlockStore:
         self._m_hot.dec(blk.length)
         self._m_demotes.inc()
         self._m_demote_bytes.inc(blk.length)
+        if RECORDER.enabled:
+            fr_event("tier", "demote", bytes=blk.length)
 
     def _finish_load(self, entry: TierEntry, blk: _Block,
                      row: Optional[np.ndarray]) -> None:
@@ -678,12 +683,19 @@ class TieredBlockStore:
         row.flags.writeable = False
         self._m_promotes.inc()
         self._m_promote_bytes.inc(blk.length)
+        if RECORDER.enabled:
+            fr_event(
+                "tier", "promote",
+                bytes=blk.length, prefetched=1 if blk.prefetched else 0,
+            )
         return row
 
     def _disk_read(self, entry: TierEntry, offset: int, length: int):
         """Cold-tier read (NO lock held — concheck DISK_BLOCKING):
         O_DIRECT pread for large spans, the lazily created mmap view
         otherwise/fallback."""
+        if RECORDER.enabled:
+            fr_event("tier", "disk_read", bytes=length)
         if FAULTS.enabled:
             # models a failed/slow spill read: surfaces through the
             # same TransportError path as the freed-entry race below,

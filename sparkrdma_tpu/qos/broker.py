@@ -37,6 +37,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.qos.registry import BULK, INTERACTIVE, Tenant
 
 _EMPTY = object()
@@ -218,6 +219,12 @@ class WeightedCreditBroker:
                     waited_t0 = time.monotonic()
                     if self._wait_counter is not None:
                         self._wait_counter.inc()
+                    if RECORDER.enabled:
+                        fr_event(
+                            "qos", "credit_block",
+                            pool=self.name, bytes=cost,
+                            tenant=tenant.name if tenant else "",
+                        )
                 self._cv.wait(timeout=0.5)
                 self._grant_locked()  # periodic re-scan drives aging
             self._waiters.remove(w)
@@ -246,7 +253,14 @@ class WeightedCreditBroker:
             self._waiters.append(w)
             self._grant_locked()
             self._waiters.remove(w)
-            return w.granted
+            granted = w.granted
+        if not granted and RECORDER.enabled:
+            fr_event(
+                "qos", "credit_block",
+                pool=self.name, bytes=cost,
+                tenant=tenant.name if tenant else "",
+            )
+        return granted
 
     def release(self, cost: int, tenant: Optional[Tenant] = None) -> None:
         with self._cv:

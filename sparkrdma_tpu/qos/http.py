@@ -11,7 +11,11 @@ over HTTP for the node's lifetime:
 - ``GET /metrics.json``  — the registry snapshot as JSON (what
   ``tools/metrics_report.py`` renders),
 - ``GET /tenants``       — the QoS tenant registry snapshot (weights,
-  priorities, quotas, degraded flags).
+  priorities, quotas, degraded flags),
+- ``GET /health``        — liveness probe: 200 with uptime/pid JSON,
+- ``GET /flightrecorder`` — on-demand flight-recorder snapshot
+  (obs/recorder.py), the same JSON shape the automatic failure dumps
+  write.
 
 One daemon thread (``metrics-http-<port>``) runs a plain
 ``http.server`` loop — scrapes serialize, which is exactly right for
@@ -25,12 +29,15 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Optional, Tuple
 
 from sparkrdma_tpu.metrics import get_registry
 from sparkrdma_tpu.metrics.export import to_prometheus
+from sparkrdma_tpu.obs import RECORDER
 from sparkrdma_tpu.qos.registry import get_qos
 
 logger = logging.getLogger(__name__)
@@ -57,6 +64,22 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
                     get_qos().snapshot(), indent=1
                 ).encode("utf-8")
                 ctype = "application/json"
+            elif path == "/health":
+                started = getattr(self.server, "started_at", None)
+                body = json.dumps({
+                    "status": "ok",
+                    "pid": os.getpid(),
+                    "uptime_s": round(
+                        time.time() - started, 3
+                    ) if started is not None else None,
+                }).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/flightrecorder":
+                snap = RECORDER.snapshot() if RECORDER.enabled else {
+                    "enabled": False, "planes": {},
+                }
+                body = json.dumps(snap).encode("utf-8")
+                ctype = "application/json"
             else:
                 self.send_error(404, "unknown path")
                 return
@@ -82,6 +105,7 @@ class MetricsHttpServer:
 
     def __init__(self, port: int, host: str = "127.0.0.1"):
         self._server = HTTPServer((host, port), _ScrapeHandler)
+        self._server.started_at = time.time()  # /health uptime anchor
         self.address: Tuple[str, int] = self._server.server_address[:2]
         self._thread: Optional[threading.Thread] = threading.Thread(
             target=self._server.serve_forever,

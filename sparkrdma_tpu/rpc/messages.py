@@ -147,15 +147,34 @@ class WireField:
       ``bytes_rest``  raw bytes to end of payload (last field only)
       ``custom``      hand-written codec section; ``code`` documents the
                       layout and wirecheck audits the methods (WC01/05)
+
+    ``since`` > 1 marks an OPTIONAL-TAIL field added at that wire
+    generation: scalar fields after every base field, defaulting to 0.
+    The derived codec emits the tail only when the negotiated wire
+    version allows it AND some tail value is non-zero — so a message
+    with all-default tail values encodes byte-identically to its
+    pre-tail generation (golden-frame pinned), and v1 peers never see
+    bytes they would reject as trailing garbage.  Decode accepts the
+    tail present or absent (absent → defaults), which is unambiguous
+    because the last base field of any tailed schema is
+    self-delimiting (count-prefixed).
     """
 
-    __slots__ = ("name", "kind", "code", "st", "n_values", "max_len")
+    __slots__ = ("name", "kind", "code", "st", "n_values", "max_len",
+                 "since")
 
-    def __init__(self, name: str, kind: str, code=None, max_len=None):
+    def __init__(self, name: str, kind: str, code=None, max_len=None,
+                 since: int = 1):
         self.name = name
         self.kind = kind
         self.code = code
         self.max_len = max_len
+        self.since = since
+        if since > 1 and kind != "scalar":
+            raise ValueError(
+                f"wire field {name!r}: optional-tail fields must be "
+                f"scalars (got {kind!r})"
+            )
         self.st = None
         self.n_values = 0
         if kind in ("scalar", "bool") or (
@@ -175,8 +194,8 @@ class WireField:
         return cls(name, "scalar", "<i")
 
     @classmethod
-    def scalar(cls, name, code):
-        return cls(name, "scalar", code)
+    def scalar(cls, name, code, since: int = 1):
+        return cls(name, "scalar", code, since=since)
 
     @classmethod
     def bool_i32(cls, name):
@@ -212,6 +231,27 @@ F = WireField
 
 def _schema_is_derived(schema) -> bool:
     return all(f.kind != "custom" for f in schema)
+
+
+def _tail_fields(schema):
+    """The optional-tail fields (``since`` > 1), validated to sit after
+    every base field — a tail in the middle would be ambiguous."""
+    tail = tuple(f for f in schema if f.since > 1)
+    if tail and schema[-len(tail):] != tail:
+        raise ValueError("optional-tail fields must be last in schema")
+    return tail
+
+
+def _emit_tail(msg: "RpcMsg", tail, wire_version) -> bool:
+    """Whether to encode the optional tail: the negotiated generation
+    must allow it (None → current) and some value must be non-zero."""
+    if not tail:
+        return False
+    if wire_version is not None and any(
+        wire_version < f.since for f in tail
+    ):
+        return False
+    return any(getattr(msg, f.name) for f in tail)
 
 
 def _encode_field(buf: bytearray, f: WireField, v) -> None:
@@ -331,24 +371,35 @@ class RpcMsg:
     WIRE_SCHEMA: Tuple[WireField, ...] = ()
 
     # -- schema-derived codec ------------------------------------------------
-    def _payload(self) -> bytes:
+    def _payload(self, wire_version=None) -> bytes:
+        """Serialize per the schema.  ``wire_version`` pins the target
+        generation (None → current): optional-tail fields (``since`` >
+        1) are emitted only when the generation allows them and some
+        tail value is non-zero, keeping all-default encodings
+        byte-identical across generations."""
         schema = type(self).WIRE_SCHEMA
         if not _schema_is_derived(schema):  # pragma: no cover
             raise NotImplementedError(
                 f"{type(self).__name__} has custom wire sections and "
                 f"must hand-write _payload"
             )
+        tail = _emit_tail(self, _tail_fields(schema), wire_version)
         buf = bytearray()
         for f in schema:
+            if f.since > 1 and not tail:
+                continue
             _encode_field(buf, f, getattr(self, f.name))
         return bytes(buf)
 
-    def _payload_size(self) -> int:
+    def _payload_size(self, wire_version=None) -> int:
         """Cheap payload-size estimate used to decide splitting without
         serializing — derived from the schema field by field."""
+        schema = type(self).WIRE_SCHEMA
+        tail = _emit_tail(self, _tail_fields(schema), wire_version)
         return sum(
             _field_size(f, getattr(self, f.name))
-            for f in type(self).WIRE_SCHEMA
+            for f in schema
+            if f.since == 1 or tail
         )
 
     @classmethod
@@ -362,6 +413,11 @@ class RpcMsg:
         kwargs = {}
         off = 0
         for f in schema:
+            if f.since > 1 and off == len(view):
+                # optional tail absent (an older-generation or
+                # all-default frame): defaults apply
+                kwargs[f.name] = 0
+                continue
             kwargs[f.name], off = _decode_field(f, view, off)
         if off != len(view):
             raise WireFormatError(
@@ -378,17 +434,21 @@ class RpcMsg:
     def _frame(self, payload: bytes) -> bytes:
         return _HEADER.pack(HEADER_SIZE + len(payload), self.MSG_TYPE) + payload
 
-    def encode(self) -> bytes:
-        return self._frame(self._payload())
+    def encode(self, wire_version=None) -> bytes:
+        return self._frame(self._payload(wire_version))
 
-    def encode_segments(self, max_segment_size: int) -> List[bytes]:
-        """Encode into frames each ≤ max_segment_size bytes."""
+    def encode_segments(self, max_segment_size: int,
+                        wire_version=None) -> List[bytes]:
+        """Encode into frames each ≤ max_segment_size bytes.
+        ``wire_version`` pins the peer's negotiated generation (None →
+        current) so optional-tail fields stay off frames bound for
+        older peers."""
         max_payload = max_segment_size - HEADER_SIZE
         if max_payload <= 0:
             raise ValueError(f"segment size too small: {max_segment_size}")
-        size = self._payload_size()
+        size = self._payload_size(wire_version)
         if size <= max_payload:
-            return [self._frame(self._payload())]
+            return [self._frame(self._payload(wire_version))]
         parts = self._split(max_payload)
         if len(parts) == 1:
             raise ValueError(
@@ -397,7 +457,7 @@ class RpcMsg:
             )
         out: List[bytes] = []
         for p in parts:
-            psize = p._payload_size()
+            psize = p._payload_size(wire_version)
             if psize > max_payload:
                 # an atomic element (e.g. one id with a very long hostname,
                 # or a fixed header) alone exceeds the segment size
@@ -405,7 +465,7 @@ class RpcMsg:
                     f"{type(self).__name__} segment payload {psize}B still "
                     f"exceeds segment size {max_segment_size}B"
                 )
-            out.append(p._frame(p._payload()))
+            out.append(p._frame(p._payload(wire_version)))
         return out
 
 
@@ -586,6 +646,8 @@ class FetchMapStatusMsg(RpcMsg):
     block_ids: Tuple[Tuple[int, int], ...]  # (map_id, reduce_id) pairs
     total: int = -1  # blocks in the whole logical request; -1 → len(block_ids)
     index: int = 0   # offset of block_ids[0] within the logical request
+    trace_id: int = 0  # v2 optional tail: distributed trace correlation
+    span_id: int = 0
 
     MSG_TYPE = 4
     WIRE_SCHEMA = (
@@ -596,10 +658,12 @@ class FetchMapStatusMsg(RpcMsg):
         F.i32("total"),
         F.i32("index"),
         F.list("block_ids", "<ii"),
+        F.scalar("trace_id", "<Q", since=2),
+        F.scalar("span_id", "<Q", since=2),
     )
 
     def __init__(self, requester, host, shuffle_id, callback_id, block_ids,
-                 total=-1, index=0):
+                 total=-1, index=0, trace_id=0, span_id=0):
         object.__setattr__(self, "requester", requester)
         object.__setattr__(self, "host", host)
         object.__setattr__(self, "shuffle_id", shuffle_id)
@@ -607,6 +671,8 @@ class FetchMapStatusMsg(RpcMsg):
         object.__setattr__(self, "block_ids", tuple(tuple(b) for b in block_ids))
         object.__setattr__(self, "total", len(self.block_ids) if total < 0 else total)
         object.__setattr__(self, "index", index)
+        object.__setattr__(self, "trace_id", trace_id)
+        object.__setattr__(self, "span_id", span_id)
 
     def _split(self, max_payload: int) -> Sequence["FetchMapStatusMsg"]:
         fixed = self._payload_size() - _PAIR_II.size * len(self.block_ids)
@@ -618,6 +684,7 @@ class FetchMapStatusMsg(RpcMsg):
                     self.requester, self.host, self.shuffle_id, self.callback_id,
                     self.block_ids[start : start + per_seg],
                     total=self.total, index=self.index + start,
+                    trace_id=self.trace_id, span_id=self.span_id,
                 )
             )
         return parts
@@ -766,23 +833,30 @@ class PrefetchHintMsg(RpcMsg):
 
     shuffle_id: int
     locations: Tuple[BlockLocation, ...]
+    trace_id: int = 0  # v2 optional tail: distributed trace correlation
+    span_id: int = 0
 
     MSG_TYPE = 11
     WIRE_SCHEMA = (
         F.i32("shuffle_id"),
         F.list("locations", "loc"),
+        F.scalar("trace_id", "<Q", since=2),
+        F.scalar("span_id", "<Q", since=2),
     )
 
-    def __init__(self, shuffle_id: int, locations):
+    def __init__(self, shuffle_id: int, locations, trace_id=0, span_id=0):
         object.__setattr__(self, "shuffle_id", shuffle_id)
         object.__setattr__(self, "locations", tuple(locations))
+        object.__setattr__(self, "trace_id", trace_id)
+        object.__setattr__(self, "span_id", span_id)
 
     def _split(self, max_payload: int) -> Sequence["PrefetchHintMsg"]:
         fixed = self._payload_size() - LOCATION_ENTRY_SIZE * len(self.locations)
         per_seg = max(1, (max_payload - fixed) // LOCATION_ENTRY_SIZE)
         return [
             PrefetchHintMsg(
-                self.shuffle_id, self.locations[i : i + per_seg]
+                self.shuffle_id, self.locations[i : i + per_seg],
+                trace_id=self.trace_id, span_id=self.span_id,
             )
             for i in range(0, len(self.locations), per_seg)
         ]
@@ -861,7 +935,7 @@ class ExchangePlanMsg(RpcMsg):
                 f"lengths, {len(self.manifest)} manifest rows"
             )
 
-    def _payload(self) -> bytes:
+    def _payload(self, wire_version=None) -> bytes:
         buf = bytearray(_PAIR_II.pack(self.callback_id, len(self.hosts)))
         for h in self.hosts:
             h.write(buf)
@@ -878,7 +952,7 @@ class ExchangePlanMsg(RpcMsg):
             buf += _I32.pack(m)
         return bytes(buf)
 
-    def _payload_size(self) -> int:
+    def _payload_size(self, wire_version=None) -> int:
         return (
             _PAIR_II.size
             + sum(h.serialized_length() for h in self.hosts)

@@ -46,6 +46,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.qos import CreditLedger
 from sparkrdma_tpu.utils.dbglock import dbg_condition
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
@@ -107,6 +108,8 @@ class DecodeTicket:
                 steal = False
         if steal:
             pool._m_steals.inc()
+            if RECORDER.enabled:
+                fr_event("decode", "ticket_steal", bytes=self.nbytes)
             self._run_inline()
         elif self._event.is_set():
             pool._m_ahead_hits.inc()
@@ -382,6 +385,10 @@ class DecodePool:
                     if not waited:
                         waited = True
                         self._m_credit_waits.inc()
+                        if RECORDER.enabled:
+                            fr_event(
+                                "decode", "credit_wait", bytes=cost,
+                            )
                         if tenant is not None:
                             self._waiting_add(tenant)
                     self._cv.wait(timeout=0.5)
@@ -414,7 +421,14 @@ class DecodePool:
                 item._result = item._fn(item._data)
             except BaseException as e:
                 item._error = e
-            self._observe(item.nbytes, time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self._observe(item.nbytes, dt)
+            if RECORDER.enabled:
+                fr_event(
+                    "decode", "decode_done",
+                    bytes=item.nbytes, us=int(dt * 1e6),
+                    err=1 if item._error is not None else 0,
+                )
             with self._cv:
                 item._state = _DONE
                 if item._stream._closed or item._abandoned:

@@ -37,6 +37,7 @@ from sparkrdma_tpu.metrics import (
     write_json_snapshot,
     write_prometheus,
 )
+from sparkrdma_tpu.obs import RECORDER, TRACING
 from sparkrdma_tpu.qos import WeightedCreditBroker, get_qos
 from sparkrdma_tpu.skew import get_skew
 from sparkrdma_tpu.utils.dbglock import dbg_lock, dbg_rlock
@@ -369,6 +370,21 @@ class TpuShuffleManager:
 
         if conf.trace:
             get_tracer().enabled = True
+        # observability plane (obs/): the flight recorder's per-plane
+        # event rings and the distributed-trace context generator.
+        # Owner-counted like the fault injector — in-process clusters
+        # retain per manager, and only the LAST stop() turns them off.
+        self._obs_retained = False
+        self._tracing_retained = False
+        if conf.flight_recorder:
+            RECORDER.retain(
+                ring_size=conf.flight_recorder_ring_size,
+                dump_dir=conf.flight_recorder_dump_path,
+            )
+            self._obs_retained = True
+        if conf.trace_enabled:
+            TRACING.retain(conf.trace_sample_rate)
+            self._tracing_retained = True
         # persistent per-device HBM arena — set when a CollectiveNetwork
         # attaches this executor to a mesh device
         self.device_arena = None
@@ -560,7 +576,13 @@ class TpuShuffleManager:
     def _send_msg(self, channel: Channel, msg: RpcMsg,
                   on_failure: Optional[Callable] = None
                   ) -> None:
-        frames = msg.encode_segments(self.conf.recv_wr_size)
+        # pin the frames to the channel's negotiated wire generation so
+        # v2-only tail fields stay off frames bound for v1 peers
+        # (wire_version 0 = unversioned/in-process = current)
+        frames = msg.encode_segments(
+            self.conf.recv_wr_size,
+            wire_version=channel.wire_version or None,
+        )
         channel.send_rpc(
             frames,
             FnCompletionListener(on_failure=on_failure or (
@@ -2043,6 +2065,17 @@ class TpuShuffleManager:
                     )
                 tracer.enabled = False
                 tracer.clear()
+        if self._obs_retained:
+            self._obs_retained = False
+            if self.conf.flight_recorder_dump_path:
+                # final black-box snapshot before the rings go away —
+                # this is how each fleet process leaves its dump for
+                # the cross-process merge (obs/collect.py)
+                RECORDER.dump("manager_stop")
+            RECORDER.release()
+        if self._tracing_retained:
+            self._tracing_retained = False
+            TRACING.release()
         logger.info("staging pool at stop: %s", self.staging_pool.stats())
         logger.info("tier store at stop: %s", self.tier_store.stats())
         if self.metrics_http is not None:

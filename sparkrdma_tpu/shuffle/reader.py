@@ -29,6 +29,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.faults.retry import RetryPolicy, is_transient
 from sparkrdma_tpu.metrics import counter, histogram
+from sparkrdma_tpu.obs import RECORDER, TRACING, fr_event
 from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.skew import is_split_marker
@@ -221,6 +222,12 @@ class ShuffleReader:
         self._m_local_read = histogram("shuffle_local_read_ms")
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
         self._m_merge_fanin = histogram("skew_merge_fanin")
+        # distributed tracing (obs/): one root context per reduce task;
+        # each issued fetch gets a child span that rides the read
+        # request's v2 wire tail so serve-side events join this trace.
+        # None when tracing is off or this task was sampled out — every
+        # site below is a cheap ``is not None`` / RECORDER.enabled gate.
+        self._trace_ctx = TRACING.start()
 
     # -- fetch machinery ----------------------------------------------------
     def _start_remote_fetches(self) -> Iterator:
@@ -331,9 +338,12 @@ class ShuffleReader:
             on_locations, on_status_failed
         )
         self._callback_ids.append(cb_id)
+        ctx = self._trace_ctx
         msg = FetchMapStatusMsg(
             self.manager.local_smid, host, self.handle.shuffle_id,
             cb_id, pairs,
+            trace_id=ctx.trace_id if ctx is not None else 0,
+            span_id=ctx.span_id if ctx is not None else 0,
         )
         timer.start()
         try:
@@ -474,6 +484,15 @@ class ShuffleReader:
             self._outstanding_blocks += nonempty
             self._pending.extend(new_fetches)
             self._awaiting_hosts -= 1
+        if RECORDER.enabled:
+            ctx = self._trace_ctx
+            for pf in new_fetches:
+                fr_event(
+                    "reader", "fetch_enqueue",
+                    trace_id=ctx.trace_id if ctx is not None else 0,
+                    host=pf.host.host, blocks=len(pf.locations),
+                    bytes=pf.total_bytes,
+                )
         # announce the head of this host's fetch plan before the first
         # read is even issued — the responder's tier warms those blocks
         # off disk while the RPCs are still in flight
@@ -586,6 +605,18 @@ class ShuffleReader:
         # serializing behind it
         self._send_hint(fetch.host)
         t0 = time.monotonic()
+        # per-fetch child span: carried on the read request's v2 wire
+        # tail so the serving peer's events join this reader's trace
+        root = self._trace_ctx
+        ctx = root.child() if root is not None else None
+        if RECORDER.enabled:
+            fr_event(
+                "reader", "fetch_issue",
+                trace_id=ctx.trace_id if ctx is not None else 0,
+                span_id=ctx.span_id if ctx is not None else 0,
+                host=fetch.host.host, bytes=fetch.total_bytes,
+                attempt=fetch.attempts,
+            )
         progressed = [0]
         settled = [False]
         done = [False]
@@ -600,6 +631,13 @@ class ShuffleReader:
         )
 
         def on_progress(n):
+            if RECORDER.enabled:
+                fr_event(
+                    "transport", "stripe_land",
+                    trace_id=ctx.trace_id if ctx is not None else 0,
+                    span_id=ctx.span_id if ctx is not None else 0,
+                    bytes=n,
+                )
             # stripe-granular window accounting: each landed stripe (or
             # small block) frees its bytes from the in-flight window
             # IMMEDIATELY, so the next pending fetch can issue while
@@ -666,6 +704,14 @@ class ShuffleReader:
                 "shuffle.fetch.complete", host=fetch.host.host,
                 bytes=fetch.total_bytes, latency_ms=round(latency, 2),
             )
+            if RECORDER.enabled:
+                fr_event(
+                    "reader", "fetch_land",
+                    trace_id=ctx.trace_id if ctx is not None else 0,
+                    span_id=ctx.span_id if ctx is not None else 0,
+                    host=fetch.host.host, bytes=fetch.total_bytes,
+                    us=int(latency * 1000),
+                )
             stream = self._decode_stream
             if stream is not None:
                 # decode-ahead: landed payloads go to the pool NOW,
@@ -719,6 +765,13 @@ class ShuffleReader:
                     "shuffle.fetch.retry", host=fetch.host.host,
                     attempt=fetch.attempts, delay_ms=round(delay_ms, 1),
                 )
+                if RECORDER.enabled:
+                    fr_event(
+                        "reader", "fetch_retry",
+                        trace_id=ctx.trace_id if ctx is not None else 0,
+                        host=fetch.host.host, attempt=fetch.attempts,
+                        delay_ms=int(delay_ms),
+                    )
                 tm = threading.Timer(
                     delay_ms / 1000.0, self._requeue, args=(fetch,)
                 )
@@ -767,12 +820,24 @@ class ShuffleReader:
                 FnCompletionListener(on_success, on_failure),
                 on_progress=on_progress,
                 tenant=self._tenant,
+                ctx=ctx,
             )
         except Exception as e:
             on_failure(e)
 
     def _fail(self, err: FetchFailedError) -> None:
         self._failed = err
+        if RECORDER.enabled:
+            root = self._trace_ctx
+            fr_event(
+                "reader", "fetch_fail",
+                trace_id=root.trace_id if root is not None else 0,
+                host=err.host, shuffle_id=err.shuffle_id,
+                reason=str(err)[:200],
+            )
+            # the first FetchFailed is exactly the moment the rings
+            # still hold the lead-up — dump before the stage unwinds
+            RECORDER.auto_dump("fetch_failed")
         self._results.put(_Result(error=err))
 
     def _requeue(self, fetch: _PendingFetch) -> None:
@@ -826,7 +891,15 @@ class ShuffleReader:
                         break
                 t0 = time.monotonic()
                 res = self._results.get()
-                self.metrics.fetch_wait_ms += (time.monotonic() - t0) * 1000
+                waited = (time.monotonic() - t0) * 1000
+                self.metrics.fetch_wait_ms += waited
+                if RECORDER.enabled:
+                    root = self._trace_ctx
+                    fr_event(
+                        "reader", "consume_wait",
+                        trace_id=root.trace_id if root is not None else 0,
+                        us=int(waited * 1000),
+                    )
                 if res.error is not None:
                     raise res.error
                 if not res.blocks:
@@ -908,8 +981,16 @@ class ShuffleReader:
         decode-wait half of the fetch-wait split."""
         t0 = time.monotonic()
         items, n = item.get()
-        self.metrics.decode_wait_ms += (time.monotonic() - t0) * 1000
+        waited = (time.monotonic() - t0) * 1000
+        self.metrics.decode_wait_ms += waited
         self.metrics.records_read += n
+        if RECORDER.enabled:
+            root = self._trace_ctx
+            fr_event(
+                "reader", "decode_wait",
+                trace_id=root.trace_id if root is not None else 0,
+                us=int(waited * 1000), records=n,
+            )
         return items
 
     def _iter_record_runs(self) -> Iterator[List[Record]]:

@@ -137,6 +137,11 @@ class Channel:
 
     def __init__(self, channel_type: ChannelType, send_queue_depth: int = 4096):
         self.channel_type = channel_type
+        #: negotiated wire generation — 0 means "unversioned" (in-process
+        #: channels, tests), treated as current; the TCP engines stamp
+        #: the handshake's accepted/negotiated version here, and senders
+        #: suppress v2-only bytes when it reads 1
+        self.wire_version = 0
         self._state = ChannelState.IDLE
         self._state_lock = dbg_lock("channel.state", 60)
         # send-WR budget: number of outstanding posted operations
@@ -191,6 +196,7 @@ class Channel:
         listener: CompletionListener,
         dest: Optional[Sequence] = None,
         on_progress: Optional[Callable[[int], None]] = None,
+        ctx=None,
     ) -> None:
         """Post a scatter read of remote blocks — the one-sided RDMA READ
         analog (reference: rdmaReadInQueue, RdmaChannel.java:441-474).
@@ -205,16 +211,20 @@ class Channel:
           themselves in place of fresh payloads.
         - ``on_progress(nbytes)``: fires as each location's payload
           arrives, before completion — stripe-granular in-flight-window
-          accounting for the reader."""
+          accounting for the reader.
+
+        ``ctx`` is an optional trace context (obs/) the engine carries
+        to the serving node — the v2 read-request tail — so serve-side
+        spans join the requester's trace; None costs nothing."""
         self._check_usable()
-        if dest is None and on_progress is None:
+        if dest is None and on_progress is None and ctx is None:
             self._enqueue(
                 lambda: self._post_read(list(locations), listener), listener
             )
         else:
             self._enqueue(
                 lambda: self._post_read(
-                    list(locations), listener, dest, on_progress
+                    list(locations), listener, dest, on_progress, ctx
                 ),
                 listener,
             )
@@ -341,6 +351,7 @@ class Channel:
         listener: CompletionListener,
         dest=None,
         on_progress=None,
+        ctx=None,
     ) -> None:
         raise NotImplementedError
 

@@ -76,6 +76,7 @@ import numpy as np
 
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, gauge, histogram
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport.channel import (
     Channel,
     ChannelState,
@@ -635,7 +636,8 @@ class _Handshake:
             if magic != wire._MAGIC \
                     or type_idx >= len(wire._TYPE_BY_INDEX):
                 raise TransportError(f"bad hello from {self._addr}")
-            if version != wire.WIRE_VERSION:
+            if not (wire.MIN_WIRE_VERSION <= version
+                    <= wire.WIRE_VERSION):
                 # structured rejection (NAK + both versions) — the 5
                 # bytes always fit a fresh socket's send buffer; the
                 # connector's error names both sides
@@ -647,7 +649,8 @@ class _Handshake:
                 raise TransportError(
                     f"protocol version mismatch from {self._addr}: "
                     f"hello spoke wire version {version}, this node "
-                    f"requires {wire.WIRE_VERSION}"
+                    f"accepts {wire.MIN_WIRE_VERSION}.."
+                    f"{wire.WIRE_VERSION}"
                 )
             # the 1-byte ack always fits a fresh socket's send buffer
             self._sock.send(b"\x01")
@@ -665,6 +668,7 @@ class _Handshake:
             wire._PAIRED.get(req_type, req_type), self._node, peer,
             self._sock, self._disp,
         )
+        ch.wire_version = version  # the hello's (accepted) generation
         ch._set_state(ChannelState.CONNECTED)
         # swap this socket's handler from the handshake to the channel
         self._done = True
@@ -1138,7 +1142,7 @@ class AsyncTcpChannel(Channel):
 
     def _post_read(self, locations: List[BlockLocation],
                    listener: CompletionListener,
-                   dest=None, on_progress=None) -> None:
+                   dest=None, on_progress=None, ctx=None) -> None:
         total = sum(loc.length for loc in locations)
         with self._reads_lock:
             req_id = self._next_req
@@ -1151,6 +1155,15 @@ class AsyncTcpChannel(Channel):
         payload = bytearray(wire._REQ_HDR.pack(req_id, len(locations)))
         for loc in locations:
             payload += wire._LOC.pack(loc.address, loc.length, loc.mkey)
+        if ctx is not None and self.wire_version != 1:
+            # optional v2 tail; suppressed on channels negotiated down
+            payload += wire._TRACE_CTX.pack(ctx[0], ctx[1])
+            if RECORDER.enabled:
+                fr_event(
+                    "transport", "wire_send",
+                    trace_id=ctx[0], span_id=ctx[1],
+                    locs=len(locations),
+                )
 
         def done(err):
             if err is not None:
@@ -1400,7 +1413,8 @@ class AsyncTcpChannel(Channel):
                 if length == 0:
                     self._arm_fixed(self._HDR, wire._HDR.size)
                     self.node.submit_serve(
-                        self._serve_read_async, (b"",), 0, deferred=True,
+                        self._serve_read_async, (b"", time.monotonic()),
+                        0, deferred=True,
                     )
                 else:
                     self._arm_fixed(self._REQ, length)
@@ -1431,7 +1445,7 @@ class AsyncTcpChannel(Channel):
             # reads may fault — never on the loop); its byte credits
             # are released by the response's send-completion event
             self.node.submit_serve(
-                self._serve_read_async, (payload,),
+                self._serve_read_async, (payload, time.monotonic()),
                 wire._req_cost(payload), deferred=True,
                 mkey=wire._req_mkey(payload),
             )
@@ -1938,24 +1952,43 @@ class AsyncTcpChannel(Channel):
         self._update_interest()
 
     # -- serving (serve-pool worker thread) ---------------------------------
-    def _serve_read_async(self, payload: bytes, release) -> None:
+    def _serve_read_async(self, payload: bytes, t_enq, release) -> None:
         """One-sided READ service, completion-driven: resolve the
         blocks here on the serve worker, post the response descriptor,
         return.  The serve's byte credits are released by the
         send-completion event — not by a worker blocked in sendall —
         so the credit budget still bounds resident serve memory while
         the worker moves on."""
+        ctx = None
+        if RECORDER.enabled:
+            # t_enq → now spans the serve queue AND credit wait
+            ctx = wire._req_trace(payload)
+            fr_event(
+                "transport", "serve_admit",
+                trace_id=ctx[0] if ctx else 0,
+                span_id=ctx[1] if ctx else 0,
+                wait_us=0 if t_enq is None
+                else int((time.monotonic() - t_enq) * 1e6),
+                bytes=wire._req_cost(payload),
+            )
         parts = wire.build_read_response_parts(
             self.node, payload, self.peer
         )
         if parts is None:
             release()
             return
+        t0 = time.monotonic()
 
         def sent(err):
             release()
             if err is not None:
                 logger.warning("read response to %s failed", self.peer)
+            elif ctx is not None and RECORDER.enabled:
+                fr_event(
+                    "transport", "serve_send",
+                    trace_id=ctx[0], span_id=ctx[1],
+                    us=int((time.monotonic() - t0) * 1e6),
+                )
 
         # drain=True: this serve worker finishes the send itself
         # (blocking-sendall shape, no loop round trips) and the credits

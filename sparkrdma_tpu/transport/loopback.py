@@ -26,6 +26,7 @@ import numpy as np
 
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, histogram
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport.channel import (
     Channel,
     ChannelState,
@@ -218,7 +219,7 @@ class LoopbackChannel(Channel):
             return True
 
     def _post_read(self, locations, listener: CompletionListener,
-                   dest=None, on_progress=None) -> None:
+                   dest=None, on_progress=None, ctx=None) -> None:
         # clock starts at POST time (like TcpChannel stamping t0 in
         # _post_read): the serve-queue wait is part of the RTT, so the
         # tcp/loopback series stay comparable under load
@@ -282,7 +283,17 @@ class LoopbackChannel(Channel):
                     # loopback has no response frame to cut, so the
                     # read_resp point fires here on the reply boundary
                     FAULTS.check("read_resp")
+                ts = time.monotonic()
                 data = self.remote.read_local_blocks(locations)
+                if ctx is not None and RECORDER.enabled:
+                    # in-process serve: the trace context needs no wire
+                    # tail — the closure carries it to the serve side
+                    fr_event(
+                        "transport", "serve_read",
+                        trace_id=ctx[0], span_id=ctx[1],
+                        blocks=len(locations),
+                        us=int((time.monotonic() - ts) * 1e6),
+                    )
             except BaseException as e:
                 fail(e)
                 return

@@ -28,8 +28,10 @@ import logging
 import selectors
 import socket
 import threading
+import time
 from typing import List, Tuple
 
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport import tcp as wire
 
 logger = logging.getLogger(__name__)
@@ -178,7 +180,7 @@ class SimPeerFleet:
             if magic != wire._MAGIC:
                 self._drop(conn)
                 return
-            if version != wire.WIRE_VERSION:
+            if not (wire.MIN_WIRE_VERSION <= version <= wire.WIRE_VERSION):
                 # same structured rejection real acceptors send: the
                 # dialing engine surfaces both versions in its error
                 self._send(conn, b"\x00" + wire._HELLO_REJ.pack(
@@ -201,6 +203,12 @@ class SimPeerFleet:
 
     def _serve_read(self, conn: _Conn, payload: bytes) -> None:
         req_id, count = wire._REQ_HDR.unpack_from(payload, 0)
+        if RECORDER.enabled:
+            # the requester's trace context rides the request's v2
+            # tail — the fleet's serve events join its trace exactly
+            # like a real peer's would
+            ctx = wire._req_trace(payload)
+            t0 = time.monotonic()
         parts = [wire._RESP_HDR.pack(req_id, 0)]
         off = wire._REQ_HDR.size
         try:
@@ -220,6 +228,14 @@ class SimPeerFleet:
                 str(e).encode("utf-8", "replace"),
             ]
         body = b"".join(bytes(p) for p in parts)
+        if RECORDER.enabled:
+            fr_event(
+                "transport", "serve_read",
+                trace_id=ctx[0] if ctx else 0,
+                span_id=ctx[1] if ctx else 0,
+                blocks=count,
+                us=int((time.monotonic() - t0) * 1e6),
+            )
         self._send(
             conn, wire._HDR.pack(wire.OP_READ_RESP, len(body)) + body
         )
@@ -247,4 +263,68 @@ class SimPeerFleet:
             pass
 
 
-__all__ = ["SimPeerFleet"]
+def _fleet_proc_main(n_peers, base_port, pattern, dump_path, host,
+                     ready, stop) -> None:
+    """Entry point of the spawned fleet process: serve until ``stop``,
+    then leave a flight-recorder dump at ``dump_path`` so the parent
+    can merge this process's serve spans with its own trace
+    (obs/collect.py)."""
+    RECORDER.retain()
+    try:
+        fleet = SimPeerFleet(n_peers, base_port, pattern, host=host)
+    except OSError as e:
+        ready.put(("err", str(e)))
+        return
+    ready.put(("ok", fleet.addresses))
+    stop.wait()
+    fleet.close()
+    if dump_path:
+        RECORDER.dump("fleet_stop", path=dump_path)
+    RECORDER.release()
+
+
+class SimPeerFleetProc:
+    """A :class:`SimPeerFleet` in its OWN process (multiprocessing
+    spawn — the module chain stays jax-free, so spawn is cheap).
+
+    The point is cross-process observability: the child retains the
+    flight recorder, its ``serve_read`` events carry the requester's
+    trace context off the wire, and ``close()`` leaves a dump at
+    ``dump_path`` for the parent to merge — a 2-process run then
+    yields ONE trace spanning requester and server spans."""
+
+    def __init__(self, n_peers: int, base_port: int, pattern,
+                 dump_path: str = "", host: str = "127.0.0.1",
+                 start_timeout: float = 30.0):
+        import multiprocessing
+
+        ctx = multiprocessing.get_context("spawn")
+        self._stop = ctx.Event()
+        ready = ctx.Queue()
+        self.dump_path = dump_path
+        self._proc = ctx.Process(
+            target=_fleet_proc_main,
+            args=(n_peers, base_port, bytes(pattern), dump_path, host,
+                  ready, self._stop),
+            daemon=True,
+        )
+        self._proc.start()
+        try:
+            status, detail = ready.get(timeout=start_timeout)
+        except Exception:
+            self._proc.terminate()
+            raise RuntimeError("simfleet subprocess did not come up")
+        if status != "ok":
+            self._proc.join(timeout=5)
+            raise OSError(f"simfleet subprocess bind failed: {detail}")
+        self.addresses: List[Tuple[str, int]] = detail
+
+    def close(self) -> None:
+        self._stop.set()
+        self._proc.join(timeout=15)
+        if self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=5)
+
+
+__all__ = ["SimPeerFleet", "SimPeerFleetProc"]

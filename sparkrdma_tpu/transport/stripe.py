@@ -166,7 +166,7 @@ class ReadGroup:
         return [self.channel(s) for s in range(1, self.num_stripes + 1)]
 
     def _post(self, slot: int, locs, listener, dest=None,
-              on_progress=None) -> None:
+              on_progress=None, ctx=None) -> None:
         """Post one lane's sub-read, re-resolving the channel exactly
         once if the cached channel was evicted between the cache lookup
         and the post (``read_blocks`` raises synchronously BEFORE
@@ -176,11 +176,12 @@ class ReadGroup:
         for attempt in (0, 1):
             ch = self.channel(slot)
             try:
-                if dest is None and on_progress is None:
+                if dest is None and on_progress is None and ctx is None:
                     ch.read_blocks(locs, listener)
                 else:
                     ch.read_blocks(
-                        locs, listener, dest=dest, on_progress=on_progress
+                        locs, listener, dest=dest, on_progress=on_progress,
+                        ctx=ctx,
                     )
             except TransportError:
                 if attempt:
@@ -201,6 +202,7 @@ class ReadGroup:
         listener: CompletionListener,
         on_progress=None,
         tenant=None,
+        ctx=None,
     ) -> None:
         """Same contract as ``Channel.read_blocks``: completion delivers
         one bytes-like payload per location, in order — striped blocks
@@ -243,8 +245,11 @@ class ReadGroup:
             if lanes_borrowed == 0:
                 striped = []
         if not striped:
-            if scatter and on_progress is not None:
-                self._post(0, locations, listener, on_progress=on_progress)
+            if scatter and (on_progress is not None or ctx is not None):
+                self._post(
+                    0, locations, listener, on_progress=on_progress,
+                    ctx=ctx,
+                )
             else:
                 self._post(0, locations, listener)
             return
@@ -265,14 +270,14 @@ class ReadGroup:
         try:
             self._read_striped(
                 locations, striped, lanes_borrowed, listener, on_progress,
-                release_lanes,
+                release_lanes, ctx,
             )
         except BaseException:
             release_lanes()
             raise
 
     def _read_striped(self, locations, striped, width, listener,
-                      on_progress, release_lanes) -> None:
+                      on_progress, release_lanes, ctx=None) -> None:
         striped_set = set(striped)
         small = [i for i in range(len(locations)) if i not in striped_set]
         out: list = [None] * len(locations)
@@ -337,13 +342,14 @@ class ReadGroup:
                 self._post(
                     0, [locations[i] for i in small],
                     FnCompletionListener(small_done, state.fail),
-                    on_progress=state.progress,
+                    on_progress=state.progress, ctx=ctx,
                 )
             for s in live_lanes:
                 locs, dests = lanes[s]
                 self._post(
                     s, locs, lane_listener(), dest=dests,
                     on_progress=state.progress,
+                    ctx=ctx.child() if ctx is not None else None,
                 )
         except BaseException as e:
             state.fail(e)

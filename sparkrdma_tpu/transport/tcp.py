@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter, gauge, histogram
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport.channel import (
     Channel,
     ChannelState,
@@ -72,13 +73,24 @@ _REQ_HDR = struct.Struct("<QI")      # req_id, location count
 _LOC = struct.Struct("<QII")         # address, length, mkey
 _RESP_HDR = struct.Struct("<QB")     # req_id, status
 _LEN = struct.Struct("<I")
+_TRACE_CTX = struct.Struct("<QQ")    # optional read-req tail: trace, span id
 
 #: Wire protocol generation carried in the connect hello.  Bump on any
-#: incompatible change to framing or message layout; peers speaking a
-#: different generation are rejected at handshake with both versions
-#: named (pre-versioning peers sent 0 in this slot, so they reject
-#: cleanly too).
-WIRE_VERSION = 1
+#: incompatible change to framing or message layout.  v2 adds the
+#: OPTIONAL trace-context tail to read requests and the trace fields on
+#: fetch-status/prefetch RPCs (rpc/messages.py ``since=2`` fields).
+#: Acceptors take any hello in [MIN_WIRE_VERSION, WIRE_VERSION]; a
+#: hello above/below that range is rejected STRUCTURALLY with both
+#: versions named (pre-versioning peers sent 0 in this slot, so they
+#: reject cleanly too).  The connector, NAKed by an older acceptor
+#: whose version it can still speak, re-dials at the acceptor's
+#: generation — the negotiated fallback — and records the channel's
+#: ``wire_version`` so v2-only bytes stay off that channel.
+WIRE_VERSION = 2
+
+#: Oldest wire generation this build still speaks (for both accepting
+#: older hellos and downgrading its own).
+MIN_WIRE_VERSION = 1
 
 OP_RPC = 1
 OP_READ_REQ = 2
@@ -146,8 +158,10 @@ def build_read_response_parts(node, payload: bytes, peer) -> Optional[List]:
     try:
         # the count must agree byte-for-byte with the payload BEFORE it
         # sizes the location loop — a lying count becomes a scoped
-        # error reply, not a struct.error mid-parse
-        if count < 0 or _REQ_HDR.size + count * _LOC.size != len(payload):
+        # error reply, not a struct.error mid-parse.  v2 requests may
+        # carry the optional trace-context tail after the locations.
+        base = _REQ_HDR.size + count * _LOC.size
+        if count < 0 or len(payload) not in (base, base + _TRACE_CTX.size):
             raise ValueError(
                 f"read request count {count} disagrees with payload "
                 f"{len(payload)}B"
@@ -161,7 +175,17 @@ def build_read_response_parts(node, payload: bytes, peer) -> Optional[List]:
         if FAULTS.enabled:
             FAULTS.check("serve_delay")
             FAULTS.check("serve")
+        t0 = time.monotonic()
         blocks = node.read_local_blocks(locs)
+        if RECORDER.enabled:
+            ctx = _req_trace(payload)
+            fr_event(
+                "transport", "serve_read",
+                trace_id=ctx[0] if ctx else 0,
+                span_id=ctx[1] if ctx else 0,
+                blocks=len(locs),
+                us=int((time.monotonic() - t0) * 1e6),
+            )
         parts: List = [_RESP_HDR.pack(req_id, 0)]
         for b in blocks:
             v = _as_view(b)
@@ -191,6 +215,21 @@ def _req_cost(payload: bytes) -> int:
         return total
     except Exception:
         return 0
+
+
+def _req_trace(payload: bytes) -> Optional[Tuple[int, int]]:
+    """The (trace_id, span_id) tail of one OP_READ_REQ, or None — v1
+    frames, trace-off requesters, and malformed payloads all land on
+    None (the tail is strictly optional on the wire)."""
+    try:
+        _req_id, count = _REQ_HDR.unpack_from(payload, 0)
+        base = _REQ_HDR.size + count * _LOC.size
+        if count < 0 or len(payload) != base + _TRACE_CTX.size:
+            return None
+        tid, sid = _TRACE_CTX.unpack_from(payload, base)
+        return (tid, sid) if tid else None
+    except Exception:
+        return None
 
 
 def _req_mkey(payload: bytes):
@@ -354,7 +393,7 @@ class TcpChannel(Channel):
 
     def _post_read(self, locations: List[BlockLocation],
                    listener: CompletionListener,
-                   dest=None, on_progress=None) -> None:
+                   dest=None, on_progress=None, ctx=None) -> None:
         with self._reads_lock:
             req_id = self._next_req
             self._next_req += 1
@@ -365,10 +404,19 @@ class TcpChannel(Channel):
         payload = bytearray(_REQ_HDR.pack(req_id, len(locations)))
         for loc in locations:
             payload += _LOC.pack(loc.address, loc.length, loc.mkey)
+        if ctx is not None and self.wire_version != 1:
+            # optional v2 tail; suppressed on channels negotiated down
+            payload += _TRACE_CTX.pack(ctx[0], ctx[1])
 
         def run():
             try:
                 self._send_msg(OP_READ_REQ, (payload,))
+                if ctx is not None and RECORDER.enabled:
+                    fr_event(
+                        "transport", "wire_send",
+                        trace_id=ctx[0], span_id=ctx[1],
+                        locs=len(locations),
+                    )
             except BaseException as e:
                 with self._reads_lock:
                     self._reads.pop(req_id, None)
@@ -421,7 +469,7 @@ class TcpChannel(Channel):
                     # never starve heartbeat/RPC dispatch, and its
                     # byte credits bound resident serve memory
                     self.node.submit_serve(
-                        self._serve_read, (payload,),
+                        self._serve_read, (payload, time.monotonic()),
                         _req_cost(payload), mkey=_req_mkey(payload),
                     )
                 else:
@@ -587,7 +635,7 @@ class TcpChannel(Channel):
             self._fail(entry[1], err)
             self._release_budget()
 
-    def _serve_read(self, payload: bytes) -> None:
+    def _serve_read(self, payload: bytes, t_enq=None) -> None:
         """The one-sided READ service: runs on the node's bounded serve
         pool (posted by the reader loop) against the registered block
         stores — never via the application receive listener, and never
@@ -596,13 +644,33 @@ class TcpChannel(Channel):
         scatter-gather frame of header + length prefixes + the
         resolved block VIEWS — registered memory is never copied into
         an intermediate response buffer."""
+        ctx = None
+        if RECORDER.enabled:
+            # t_enq → now spans the serve queue AND credit wait (the
+            # pool admits, then runs this on a worker)
+            ctx = _req_trace(payload)
+            fr_event(
+                "transport", "serve_admit",
+                trace_id=ctx[0] if ctx else 0,
+                span_id=ctx[1] if ctx else 0,
+                wait_us=0 if t_enq is None
+                else int((time.monotonic() - t_enq) * 1e6),
+                bytes=_req_cost(payload),
+            )
         parts = build_read_response_parts(self.node, payload, self.peer)
         if parts is None:
             # not even a req_id to scope an error reply to — dropped
             # (logged); the channel itself stays healthy
             return
         try:
+            t0 = time.monotonic()
             self._send_msg(OP_READ_RESP, parts)
+            if ctx is not None and RECORDER.enabled:
+                fr_event(
+                    "transport", "serve_send",
+                    trace_id=ctx[0], span_id=ctx[1],
+                    us=int((time.monotonic() - t0) * 1e6),
+                )
         except BaseException:
             # a response the requester will never see — and possibly a
             # half-written frame desyncing the byte stream.  The
@@ -723,7 +791,7 @@ class TcpNetwork:
                 )
                 if magic != _MAGIC or type_idx >= len(_TYPE_BY_INDEX):
                     raise TransportError(f"bad hello from {addr}")
-                if version != WIRE_VERSION:
+                if not (MIN_WIRE_VERSION <= version <= WIRE_VERSION):
                     # structured rejection: NAK byte + both versions,
                     # so the connector's error can name them (old
                     # pre-versioning hellos carry 0 here)
@@ -734,7 +802,7 @@ class TcpNetwork:
                     raise TransportError(
                         f"protocol version mismatch from {addr}: hello "
                         f"spoke wire version {version}, this node "
-                        f"requires {WIRE_VERSION}"
+                        f"accepts {MIN_WIRE_VERSION}..{WIRE_VERSION}"
                     )
                 req_type = _TYPE_BY_INDEX[type_idx]
                 sock.sendall(b"\x01")  # ack (ESTABLISHED)
@@ -748,6 +816,7 @@ class TcpNetwork:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             peer = (addr[0], src_port)
             ch = TcpChannel(_PAIRED.get(req_type, req_type), node, peer, sock)
+            ch.wire_version = version  # the hello's (accepted) generation
             ch._set_state(ChannelState.CONNECTED)
             node.register_passive_channel(ch)
             ch.start_reader()
@@ -759,20 +828,23 @@ class TcpNetwork:
         counter("transport_connect_attempts_total", transport="tcp").inc()
         if FAULTS.enabled:
             FAULTS.check("connect")
+        ver = WIRE_VERSION
         try:
-            sock = socket.create_connection(peer, timeout=timeout_s)
-            sock.settimeout(timeout_s)
-            if FAULTS.enabled and FAULTS.fires("hello"):
-                # a handshake fault dies between socket and ack — the
-                # half-open socket closes through the OSError path
-                sock.close()
-                raise OSError("injected fault at point 'hello'")
-            sock.sendall(_HELLO.pack(
-                _MAGIC, _TYPE_BY_INDEX.index(channel_type),
-                src.address[1], WIRE_VERSION,
-            ))
-            ack = _recv_exact(sock, 1)
-            if ack != b"\x01":
+            while True:
+                sock = socket.create_connection(peer, timeout=timeout_s)
+                sock.settimeout(timeout_s)
+                if FAULTS.enabled and FAULTS.fires("hello"):
+                    # a handshake fault dies between socket and ack —
+                    # the half-open socket closes via the OSError path
+                    sock.close()
+                    raise OSError("injected fault at point 'hello'")
+                sock.sendall(_HELLO.pack(
+                    _MAGIC, _TYPE_BY_INDEX.index(channel_type),
+                    src.address[1], ver,
+                ))
+                ack = _recv_exact(sock, 1)
+                if ack == b"\x01":
+                    break
                 detail = ""
                 if ack == b"\x00":
                     # structured version rejection carries both sides
@@ -780,12 +852,34 @@ class TcpNetwork:
                         srv_ver, cli_ver = _HELLO_REJ.unpack(
                             _recv_exact(sock, _HELLO_REJ.size)
                         )
+                    except TransportError:
+                        srv_ver = None
+                    else:
                         detail = (
                             f": peer requires wire version {srv_ver}, "
                             f"this hello spoke {cli_ver}"
                         )
-                    except TransportError:
-                        pass
+                    if (srv_ver is not None
+                            and MIN_WIRE_VERSION <= srv_ver < ver):
+                        # negotiated fallback: the acceptor closed its
+                        # end after the NAK, so re-dial speaking ITS
+                        # generation; the channel remembers it so
+                        # v2-only bytes (trace tails/fields) stay off
+                        # this connection
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        ver = srv_ver
+                        counter(
+                            "wire_version_downgrades_total",
+                            transport="tcp",
+                        ).inc()
+                        fr_event(
+                            "transport", "version_downgrade",
+                            peer=f"{peer[0]}:{peer[1]}", to=ver,
+                        )
+                        continue
                 raise TransportError(
                     f"handshake rejected by {peer}{detail}"
                 )
@@ -804,8 +898,11 @@ class TcpNetwork:
         if src.conf.transport_async_dispatcher:
             from sparkrdma_tpu.transport.dispatcher import AsyncTcpChannel
 
-            return AsyncTcpChannel.attach(channel_type, src, peer, sock)
+            ch = AsyncTcpChannel.attach(channel_type, src, peer, sock)
+            ch.wire_version = ver
+            return ch
         ch = TcpChannel(channel_type, src, peer, sock)
+        ch.wire_version = ver
         ch._set_state(ChannelState.CONNECTED)
         ch.start_reader()
         return ch

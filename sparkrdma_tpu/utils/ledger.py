@@ -297,6 +297,18 @@ class ResourceLedger:
                          "outstanding at ledger stop", total, resource)
         for line in report:
             logger.error("  leaked %s", line)
+        if leaked:
+            # a leak at ledger stop is a lifecycle bug — leave the
+            # flight recorder's view of the run's tail next to the
+            # leak report (obs/; lazy import keeps utils/ base-level)
+            from sparkrdma_tpu.obs import RECORDER, fr_event
+
+            if RECORDER.enabled:
+                fr_event(
+                    "faults", "ledger_leak",
+                    resources=len(leaked), units=sum(leaked.values()),
+                )
+                RECORDER.auto_dump("ledger_leak")
         if leaked and raise_on_leak:
             raise ResourceLeakError(
                 f"{sum(leaked.values())} unit(s) of "

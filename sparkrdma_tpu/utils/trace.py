@@ -32,12 +32,23 @@ class Tracer:
 
     def _append(self, event: Dict) -> None:
         """Bounded append: beyond max_events new events are counted but
-        dropped, so an always-on trace can't grow without limit."""
+        dropped, so an always-on trace can't grow without limit.  Drops
+        were once silent (the count surfaced only in the dump's
+        metadata); now they tick ``trace_dropped_total`` so a live
+        scrape shows a saturated tracer while the run is still up."""
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
-                return
-            self._events.append(event)
+                dropped = True
+            else:
+                self._events.append(event)
+                dropped = False
+        if dropped:
+            # outside the tracer lock (92): the registry's stripe locks
+            # rank higher but keeping inc() lock-free here is cheaper
+            from sparkrdma_tpu.metrics import counter
+
+            counter("trace_dropped_total").inc()
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6

@@ -33,6 +33,7 @@ import logging
 from typing import Optional
 
 from sparkrdma_tpu.metrics import counter
+from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.rpc.messages import (
     WireFormatError,
     decode_msg,
@@ -85,6 +86,14 @@ def header_error(engine: str, opcode: int, length: int) -> Optional[str]:
     counter(
         "wire_frames_rejected_total", engine=engine, opcode=label
     ).inc()
+    if RECORDER.enabled:
+        fr_event(
+            "transport", "wire_reject",
+            engine=engine, opcode=label, reason=err,
+        )
+        # a lying frame header desyncs the channel — snapshot the
+        # rings before the engine tears it down
+        RECORDER.auto_dump("wire_reject")
     return err
 
 
@@ -102,6 +111,12 @@ def rpc_frame_ok(engine: str, frame) -> bool:
             "wireDebug[%s]: dropping RPC frame: %s (frame %s)",
             engine, e, hex_context(bytes(frame)),
         )
+        if RECORDER.enabled:
+            fr_event(
+                "transport", "wire_reject",
+                engine=engine, opcode="rpc", reason=str(e)[:200],
+            )
+            RECORDER.auto_dump("wire_reject")
         return False
     counter(
         "wire_frames_validated_total", engine=engine, opcode="rpc"

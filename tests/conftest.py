@@ -28,3 +28,30 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected ≥8 spoofed CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session", autouse=True)
+def collect_flight_recorder_dump():
+    """Fleet-wide observability collection: with
+    ``SPARKRDMA_TPU_OBS_DUMP_DIR`` set, this process retains the
+    flight recorder for the whole session and leaves one dump at exit;
+    merge the per-process files with
+    ``python tools/trace_report.py <dir>/*.json`` for one
+    cross-process trace of the run.  Opt-in only — holding the
+    recorder open changes the (normally off-by-default) enabled flag
+    some lifecycle assertions check, so this is a debugging mode, not
+    part of the default gate."""
+    dump_dir = os.environ.get("SPARKRDMA_TPU_OBS_DUMP_DIR")
+    if not dump_dir:
+        yield
+        return
+    from sparkrdma_tpu.obs import RECORDER
+    from sparkrdma_tpu.obs.collect import write_dump
+
+    RECORDER.retain(ring_size=1 << 16)
+    yield
+    write_dump(
+        os.path.join(dump_dir, f"flightrec-session-{os.getpid()}.json"),
+        reason="session_end",
+    )
+    RECORDER.release()

@@ -1,0 +1,475 @@
+"""Observability plane (obs/): distributed trace propagation over the
+wire, flight-recorder ring/dump mechanics, negotiated wire-version
+fallback in both directions, the /health and /flightrecorder
+endpoints, and fleet-wide dump collection merging a 2-process run into
+one cross-process trace."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY, counter
+from sparkrdma_tpu.obs import RECORDER, TRACING, fr_event
+from sparkrdma_tpu.obs.collect import merge_dumps, merged_events, write_dump
+from sparkrdma_tpu.qos.http import MetricsHttpServer
+from sparkrdma_tpu.qos.registry import GLOBAL_QOS
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.shuffle.reader import FetchFailedError
+from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+from sparkrdma_tpu.transport import tcp as wire
+from sparkrdma_tpu.transport.channel import ChannelType, FnCompletionListener
+from sparkrdma_tpu.transport.node import Node
+from sparkrdma_tpu.transport.simfleet import SimPeerFleetProc
+from sparkrdma_tpu.utils.types import BlockLocation
+
+BASE_PORT = 34200
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_REPORT = os.path.join(ROOT, "tools", "trace_report.py")
+
+
+@pytest.fixture(autouse=True)
+def obs_reset():
+    """Every test leaves the process-global observability planes the
+    way it found them (owner counts, registries)."""
+    prev_metrics = GLOBAL_REGISTRY.enabled
+    GLOBAL_QOS.reset()
+    yield
+    GLOBAL_REGISTRY.enabled = prev_metrics
+    GLOBAL_QOS.enabled = False
+    GLOBAL_QOS.reset()
+    while RECORDER.enabled:
+        RECORDER.release()
+    while TRACING.enabled:
+        TRACING.release()
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        assert resp.status == 200
+        return resp.read()
+
+
+# -- trace context ------------------------------------------------------------
+
+
+def test_tracing_off_is_none_and_zero_cost():
+    assert not TRACING.enabled
+    assert TRACING.start() is None
+
+
+def test_tracing_start_child_and_sampling():
+    TRACING.retain(1.0)
+    try:
+        a, b = TRACING.start(), TRACING.start()
+        assert a is not None and b is not None
+        assert a.trace_id != b.trace_id
+        assert a.trace_id != 0 and a.span_id != 0
+        child = a.child()
+        assert child.trace_id == a.trace_id
+        assert child.span_id != a.span_id
+    finally:
+        TRACING.release()
+    # rate 0: enabled but every start sampled out
+    TRACING.retain(0.0)
+    try:
+        assert all(TRACING.start() is None for _ in range(8))
+    finally:
+        TRACING.release()
+    # rate 0.5 -> stride 2: exactly every other start traces
+    TRACING.retain(0.5)
+    try:
+        got = [TRACING.start() is not None for _ in range(8)]
+        assert sum(got) == 4
+    finally:
+        TRACING.release()
+
+
+# -- flight-recorder rings ----------------------------------------------------
+
+
+def test_recorder_off_fr_event_is_noop():
+    assert not RECORDER.enabled
+    fr_event("reader", "fetch_issue", bytes=1)  # must not raise
+    assert RECORDER._rings == {} or not RECORDER.enabled
+
+
+def test_ring_overflow_drops_oldest_and_counts():
+    GLOBAL_REGISTRY.enabled = True
+    base = counter("obs_events_dropped_total", plane="qos").value
+    RECORDER.retain(ring_size=64)
+    try:
+        for i in range(100):
+            fr_event("qos", "credit_block", pool="serve", bytes=i)
+        snap = RECORDER.snapshot()
+        ring = snap["planes"]["qos"]
+        assert len(ring["events"]) == 64
+        assert ring["dropped"] == 36
+        # the ring kept the NEWEST 64: the oldest surviving event is #36
+        assert ring["events"][0][2]["bytes"] == 36
+        assert counter(
+            "obs_events_dropped_total", plane="qos"
+        ).value - base == 36
+    finally:
+        RECORDER.release()
+
+
+def test_recorder_retain_is_owner_counted():
+    RECORDER.retain(ring_size=64)
+    RECORDER.retain(ring_size=64)
+    RECORDER.release()
+    assert RECORDER.enabled  # one owner still holds it
+    RECORDER.release()
+    assert not RECORDER.enabled
+
+
+def test_dump_and_auto_dump_rate_cap(tmp_path):
+    GLOBAL_REGISTRY.enabled = True
+    RECORDER.retain(ring_size=64, dump_dir=str(tmp_path))
+    try:
+        fr_event("faults", "breaker_trip", peer="p1", strikes=3)
+        p1 = RECORDER.auto_dump("breaker_trip")
+        assert p1 is not None and os.path.exists(p1)
+        assert "breaker_trip" in os.path.basename(p1)
+        doc = json.load(open(p1))
+        assert doc["reason"] == "breaker_trip"
+        assert doc["pid"] == os.getpid()
+        names = [e[1] for e in doc["planes"]["faults"]["events"]]
+        assert "breaker_trip" in names
+        # second auto-dump inside the interval is suppressed
+        assert RECORDER.auto_dump("breaker_trip") is None
+        # explicit dump is never rate-capped
+        p2 = RECORDER.dump("on_demand")
+        assert p2 is not None and p2 != p1
+    finally:
+        RECORDER.release()
+
+
+# -- /health and /flightrecorder ----------------------------------------------
+
+
+def test_health_and_flightrecorder_endpoints():
+    srv = MetricsHttpServer(0)
+    RECORDER.retain(ring_size=64)
+    try:
+        health = json.loads(_get(srv.url("/health")))
+        assert health["status"] == "ok"
+        assert health["pid"] == os.getpid()
+        assert health["uptime_s"] >= 0
+        fr_event("tier", "warm", mkey=7, blocks=3)
+        snap = json.loads(_get(srv.url("/flightrecorder")))
+        tier = snap["planes"]["tier"]["events"]
+        assert any(e[1] == "warm" and e[2]["mkey"] == 7 for e in tier)
+    finally:
+        RECORDER.release()
+        srv.stop()
+    # recorder off: the endpoint still answers, honestly
+    srv2 = MetricsHttpServer(0)
+    try:
+        snap = json.loads(_get(srv2.url("/flightrecorder")))
+        assert snap == {"enabled": False, "planes": {}}
+    finally:
+        srv2.stop()
+
+
+# -- wire-version negotiation, both directions --------------------------------
+
+
+def test_connector_downgrades_to_v1_acceptor():
+    """A peer whose acceptor NAKs with ``srv_ver=1`` gets re-dialed at
+    version 1; the channel pins the negotiated generation so v2-only
+    bytes stay off the connection."""
+    GLOBAL_REGISTRY.enabled = True
+    port = BASE_PORT
+    ready = threading.Event()
+    hellos = []
+
+    def v1_server():
+        srv = socket.create_server(("127.0.0.1", port))
+        srv.settimeout(10)
+        ready.set()
+        for _ in range(2):
+            sock, _addr = srv.accept()
+            hello = b""
+            while len(hello) < wire._HELLO.size:
+                hello += sock.recv(wire._HELLO.size - len(hello))
+            _magic, _ct, _port, ver = wire._HELLO.unpack(hello)
+            hellos.append(ver)
+            if ver != 1:
+                sock.sendall(b"\x00" + wire._HELLO_REJ.pack(1, ver))
+                sock.close()
+                continue
+            sock.sendall(b"\x01")
+            srv.close()
+            return sock  # hold the accepted v1 channel open
+
+    t = threading.Thread(target=v1_server, daemon=True)
+    t.start()
+    assert ready.wait(5)
+    net = TcpNetwork()
+    node = Node(("127.0.0.1", port + 1), TpuShuffleConf({
+        "spark.shuffle.tpu.connectTimeout": "5s",
+    }))
+    base = counter(
+        "wire_version_downgrades_total", transport="tcp"
+    ).value
+    try:
+        ch = net.connect(node, ("127.0.0.1", port), ChannelType.RPC_REQUESTOR)
+        assert ch.wire_version == 1
+        assert hellos == [wire.WIRE_VERSION, 1]
+        assert counter(
+            "wire_version_downgrades_total", transport="tcp"
+        ).value - base == 1
+        ch.stop()
+    finally:
+        node.stop()
+        t.join(timeout=10)
+
+
+def test_listener_accepts_v1_hello():
+    """The other direction: a v1 peer dialing THIS node's acceptor is
+    admitted (MIN_WIRE_VERSION), not NAKed."""
+    port = BASE_PORT + 10
+    net = TcpNetwork()
+    node = Node(("127.0.0.1", port), TpuShuffleConf({}))
+    net.register(node)
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.settimeout(10)
+        s.sendall(wire._HELLO.pack(
+            wire._MAGIC,
+            wire._TYPE_BY_INDEX.index(ChannelType.RPC_REQUESTOR),
+            55321, 1,
+        ))
+        assert s.recv(1) == b"\x01"
+        s.close()
+    finally:
+        node.stop()
+        net.unregister(node)
+
+
+def test_req_trace_tail_parses_and_requires_nonzero():
+    base = wire._REQ_HDR.pack(7, 1) + wire._LOC.pack(0, 16, 1)
+    assert wire._req_trace(base) is None
+    tail = base + wire._TRACE_CTX.pack(0xAB, 0xCD)
+    assert wire._req_trace(tail) == (0xAB, 0xCD)
+    # zero trace id is "no trace" even if bytes are present
+    zero = base + wire._TRACE_CTX.pack(0, 0xCD)
+    assert wire._req_trace(zero) is None
+
+
+# -- chaos auto-dump rendered by trace_report ---------------------------------
+
+
+def _cluster(conf, n_execs=2):
+    net = LoopbackNetwork()
+    driver = TpuShuffleManager(conf, is_driver=True, network=net)
+    execs = [
+        TpuShuffleManager(
+            conf, is_driver=False, network=net,
+            port=conf.driver_port + 100 + i * 10, executor_id=str(i),
+        )
+        for i in range(n_execs)
+    ]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == n_execs for e in execs):
+            break
+        time.sleep(0.01)
+    return net, driver, execs
+
+
+def test_chaos_fetch_failure_auto_dumps_and_report_names_fault(tmp_path):
+    """The acceptance path end to end: a seeded serve fault exhausts
+    the in-task retries, the terminal FetchFailed auto-dumps the
+    flight recorder, and tools/trace_report.py renders that dump
+    NAMING the injected fault point."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": BASE_PORT + 20,
+        "spark.shuffle.tpu.metrics": True,
+        "spark.shuffle.tpu.faultInject": "serve:p=1;seed=11",
+        "spark.shuffle.tpu.fetchRetryCount": 1,
+        "spark.shuffle.tpu.fetchRetryWaitMs": "10ms",
+        "spark.shuffle.tpu.flightRecorderDumpPath": str(tmp_path),
+    })
+    net, driver, execs = _cluster(conf)
+    try:
+        handle = driver.register_shuffle(21, 2, HashPartitioner(2))
+        maps_by_host = defaultdict(list)
+        for m in range(2):
+            w = execs[m].get_writer(handle, m)
+            w.write([(j % 5, j) for j in range(100)])
+            w.stop(True)
+            maps_by_host[execs[m].local_smid].append(m)
+        with pytest.raises(FetchFailedError):
+            list(execs[0].get_reader(
+                handle, 0, 1, dict(maps_by_host)
+            ).read())
+    finally:
+        for m in execs + [driver]:
+            m.stop()
+    dumps = [
+        os.path.join(tmp_path, f) for f in os.listdir(tmp_path)
+        if "fetch_failed" in f
+    ]
+    assert dumps, f"no fetch_failed auto-dump in {os.listdir(tmp_path)}"
+    out = subprocess.run(
+        [sys.executable, TRACE_REPORT, dumps[0]],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "injected fault points:" in out.stdout
+    assert "serve" in out.stdout.split("injected fault points:")[1]
+    assert "reader/fetch_fail" in out.stdout
+    assert "faults/fault_fired" in out.stdout
+
+
+# -- 2-process merged trace (simfleet) ----------------------------------------
+
+
+def test_two_process_merged_trace_spans_requester_and_server(tmp_path):
+    """SimPeerFleetProc serves from its OWN process; the requester's
+    trace context rides the READ_REQ v2 tail, so the child's
+    serve_read events carry the parent's trace id.  Merging the two
+    per-process dumps yields ONE trace whose events span both pids."""
+    pattern = (np.arange(1 << 16, dtype=np.uint32) % 251).astype(np.uint8)
+    fleet_dump = str(tmp_path / "fleet.json")
+    fleet = SimPeerFleetProc(
+        1, BASE_PORT + 40, pattern.tobytes(), dump_path=fleet_dump,
+    )
+    RECORDER.retain(ring_size=4096)
+    TRACING.retain(1.0)
+    node = Node(("127.0.0.1", BASE_PORT + 50), TpuShuffleConf({}))
+    ctx = TRACING.start()
+    try:
+        child = ctx.child()
+        locs = [BlockLocation(64, 4096, 1), BlockLocation(8192, 1024, 1)]
+        done = threading.Event()
+        res = {}
+        group = node.get_read_group(fleet.addresses[0], TcpNetwork().connect)
+        group.read_blocks(
+            locs,
+            FnCompletionListener(
+                lambda blocks: (res.setdefault("blocks", blocks), done.set()),
+                lambda e: (res.setdefault("error", e), done.set()),
+            ),
+            ctx=child,
+        )
+        assert done.wait(30), "fleet read hung"
+        assert "error" not in res, res.get("error")
+        for loc, blk in zip(locs, res["blocks"]):
+            got = np.frombuffer(memoryview(blk), np.uint8)
+            assert np.array_equal(
+                got, pattern[loc.address:loc.address + loc.length]
+            )
+    finally:
+        node.stop()
+        fleet.close()
+    my_dump = str(tmp_path / "requester.json")
+    assert write_dump(my_dump, reason="test") == my_dump
+    TRACING.release()
+    RECORDER.release()
+    assert os.path.exists(fleet_dump), "child left no dump"
+
+    doc = merge_dumps([my_dump, fleet_dump])
+    events = [
+        e for e in merged_events(doc)
+        if e["fields"].get("trace_id") == ctx.trace_id
+    ]
+    pids = {e["pid"] for e in events}
+    assert len(pids) == 2, (
+        f"trace {ctx.trace_id:#x} does not span both processes: {events}"
+    )
+    names = {(e["plane"], e["name"]) for e in events}
+    assert ("transport", "wire_send") in names     # requester side
+    assert ("transport", "serve_read") in names    # server side
+    server_pid = next(iter(pids - {os.getpid()}))
+    assert any(
+        e["pid"] == server_pid and e["name"] == "serve_read"
+        for e in events
+    )
+    # and the renderer shows one merged waterfall for the trace
+    out = subprocess.run(
+        [sys.executable, TRACE_REPORT, my_dump, fleet_dump],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert f"trace 0x{ctx.trace_id:016x}" in out.stdout
+    assert "2 process(es)" in out.stdout
+
+
+# -- manager wiring -----------------------------------------------------------
+
+
+def test_manager_retains_recorder_and_tracing_from_conf(tmp_path):
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": BASE_PORT + 60,
+        "spark.shuffle.tpu.traceEnabled": True,
+        "spark.shuffle.tpu.flightRecorderDumpPath": str(tmp_path),
+    })
+    mgr = TpuShuffleManager(
+        conf, is_driver=True, network=LoopbackNetwork(),
+    )
+    try:
+        assert RECORDER.enabled
+        assert TRACING.enabled
+    finally:
+        mgr.stop()
+    assert not RECORDER.enabled
+    assert not TRACING.enabled
+    # stop with a dump dir leaves a manager_stop snapshot
+    assert any(
+        "manager_stop" in f for f in os.listdir(tmp_path)
+    ), os.listdir(tmp_path)
+
+
+def test_trace_off_shuffle_has_no_trace_bytes_or_events():
+    """traceEnabled default-off: the reader stamps nothing, fetch-status
+    RPCs carry all-zero ids (v1-identical bytes, golden-pinned), and
+    no trace-carrying event lands in the rings."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.driverPort": BASE_PORT + 70,
+        "spark.shuffle.tpu.flightRecorder": True,
+    })
+    net, driver, execs = _cluster(conf)
+    try:
+        assert RECORDER.enabled
+        assert not TRACING.enabled
+        handle = driver.register_shuffle(22, 2, HashPartitioner(2))
+        maps_by_host = defaultdict(list)
+        for m in range(2):
+            w = execs[m].get_writer(handle, m)
+            w.write([(j % 5, j) for j in range(100)])
+            w.stop(True)
+            maps_by_host[execs[m].local_smid].append(m)
+        records = []
+        for p in range(2):
+            records.extend(execs[(p + 1) % 2].get_reader(
+                handle, p, p + 1, dict(maps_by_host)
+            ).read())
+        assert len(records) == 200
+        snap = RECORDER.snapshot()
+        for plane, rec in snap["planes"].items():
+            for _t, name, fields in rec["events"]:
+                assert not fields.get("trace_id"), (
+                    f"trace id leaked into {plane}/{name} with tracing off"
+                )
+        # the reader DID record its lifecycle, untraced
+        reader_names = {
+            e[1] for e in snap["planes"]["reader"]["events"]
+        }
+        assert "fetch_enqueue" in reader_names
+        driver.unregister_shuffle(22)
+    finally:
+        for m in execs + [driver]:
+            m.stop()

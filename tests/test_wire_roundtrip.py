@@ -162,9 +162,16 @@ CASES = [
     # PublishShuffleMetricsMsg
     PublishShuffleMetricsMsg(smid(7), 0, payload=b""),
     PublishShuffleMetricsMsg(smid(7), 1, payload=b"\x00\xff" * 65536),
+    FetchMapStatusMsg(
+        smid(3), smid(4), 1, 2, block_ids=[(0, 1)],
+        trace_id=2**64 - 1, span_id=1,
+    ),
     # PrefetchHintMsg
     PrefetchHintMsg(0, locations=[]),
     PrefetchHintMsg(I32_MAX, locations=[loc(i) for i in range(2048)]),
+    PrefetchHintMsg(
+        9, locations=[loc(0)], trace_id=1, span_id=2**64 - 1,
+    ),
     # CleanShuffleMsg
     CleanShuffleMsg(0),
     CleanShuffleMsg(I32_MAX),
@@ -186,6 +193,71 @@ def test_roundtrip(msg):
 def test_roundtrip_cases_cover_every_message_type():
     covered = {type(m).MSG_TYPE for m in CASES}
     assert covered == set(MSG_TYPES)
+
+
+# -- v2 trace tails: zero ids are invisible, v1 encoding drops them -----------
+
+def _traced(cls_case: int):
+    if cls_case == 0:
+        return (
+            FetchMapStatusMsg(smid(3), smid(4), 1, 2, block_ids=[(0, 1)]),
+            FetchMapStatusMsg(
+                smid(3), smid(4), 1, 2, block_ids=[(0, 1)],
+                trace_id=0xABC, span_id=0xDEF,
+            ),
+        )
+    return (
+        PrefetchHintMsg(5, locations=[loc(0), loc(1)]),
+        PrefetchHintMsg(
+            5, locations=[loc(0), loc(1)], trace_id=0xABC, span_id=0xDEF,
+        ),
+    )
+
+
+@pytest.mark.parametrize("case", [0, 1], ids=["fetch_map_status", "prefetch"])
+def test_zero_trace_ids_encode_byte_identical_to_v1(case):
+    """A trace-off run (all-default ids) must be bit-identical to wire
+    v1 at EVERY encoding version — the invariant that keeps the
+    pre-tail golden corpus green and the trace-off A/B honest."""
+    plain, _ = _traced(case)
+    base = plain.encode()
+    assert plain.encode(wire_version=1) == base
+    assert plain.encode(wire_version=2) == base
+
+
+@pytest.mark.parametrize("case", [0, 1], ids=["fetch_map_status", "prefetch"])
+def test_nonzero_trace_ids_suppressed_at_v1_carried_at_v2(case):
+    """Nonzero ids add exactly the two tail fields at v2 and vanish —
+    same bytes as the untraced message — when the peer negotiated v1."""
+    plain, traced = _traced(case)
+    v2 = traced.encode()
+    assert len(v2) == len(plain.encode()) + 16
+    out = decode_msg(v2)
+    assert (out.trace_id, out.span_id) == (0xABC, 0xDEF)
+    # pinned at the v1 peer's generation: tail suppressed, ids lost
+    v1 = traced.encode(wire_version=1)
+    assert v1 == plain.encode()
+    out1 = decode_msg(v1)
+    assert (out1.trace_id, out1.span_id) == (0, 0)
+
+
+def test_trace_ids_survive_segmentation():
+    """Every split part re-carries the parent's trace ids, so a
+    re-assembled multi-segment status keeps its correlation."""
+    msg = FetchMapStatusMsg(
+        smid(3), smid(4), 1, 2,
+        block_ids=[(m, r) for m in range(64) for r in range(8)],
+        trace_id=0x77, span_id=0x88,
+    )
+    segs = msg.encode_segments(512)
+    assert len(segs) > 1
+    for seg in segs:
+        part = decode_msg(bytes(seg))
+        assert (part.trace_id, part.span_id) == (0x77, 0x88)
+    # and v1 segmentation suppresses them on every part
+    for seg in msg.encode_segments(512, wire_version=1):
+        part = decode_msg(bytes(seg))
+        assert (part.trace_id, part.span_id) == (0, 0)
 
 
 def test_overlong_reason_truncates_to_max_len():

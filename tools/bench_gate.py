@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Bench regression gate: fresh BENCH_*.json vs the committed copies.
+
+Usage:
+    python tools/bench_gate.py [BENCH_file.json ...]
+
+The smoke benches overwrite their ``BENCH_*.json`` headline files in
+place, so after a ``make bench-smoke`` the working tree holds the
+fresh numbers and ``git show HEAD:<file>`` holds the committed
+baseline.  This gate diffs the two, metric by metric (matched on the
+``metric`` string), and FAILS when any metric regresses by more than
+the threshold:
+
+- throughput-like units (anything per second: ``GB/s``, ``rows/s``)
+  regress when the fresh value is LOWER,
+- latency-like units (``ns``/``us``/``ms``/``s``) regress when the
+  fresh value is HIGHER,
+- other units are reported but never gate.
+
+With no file arguments it gates every ``BENCH_*.json`` that differs
+from HEAD (``git diff --name-only``) — the ``make bench-smoke`` wiring.
+Files new to the tree (no committed baseline yet) and metrics new to a
+file are noted and skipped, never failed.
+
+Knobs (documented in the README "Observability" section):
+
+- ``BENCH_GATE_PCT`` — allowed regression percent (default 35: the
+  1-core CI hosts are noisy; tighten locally for real perf work),
+- ``BENCH_GATE=off`` — skip the gate entirely (exploratory runs).
+
+Exit status: 1 when any gated metric regresses past the threshold.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+DEFAULT_PCT = 35.0
+
+_LATENCY_UNITS = {"ns", "us", "ms", "s"}
+
+
+def _direction(unit: str):
+    """+1 higher-is-better, -1 lower-is-better, None ungated."""
+    u = (unit or "").strip()
+    if u.endswith("/s"):
+        return 1
+    if u in _LATENCY_UNITS:
+        return -1
+    return None
+
+
+def _committed(path: pathlib.Path):
+    """The HEAD copy of ``path`` as parsed JSON, or None when the file
+    is new to the tree (or we are not in a git checkout)."""
+    rel = path.resolve().relative_to(ROOT)
+    try:
+        out = subprocess.run(
+            ["git", "show", f"HEAD:{rel.as_posix()}"],
+            cwd=ROOT, capture_output=True, text=True,
+        )
+    except OSError:
+        return None
+    if out.returncode != 0:
+        return None
+    try:
+        return json.loads(out.stdout)
+    except ValueError:
+        return None
+
+
+def _changed_bench_files():
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--", "BENCH_*.json"],
+            cwd=ROOT, capture_output=True, text=True,
+        )
+    except OSError:
+        return []
+    if out.returncode != 0:
+        return []
+    return [ROOT / line for line in out.stdout.splitlines() if line]
+
+
+def _metrics(doc: dict) -> dict:
+    return {
+        r["metric"]: r for r in doc.get("results", [])
+        if isinstance(r, dict) and "metric" in r and "value" in r
+    }
+
+
+def gate_file(path: pathlib.Path, pct: float):
+    """(failures, notes) for one bench file."""
+    failures, notes = [], []
+    name = path.name
+    try:
+        fresh = _metrics(json.loads(path.read_text()))
+    except (OSError, ValueError) as e:
+        failures.append(f"{name}: unreadable fresh file ({e})")
+        return failures, notes
+    base_doc = _committed(path)
+    if base_doc is None:
+        notes.append(f"{name}: no committed baseline (new bench) — skipped")
+        return failures, notes
+    base = _metrics(base_doc)
+    for metric, rec in fresh.items():
+        if metric not in base:
+            notes.append(f"{name}: new metric {metric!r} — skipped")
+            continue
+        d = _direction(rec.get("unit", ""))
+        if d is None:
+            continue
+        old, new = float(base[metric]["value"]), float(rec["value"])
+        if old <= 0:
+            continue
+        # positive delta = regression, in the unit's bad direction
+        delta = (old - new) / old * 100.0 if d > 0 else \
+            (new - old) / old * 100.0
+        line = (
+            f"{name}: {metric}: {old:g} -> {new:g} {rec.get('unit', '')} "
+            f"({'-' if d > 0 else '+'}{abs(delta):.1f}%)"
+        )
+        if delta > pct:
+            failures.append(f"{line}  REGRESSION > {pct:g}%")
+        elif delta > pct / 2:
+            notes.append(f"{line}  (within threshold)")
+    return failures, notes
+
+
+def main(argv) -> int:
+    if os.environ.get("BENCH_GATE", "").lower() in ("off", "0", "no"):
+        print("bench_gate: BENCH_GATE=off — skipped")
+        return 0
+    try:
+        pct = float(os.environ.get("BENCH_GATE_PCT", DEFAULT_PCT))
+    except ValueError:
+        print(f"bench_gate: bad BENCH_GATE_PCT "
+              f"{os.environ['BENCH_GATE_PCT']!r}", file=sys.stderr)
+        return 2
+    paths = [pathlib.Path(a) for a in argv[1:]]
+    if not paths:
+        paths = _changed_bench_files()
+        if not paths:
+            print("bench_gate: no BENCH_*.json changed vs HEAD — "
+                  "nothing to gate")
+            return 0
+    failures, notes = [], []
+    gated = 0
+    for p in paths:
+        f, n = gate_file(p, pct)
+        failures.extend(f)
+        notes.extend(n)
+        gated += 1
+    for n in notes:
+        print(f"bench_gate: note: {n}")
+    for f in failures:
+        print(f"bench_gate: FAIL: {f}", file=sys.stderr)
+    if failures:
+        print(
+            f"bench_gate: {len(failures)} regression(s) past "
+            f"{pct:g}% across {gated} file(s) "
+            f"(override: BENCH_GATE_PCT=<pct> or BENCH_GATE=off)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"bench_gate: clean ({gated} file(s), threshold {pct:g}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

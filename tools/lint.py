@@ -53,6 +53,12 @@ Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
         first).  And every declared key must appear in a README.md
         conf table — as the backticked short key (`` `tierHotBytes` ``)
         or the full dotted key — so no knob ships undocumented.
+  PY12  flight-recorder event drift.  Every ``fr_event(plane, event,
+        ...)`` call in sparkrdma_tpu/ must pass the plane and event as
+        string LITERALS naming an entry declared in the
+        ``obs/events.py`` ``EVENTS`` registry — dashboards and
+        ``tools/trace_report.py`` group by these names, so a dynamic
+        or undeclared name is silent drift.  Declare first, then emit.
 
 C++ (native/):
   CC01  line longer than 100 characters
@@ -392,7 +398,7 @@ def lint_python(path: pathlib.Path, findings: list,
 # (docstrings included — a doc pointing at a key that does not exist
 # is exactly the drift this rule exists to catch).
 _CONF_GETTERS = {"get", "set", "_int_in_range", "_bytes_in_range",
-                 "_bool", "_time_ms"}
+                 "_bool", "_time_ms", "_float_in_range"}
 _CONF_KEY_RE = re.compile(
     r"spark\.shuffle\.(tpu|rdma)\.([A-Za-z_][A-Za-z0-9_]*)"
 )
@@ -455,6 +461,76 @@ def lint_conf_keys(findings: list, root: pathlib.Path = ROOT) -> None:
         )
 
 
+# PY12: flight-recorder event drift.  The declaration side is the
+# EVENTS dict literal in obs/events.py; the reference side is every
+# fr_event(plane, event, ...) call in library code.  Same shape as
+# PY11 — registry parsed from the AST, call sites walked per file.
+def _declared_events(events_path: pathlib.Path):
+    """``{plane: {event, ...}}`` from the EVENTS dict literal."""
+    tree = ast.parse(events_path.read_text())
+    declared: dict = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for t in node.targets:
+            if not (isinstance(t, ast.Name) and t.id == "EVENTS"):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, (ast.Tuple, ast.List, ast.Set))):
+                    continue
+                declared[k.value] = {
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                }
+    return declared
+
+
+def lint_fr_events(findings: list, root: pathlib.Path = ROOT) -> None:
+    """PY12 — see the module docstring."""
+    lib = root / "sparkrdma_tpu"
+    events_path = lib / "obs" / "events.py"
+    if not events_path.is_file():
+        return
+    declared = _declared_events(events_path)
+    for path in sorted(lib.rglob("*.py")):
+        rel = path.relative_to(root)
+        text = path.read_text()
+        if "fr_event" not in text:
+            continue
+        lines = text.splitlines()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue  # PY01 already owns this finding
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "fr_event"):
+                continue
+            msg = None
+            if (len(node.args) < 2
+                    or not all(isinstance(a, ast.Constant)
+                               and isinstance(a.value, str)
+                               for a in node.args[:2])):
+                msg = ("fr_event(plane, event, ...) must pass plane and "
+                       "event as string literals")
+            else:
+                plane, event = node.args[0].value, node.args[1].value
+                if plane not in declared:
+                    msg = (f"fr_event plane {plane!r} is not declared "
+                           f"in obs/events.py EVENTS")
+                elif event not in declared[plane]:
+                    msg = (f"fr_event event {plane!r}/{event!r} is not "
+                           f"declared in obs/events.py EVENTS")
+            if msg is not None and not _suppressed(lines, node.lineno,
+                                                   "PY12"):
+                findings.append((rel, node.lineno, "PY12", msg))
+
+
 def lint_cpp(path: pathlib.Path, findings: list) -> None:
     rel = path.relative_to(ROOT)
     for i, line in enumerate(path.read_text().splitlines(), 1):
@@ -473,6 +549,7 @@ def main() -> int:
     for f in cc_files():
         lint_cpp(f, findings)
     lint_conf_keys(findings)
+    lint_fr_events(findings)
     for rel, line, code, msg in findings:
         print(f"{rel}:{line}: {code} {msg}")
     if findings:

@@ -34,6 +34,14 @@ Wire-validator series (utils/wiredbg.py, conf ``wireDebug``) render as
 a wire-health table — frames validated/rejected per engine and opcode,
 unknown-frame counts by kind, hello version rejections — so a snapshot
 diff shows exactly what the frame validator saw during a run.
+
+Observability-plane series (obs/ + utils/trace.py) render as an
+obs-health table — tracer events dropped at the ring cap
+(``trace_dropped_total``, formerly a silent loss), flight-recorder
+events dropped per plane (``obs_events_dropped_total``), recorder
+dumps written by reason, and wire-version downgrades — so a run whose
+trace or flight-recorder data is INCOMPLETE says so in the report
+instead of rendering a silently truncated picture.
 """
 
 from __future__ import annotations
@@ -581,6 +589,53 @@ def render_wire_health(counters: list) -> list:
     return out
 
 
+def render_obs_health(counters: list) -> list:
+    """Observability-plane census (obs/ + utils/trace.py): dropped
+    tracer events, per-plane flight-recorder ring drops, dumps written
+    by reason, and wire-version downgrades.  Nonzero drop rows mean
+    the trace/recorder picture for the run is incomplete — size the
+    rings up (``flightRecorderRingSize``) before trusting a report."""
+    tracer_dropped = 0.0
+    ring_drops: dict = {}
+    dumps: dict = {}
+    downgrades: dict = {}
+    for c in counters:
+        labels = c.get("labels") or {}
+        if c["name"] == "trace_dropped_total":
+            tracer_dropped += c["value"]
+        elif c["name"] == "obs_events_dropped_total":
+            plane = labels.get("plane", "?")
+            ring_drops[plane] = ring_drops.get(plane, 0.0) + c["value"]
+        elif c["name"] == "obs_dumps_total":
+            reason = labels.get("reason", "?")
+            dumps[reason] = dumps.get(reason, 0.0) + c["value"]
+        elif c["name"] == "wire_version_downgrades_total":
+            tr = labels.get("transport", "?")
+            downgrades[tr] = downgrades.get(tr, 0.0) + c["value"]
+    if not tracer_dropped and not ring_drops and not dumps \
+            and not downgrades:
+        return []
+    out = ["observability health (obs/ + utils/trace.py)"]
+    if tracer_dropped:
+        out.append(
+            f"  tracer events dropped at ring cap: {tracer_dropped:,.0f} "
+            f"(trace incomplete — raise the tracer ring size)"
+        )
+    if ring_drops:
+        per_plane = "  ".join(
+            f"{p}={n:,.0f}" for p, n in sorted(ring_drops.items()))
+        out.append(f"  flight-recorder ring drops: {per_plane}")
+    if dumps:
+        per_reason = "  ".join(
+            f"{r}={n:,.0f}" for r, n in sorted(dumps.items()))
+        out.append(f"  recorder dumps written: {per_reason}")
+    if downgrades:
+        per_tr = "  ".join(
+            f"{t}={n:,.0f}" for t, n in sorted(downgrades.items()))
+        out.append(f"  wire-version downgrades: {per_tr}")
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
@@ -598,6 +653,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_skew(counters, hists))
     lines.extend(render_recovery(counters))
     lines.extend(render_wire_health(counters))
+    lines.extend(render_obs_health(counters))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]
     )

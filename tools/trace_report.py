@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Render flight-recorder dumps as a trace waterfall / Chrome trace.
+
+Usage:
+    python tools/trace_report.py DUMP [DUMP ...] [--chrome OUT.json]
+                                 [--plane PLANE] [--limit N]
+
+``DUMP`` is a flight-recorder JSON dump — written automatically on a
+failure (``flightRecorderDumpPath``), on demand from the metrics HTTP
+server's ``/flightrecorder`` endpoint, or at fixture/simfleet teardown
+via ``sparkrdma_tpu.obs.collect.write_dump``.  Several dumps merge
+into ONE cross-process report: every event carries its origin
+pid/host, so the requester's fetch spans and the server's serve spans
+of one ``trace_id`` interleave on the shared epoch clock.
+
+The text report prints
+
+- a per-plane event census (with ring-drop counts per process, so a
+  truncated picture says so),
+- the injected-fault and auto-dump context (which fault points fired,
+  what reason each dump was written for),
+- one waterfall per ``trace_id`` — events time-offset from the
+  trace's first event, tagged with pid/host and their key fields —
+  followed by the untraced remainder.
+
+``--chrome OUT.json`` additionally writes the merged events in Chrome
+tracing format (load in ``chrome://tracing`` or Perfetto): events
+carrying a ``us`` duration field render as complete spans, the rest as
+instants; rows group by process and plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from sparkrdma_tpu.obs.collect import (  # noqa: E402
+    load_dump,
+    merge_dumps,
+    merged_events,
+)
+
+#: fields rendered specially (identity / timing), not as plain k=v
+_SPECIAL = {"trace_id", "span_id", "us"}
+
+
+def load(paths) -> dict:
+    if len(paths) == 1:
+        return load_dump(paths[0])
+    return merge_dumps(paths)
+
+
+def _fmt_fields(fields: dict) -> str:
+    parts = []
+    sid = fields.get("span_id")
+    if sid:
+        parts.append(f"span={sid:#x}")
+    for k in sorted(fields):
+        if k in _SPECIAL:
+            continue
+        parts.append(f"{k}={fields[k]}")
+    us = fields.get("us")
+    if us is not None:
+        parts.append(f"took={_fmt_us(us)}")
+    return "  ".join(parts)
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us:.0f}us"
+
+
+def _procs(doc: dict):
+    return doc["processes"] if doc.get("merged") else [doc]
+
+
+def render_census(doc: dict, events: list) -> list:
+    """Per-plane event counts plus per-process ring drops and the
+    reason each dump was written (auto-dumps name their trigger)."""
+    out = []
+    procs = _procs(doc)
+    label = "merged dump" if doc.get("merged") else "dump"
+    out.append(
+        f"{label}: {len(events)} event(s) across {len(procs)} process(es)"
+    )
+    for proc in procs:
+        reason = proc.get("reason", "?")
+        drops = {
+            plane: rec.get("dropped", 0)
+            for plane, rec in proc.get("planes", {}).items()
+            if rec.get("dropped")
+        }
+        line = (
+            f"  pid={proc.get('pid')} host={proc.get('host')} "
+            f"reason={reason}"
+        )
+        if drops:
+            per = "  ".join(
+                f"{p}={n}" for p, n in sorted(drops.items()))
+            line += f"  RING DROPS: {per} (picture incomplete)"
+        out.append(line)
+    counts: dict = {}
+    for e in events:
+        key = (e["plane"], e["name"])
+        counts[key] = counts.get(key, 0) + 1
+    if counts:
+        out.append("event census")
+        width = max(len(f"{p}/{n}") for p, n in counts) + 2
+        for (plane, name) in sorted(counts):
+            out.append(
+                f"  {f'{plane}/{name}':<{width}}{counts[(plane, name)]:>8}"
+            )
+    return out
+
+
+def render_faults(events: list) -> list:
+    """Name every injected fault point that fired — the line a chaos
+    run's post-mortem greps for."""
+    points: dict = {}
+    for e in events:
+        if e["plane"] == "faults" and e["name"] == "fault_fired":
+            pt = e["fields"].get("point", "?")
+            points[pt] = points.get(pt, 0) + 1
+    if not points:
+        return []
+    per = "  ".join(f"{p}={n}" for p, n in sorted(points.items()))
+    return [f"injected fault points: {per}"]
+
+
+def render_waterfall(events: list, limit: int = 0) -> list:
+    """One waterfall per trace_id (events offset from the trace's
+    first event), then the untraced remainder on the epoch clock."""
+    traces: dict = {}
+    untraced = []
+    for e in events:
+        tid = e["fields"].get("trace_id") or 0
+        if tid:
+            traces.setdefault(tid, []).append(e)
+        else:
+            untraced.append(e)
+    out = []
+    for tid in sorted(traces, key=lambda t: traces[t][0]["t"]):
+        evs = traces[tid]
+        t0, t1 = evs[0]["t"], evs[-1]["t"]
+        procs = sorted({(e["pid"], e["host"]) for e in evs})
+        out.append(
+            f"trace {tid:#018x}  {len(evs)} event(s)  "
+            f"{len(procs)} process(es)  span {(t1 - t0) * 1e3:.3f}ms"
+        )
+        out.extend(_rows(evs, t0, limit))
+    if untraced:
+        out.append(f"untraced events ({len(untraced)})")
+        out.extend(_rows(untraced, untraced[0]["t"], limit))
+    return out
+
+
+def _rows(evs: list, t0: float, limit: int) -> list:
+    shown = evs if not limit else evs[:limit]
+    rows = []
+    for e in shown:
+        origin = f"{e['pid']}@{e['host']}"
+        rows.append(
+            f"  +{(e['t'] - t0) * 1e3:>10.3f}ms  {origin:<18} "
+            f"{e['plane']}/{e['name']:<18} {_fmt_fields(e['fields'])}"
+        )
+    if limit and len(evs) > limit:
+        rows.append(f"  ... {len(evs) - limit} more (raise --limit)")
+    return rows
+
+
+def chrome_trace(events: list) -> dict:
+    """Merged events in Chrome tracing format: ``us``-carrying events
+    as complete spans ending at their record time, the rest as
+    instants; one row per (process, plane)."""
+    trace_events = []
+    for e in events:
+        fields = e["fields"]
+        common = {
+            "name": e["name"],
+            "cat": e["plane"],
+            "pid": e["pid"] or 0,
+            "tid": e["plane"],
+            "args": dict(fields),
+        }
+        us = fields.get("us")
+        if us:
+            common.update(
+                ph="X", ts=(e["t"] * 1e6) - us, dur=us,
+            )
+        else:
+            common.update(ph="i", ts=e["t"] * 1e6, s="p")
+        trace_events.append(common)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def main(argv) -> int:
+    args = list(argv[1:])
+    chrome_out = None
+    plane = None
+    limit = 0
+    for flag in ("--chrome", "--plane", "--limit"):
+        if flag in args:
+            i = args.index(flag)
+            try:
+                val = args[i + 1]
+            except IndexError:
+                print(f"{flag} needs a value", file=sys.stderr)
+                return 2
+            del args[i:i + 2]
+            if flag == "--chrome":
+                chrome_out = val
+            elif flag == "--plane":
+                plane = val
+            else:
+                limit = int(val)
+    if not args:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    doc = load(args)
+    events = merged_events(doc)
+    if plane is not None:
+        events = [e for e in events if e["plane"] == plane]
+    lines = render_census(doc, events)
+    lines.extend(render_faults(events))
+    lines.extend(render_waterfall(events, limit))
+    print("\n".join(lines))
+    if chrome_out is not None:
+        with open(chrome_out, "w") as f:
+            json.dump(chrome_trace(events), f)
+        print(f"chrome trace: {chrome_out} "
+              f"({len(events)} event(s); open in chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
