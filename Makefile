@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck flowcheck wirecheck test bench bench-smoke chaos dryrun clean
+.PHONY: all native lint concheck flowcheck wirecheck test bench bench-smoke bench-cluster chaos dryrun clean
 
 all: native
 
@@ -55,8 +55,16 @@ bench-smoke:
 	python benchmarks/bench_qos.py
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_skew.py
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	python benchmarks/bench_cluster.py
 	python tools/bench_gate.py
 	$(MAKE) chaos
+
+# the multi-process cluster tier alone (real executor processes over
+# TCP + the native hot-path kernel microbench); full config writes
+# BENCH_cluster.json at the repo root
+bench-cluster: native
+	JAX_PLATFORMS=cpu python benchmarks/bench_cluster.py
 
 # the seeded chaos soak alone (faults/, conf faultInject): the full
 # engine matrix — loopback / tcp-threaded / tcp-async × decode

@@ -584,3 +584,191 @@ extern "C" int64_t rank_compress_i64(const int64_t* keys, uint64_t n,
   }
   return static_cast<int64_t>(distinct);
 }
+
+// ---------------------------------------------------------------------------
+// Hot-loop relief kernels: frame walking, batched checksums, block
+// gather.  The serde frame walkers, the exchange-row block gather and
+// the per-frame CRC loops all iterated per-frame in PYTHON (one
+// unpack_from + compare + append, or one numpy slice assignment, per
+// frame/block) — interpreter overhead that scales with frame count,
+// not byte count, and holds the GIL the whole walk.  Each kernel below
+// replaces one such loop with a single C call over the whole payload.
+
+// Length-prefixed frame walk: a frame is `prefix` opaque header bytes
+// (0 for the pickle serializer's bare batches, 1 for the codec-tag
+// byte of the compressed framing) + 4B little-endian body length +
+// body.  Writes (start, end) pairs into spans_out.  Returns the span
+// count, -1 on a truncated header/body (caller re-walks in Python for
+// the detailed error message), -2 when max_spans is too small (caller
+// grows and retries).  Little-endian hosts only (every deployment
+// target; the Python walker is the portable path).
+extern "C" int64_t frame_spans_lp(const uint8_t* buf, uint64_t total,
+                                  uint64_t prefix, int64_t* spans_out,
+                                  uint64_t max_spans) {
+  const uint64_t hdr = prefix + 4;
+  uint64_t off = 0, n_spans = 0;
+  while (off < total) {
+    if (off + hdr > total) return -1;
+    uint32_t n;
+    memcpy(&n, buf + off + prefix, 4);
+    const uint64_t end = off + hdr + n;
+    if (end > total) return -1;
+    if (n_spans == max_spans) return -2;
+    spans_out[2 * n_spans] = static_cast<int64_t>(off);
+    spans_out[2 * n_spans + 1] = static_cast<int64_t>(end);
+    n_spans++;
+    off = end;
+  }
+  return static_cast<int64_t>(n_spans);
+}
+
+// numpy dtype-string itemsize for the fixed-width codes the columnar
+// plane uses ("<i8", "|u1", "<f4", "S5", ...).  Anything fancier
+// (unicode 'U' scales by 4, datetimes carry a unit suffix) answers 0
+// and the caller falls back to np.dtype in Python.
+static inline uint64_t dtype_itemsize(const uint8_t* s, uint64_t len) {
+  uint64_t i = 0;
+  if (i < len && (s[i] == '<' || s[i] == '>' || s[i] == '=' || s[i] == '|'))
+    i++;
+  if (i >= len) return 0;
+  const uint8_t code = s[i++];
+  if (code != 'b' && code != 'i' && code != 'u' && code != 'f' &&
+      code != 'c' && code != 'S' && code != 'V')
+    return 0;
+  if (i >= len) return 0;
+  uint64_t v = 0;
+  for (; i < len; i++) {
+    if (s[i] < '0' || s[i] > '9') return 0;
+    v = v * 10 + (s[i] - '0');
+    if (v > (1u << 20)) return 0;
+  }
+  return v;
+}
+
+// Columnar frame walk (serde.ColumnarSerializer framing): 0xC2 frames
+// are magic | flags | key-dtype | val-dtype | 4B count | columns;
+// 0xC3 frames are the pickle fallback (magic + 4B len + body).
+// Returns the span count, -1 on truncation, -2 when max_spans is too
+// small, -3 on a dtype string this side won't parse, -4 on a bad
+// magic — every negative answer sends the caller back to the Python
+// walker (which raises the detailed error or handles the dtype).
+extern "C" int64_t columnar_frame_spans(const uint8_t* buf, uint64_t total,
+                                        int64_t* spans_out,
+                                        uint64_t max_spans) {
+  uint64_t off = 0, n_spans = 0;
+  while (off < total) {
+    const uint64_t start = off;
+    uint64_t end;
+    if (buf[off] == 0xC3) {
+      if (off + 5 > total) return -1;
+      uint32_t n;
+      memcpy(&n, buf + off + 1, 4);
+      end = off + 5 + n;
+    } else if (buf[off] == 0xC2) {
+      uint64_t p = off + 2;  // magic + flags
+      if (p + 1 > total) return -1;
+      const uint64_t nk = buf[p];
+      p += 1;
+      if (p + nk + 1 > total) return -1;
+      const uint64_t ksz = dtype_itemsize(buf + p, nk);
+      p += nk;
+      const uint64_t nv = buf[p];
+      p += 1;
+      if (p + nv + 4 > total) return -1;
+      const uint64_t vsz = dtype_itemsize(buf + p, nv);
+      p += nv;
+      if (!ksz || !vsz) return -3;
+      uint32_t count;
+      memcpy(&count, buf + p, 4);
+      p += 4;
+      end = p + static_cast<uint64_t>(count) * (ksz + vsz);
+    } else {
+      return -4;
+    }
+    if (end > total) return -1;
+    if (n_spans == max_spans) return -2;
+    spans_out[2 * n_spans] = static_cast<int64_t>(start);
+    spans_out[2 * n_spans + 1] = static_cast<int64_t>(end);
+    n_spans++;
+    off = end;
+  }
+  return static_cast<int64_t>(n_spans);
+}
+
+// Slice-by-8 CRC32 (the zlib polynomial, bit-exact with zlib.crc32):
+// one table init at load, then 8 bytes per table round.  The win over
+// per-span zlib.crc32 calls is the BATCH — one C call checksums every
+// frame of a block, instead of one Python call (argument packing,
+// buffer-protocol negotiation) per frame.
+static uint32_t crc_tab[8][256];
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_tab[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int t = 1; t < 8; t++)
+      crc_tab[t][i] =
+          crc_tab[0][crc_tab[t - 1][i] & 0xFF] ^ (crc_tab[t - 1][i] >> 8);
+}
+namespace {
+struct CrcInitGuard {
+  CrcInitGuard() { crc_init(); }
+} crc_init_guard;
+}  // namespace
+
+static uint32_t crc32_one(const uint8_t* p, uint64_t len, uint32_t crc) {
+  crc = ~crc;
+  while (len && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = crc_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    len--;
+  }
+  while (len >= 8) {
+    uint32_t lo, hi;
+    memcpy(&lo, p, 4);
+    memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = crc_tab[7][lo & 0xFF] ^ crc_tab[6][(lo >> 8) & 0xFF] ^
+          crc_tab[5][(lo >> 16) & 0xFF] ^ crc_tab[4][lo >> 24] ^
+          crc_tab[3][hi & 0xFF] ^ crc_tab[2][(hi >> 8) & 0xFF] ^
+          crc_tab[1][(hi >> 16) & 0xFF] ^ crc_tab[0][hi >> 24];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// out[i] = crc32(buf[spans[2i] : spans[2i+1]]) for every span.  The
+// caller bounds-checks the spans against the buffer (the kernel
+// trusts them).
+extern "C" void crc32_spans(const uint8_t* buf, const int64_t* spans,
+                            uint64_t n_spans, uint32_t* out) {
+  for (uint64_t i = 0; i < n_spans; i++) {
+    const int64_t a = spans[2 * i], b = spans[2 * i + 1];
+    out[i] = crc32_one(buf + a, static_cast<uint64_t>(b - a), 0);
+  }
+}
+
+// Batched block gather: dst[dst_offs[i] : dst_offs[i]+lens[i]] =
+// src_ptrs[i] — one C call assembles a whole exchange source row
+// instead of one numpy slice assignment per map-output block (the
+// bulk._assemble hot loop; slice assignment costs ~1 us of
+// dispatch per block regardless of size).  The caller pins the
+// source arrays for the duration and pre-validates every span
+// against the destination row.  Returns total bytes copied.
+extern "C" int64_t gather_blocks(const uint64_t* src_ptrs,
+                                 const int64_t* lens, uint8_t* dst,
+                                 const int64_t* dst_offs, uint64_t n) {
+  int64_t copied = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    memcpy(dst + dst_offs[i],
+           reinterpret_cast<const void*>(
+               static_cast<uintptr_t>(src_ptrs[i])),
+           static_cast<size_t>(lens[i]));
+    copied += lens[i];
+  }
+  return copied;
+}

@@ -40,6 +40,20 @@ def parse_time_ms(value: object) -> int:
     return int(float(s)) * 1000
 
 
+def host_core_census() -> int:
+    """Cores actually runnable by THIS process.
+
+    ``os.cpu_count()`` reports the machine; a containerized or
+    ``taskset``-pinned executor may be allowed far fewer.  Prefer the
+    scheduler-affinity mask (which cgroup cpusets and
+    ``sched_setaffinity`` both shrink) and fall back to the machine
+    count where the platform has no affinity API."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 class TpuShuffleConf:
     """Config accessor over a plain dict of ``spark.shuffle.tpu.*`` keys.
 
@@ -133,6 +147,36 @@ class TpuShuffleConf:
             return default
         return max(lo, min(hi, v))
 
+    # -- core census (every cpu_count-derived default reads this) ----------
+    @property
+    def core_census(self) -> int:
+        """The core count that parallelism defaults key off.
+
+        Resolution order: an explicit ``coreCensus`` setting wins
+        (> 0); else a ``dispatcherCpuList`` pin implies the executor
+        will run on that many cores; else the process affinity mask
+        (``host_core_census``), NOT ``os.cpu_count()`` — a CPU-pinned
+        containerized executor sees the machine's count but can only
+        run on its mask, and sizing decode/serve/spin defaults off the
+        machine count oversubscribes the pin (the bug this key fixes).
+        Every conf default that used to read ``os.cpu_count()``
+        (``decodeThreads``, ``bulkPipelineWindows``,
+        ``transportPollSpinUs``, ``tierPrefetch``,
+        ``transportNumStripes``, ``transportServeThreads``) now reads
+        this."""
+        explicit = self._int_in_range("coreCensus", 0, 0, 4096)
+        if explicit > 0:
+            return explicit
+        if self.dispatcher_cpu_list.strip():
+            machine = os.cpu_count() or 1
+            pinned = self.parse_dispatcher_cpu_list(machine)
+            # _parse_index_list answers all-cores for garbage specs;
+            # a full-machine answer is not a pin, fall through to the
+            # affinity mask
+            if pinned and len(pinned) < machine:
+                return len(pinned)
+        return host_core_census()
+
     # -- transport / control-plane queues (reference: recv/sendQueueDepth) --
     @property
     def recv_queue_depth(self) -> int:
@@ -208,7 +252,7 @@ class TpuShuffleConf:
         ``min(4, cpus)`` on multi-core hosts; 0 on a single-core host
         (decode workers would only timeslice against the task thread —
         the ``bulkPipelineWindows`` convention)."""
-        ncpu = os.cpu_count() or 1
+        ncpu = self.core_census
         return self._int_in_range(
             "decodeThreads", min(4, ncpu) if ncpu > 1 else 0, 0, 64
         )
@@ -274,7 +318,7 @@ class TpuShuffleConf:
         hide (measured net-negative there — the ``decodeThreads`` /
         ``bulkPipelineWindows`` single-core-fallback precedent).  An
         explicit setting always wins."""
-        return self._bool("tierPrefetch", (os.cpu_count() or 1) > 1)
+        return self._bool("tierPrefetch", self.core_census > 1)
 
     @property
     def tier_prefetch_blocks(self) -> int:
@@ -306,7 +350,7 @@ class TpuShuffleConf:
         extended with fabric-lib-style striping).  1 disables striping
         (single data channel per peer)."""
         return self._int_in_range(
-            "transportNumStripes", min(4, os.cpu_count() or 1), 1, 16
+            "transportNumStripes", min(4, self.core_census), 1, 16
         )
 
     @property
@@ -406,7 +450,7 @@ class TpuShuffleConf:
         single-core-fallback precedent."""
         return self._int_in_range(
             "transportPollSpinUs",
-            40 if (os.cpu_count() or 1) > 1 else 0, 0, 10000,
+            40 if self.core_census > 1 else 0, 0, 10000,
         )
 
     @property
@@ -457,7 +501,7 @@ class TpuShuffleConf:
         large serve never head-of-line-blocks completions on its
         channel."""
         return self._int_in_range(
-            "transportServeThreads", min(4, os.cpu_count() or 1), 1, 64
+            "transportServeThreads", min(4, self.core_census), 1, 64
         )
 
     @property
@@ -571,7 +615,7 @@ class TpuShuffleConf:
         it falls back to the serial loop there.  An explicit setting
         always wins."""
         return self._bool(
-            "bulkPipelineWindows", (os.cpu_count() or 2) > 1
+            "bulkPipelineWindows", self.core_census > 1
         )
 
     @property

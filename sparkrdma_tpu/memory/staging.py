@@ -88,6 +88,30 @@ def _load_native():
             ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
+    if hasattr(lib, "frame_spans_lp"):
+        lib.frame_spans_lp.restype = ctypes.c_int64
+        lib.frame_spans_lp.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+    if hasattr(lib, "columnar_frame_spans"):
+        lib.columnar_frame_spans.restype = ctypes.c_int64
+        lib.columnar_frame_spans.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+    if hasattr(lib, "crc32_spans"):
+        lib.crc32_spans.restype = None
+        lib.crc32_spans.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+    if hasattr(lib, "gather_blocks"):
+        lib.gather_blocks.restype = ctypes.c_int64
+        lib.gather_blocks.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
     return lib
 
 
@@ -237,6 +261,137 @@ def native_merge_runs_groups(key_runs, val_runs):
     # copy the (small) group-level slices so the full n-sized scratch
     # isn't pinned behind the views for the consumer's lifetime
     return out_keys[:g].copy(), out_vals, out_offs[: g + 1].copy()
+
+
+def _flat_u8(data):
+    """Flat uint8 view of any contiguous bytes-like (no copy), or None
+    when the buffer protocol won't yield one."""
+    try:
+        return np.frombuffer(data, np.uint8)
+    except (TypeError, ValueError, BufferError):
+        return None
+
+
+def native_frame_spans(data, prefix: int):
+    """(start, end) spans of length-prefixed frames (``prefix`` opaque
+    bytes + 4B LE length + body) in one C walk — the serde
+    frame-walking loops (PickleSerializer prefix=0, CompressedSerializer
+    prefix=1) pay one interpreted iteration PER FRAME otherwise.
+    Returns an int64 [n, 2] span array, or None when the native lib is
+    absent, the buffer won't view flat, or the stream is truncated —
+    the caller re-walks in Python (raising its detailed error)."""
+    if _NATIVE is None or not hasattr(_NATIVE, "frame_spans_lp"):
+        return None
+    arr = _flat_u8(data)
+    if arr is None:
+        return None
+    total = arr.shape[0]
+    if total == 0:
+        return np.empty((0, 2), np.int64)
+    # frames are typically >= hundreds of bytes; grow on the rare -2
+    cap = max(64, total // 256)
+    while True:
+        spans = np.empty((cap, 2), np.int64)
+        n = _NATIVE.frame_spans_lp(
+            arr.ctypes.data, total, prefix, spans.ctypes.data, cap
+        )
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            return None
+        return spans[:n]
+
+
+def native_columnar_frame_spans(data):
+    """(start, end) spans of columnar frames (serde.ColumnarSerializer
+    0xC2/0xC3 framing) in one C walk, parsing the fixed-width dtype
+    headers natively.  Returns an int64 [n, 2] span array, or None on
+    lib-absent / truncation / exotic dtype strings / bad magic — the
+    Python walker is the authority for every error path."""
+    if _NATIVE is None or not hasattr(_NATIVE, "columnar_frame_spans"):
+        return None
+    arr = _flat_u8(data)
+    if arr is None:
+        return None
+    total = arr.shape[0]
+    if total == 0:
+        return np.empty((0, 2), np.int64)
+    cap = max(64, total // 256)
+    while True:
+        spans = np.empty((cap, 2), np.int64)
+        n = _NATIVE.columnar_frame_spans(
+            arr.ctypes.data, total, spans.ctypes.data, cap
+        )
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            return None
+        return spans[:n]
+
+
+def native_crc32_spans(data, spans):
+    """``out[i] = zlib.crc32(data[a_i:b_i])`` batched into ONE call —
+    per-span zlib.crc32 pays Python call + buffer-protocol overhead
+    per frame, which dominates for the small-frame shapes the decode
+    plane checksums.  ``spans`` is any [n, 2] int-convertible array.
+    Returns a uint32 array (bit-exact with zlib.crc32) or None when
+    unavailable/ineligible (caller falls back to the zlib loop)."""
+    if _NATIVE is None or not hasattr(_NATIVE, "crc32_spans"):
+        return None
+    arr = _flat_u8(data)
+    if arr is None:
+        return None
+    sp = np.ascontiguousarray(spans, np.int64)
+    if sp.ndim != 2 or sp.shape[1] != 2:
+        return None
+    n = sp.shape[0]
+    if n == 0:
+        return np.empty(0, np.uint32)
+    # the kernel trusts the spans: bounds-check them here
+    if (
+        bool((sp[:, 0] < 0).any())
+        or bool((sp[:, 1] < sp[:, 0]).any())
+        or int(sp[:, 1].max()) > arr.shape[0]
+    ):
+        return None
+    out = np.empty(n, np.uint32)
+    _NATIVE.crc32_spans(arr.ctypes.data, sp.ctypes.data, n, out.ctypes.data)
+    return out
+
+
+def native_gather_blocks(dst: np.ndarray, src_addrs, lens, dst_offs) -> bool:
+    """Batched ``dst[off:off+n] = block`` memcpy: ONE call assembles a
+    whole exchange source row instead of one numpy slice assignment
+    per map-output block (bulk._assemble).  ``src_addrs`` are raw
+    buffer addresses — the CALLER keeps the owning arrays alive across
+    the call.  Returns False (caller runs the slice-assignment loop)
+    when unavailable or ineligible; every span is re-checked against
+    ``dst`` before the memcpys run."""
+    if _NATIVE is None or not hasattr(_NATIVE, "gather_blocks"):
+        return False
+    if dst.ndim != 1 or dst.dtype != np.uint8 or (
+        dst.shape[0] and dst.strides[0] != 1
+    ):
+        return False
+    a = np.ascontiguousarray(src_addrs, np.uint64)
+    ln = np.ascontiguousarray(lens, np.int64)
+    off = np.ascontiguousarray(dst_offs, np.int64)
+    n = a.shape[0]
+    if ln.shape[0] != n or off.shape[0] != n:
+        return False
+    if n == 0:
+        return True
+    if (
+        bool((ln < 0).any()) or bool((off < 0).any())
+        or int((off + ln).max()) > dst.shape[0]
+    ):
+        return False
+    _NATIVE.gather_blocks(
+        a.ctypes.data, ln.ctypes.data, dst.ctypes.data, off.ctypes.data, n
+    )
+    return True
 
 
 def native_radix_scratch_trim() -> None:

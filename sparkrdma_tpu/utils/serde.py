@@ -118,8 +118,17 @@ class PickleSerializer(Serializer):
             off += n
 
     def frame_spans(self, data) -> List[Tuple[int, int]]:
-        """One span per length-prefixed pickle batch."""
+        """One span per length-prefixed pickle batch.  The walk is one
+        native call when ``_staging.so`` is present (interpreter cost
+        per BLOCK, not per frame); the Python loop is the fallback and
+        the authority for truncation errors."""
+        from sparkrdma_tpu.memory.staging import native_frame_spans
+
         view = as_view(data)
+        walked = native_frame_spans(view, 0)
+        if walked is not None:
+            return list(zip(walked[:, 0].tolist(),
+                            walked[:, 1].tolist()))
         spans: List[Tuple[int, int]] = []
         off = 0
         while off < len(view):
@@ -328,8 +337,16 @@ class ColumnarSerializer(Serializer):
     def frame_spans(self, data) -> List[Tuple[int, int]]:
         """One span per columnar/pickle frame: a header-only walk (no
         column views built) so splitting a block across decode workers
-        costs O(frames), not O(bytes)."""
+        costs O(frames), not O(bytes) — and one NATIVE call when
+        ``_staging.so`` is present (the C side parses the fixed-width
+        dtype headers; exotic dtypes fall back here)."""
+        from sparkrdma_tpu.memory.staging import native_columnar_frame_spans
+
         view = as_view(data)
+        walked = native_columnar_frame_spans(view)
+        if walked is not None:
+            return list(zip(walked[:, 0].tolist(),
+                            walked[:, 1].tolist()))
         spans: List[Tuple[int, int]] = []
         off = 0
         total = len(view)
@@ -482,8 +499,16 @@ class CompressedSerializer(Serializer):
         """One span per ``tag + length + body`` frame — decompression
         splits at these boundaries, so one large block's inflate fans
         out across decode workers (each span group is decoded
-        independently through ``deserialize``/``deserialize_columns``)."""
+        independently through ``deserialize``/``deserialize_columns``).
+        Walked natively when ``_staging.so`` is present (1-byte tag
+        prefix + 4B length, the same layout the pickle walk uses)."""
+        from sparkrdma_tpu.memory.staging import native_frame_spans
+
         view = as_view(data)
+        walked = native_frame_spans(view, 1)
+        if walked is not None:
+            return list(zip(walked[:, 0].tolist(),
+                            walked[:, 1].tolist()))
         spans: List[Tuple[int, int]] = []
         off = 0
         while off < len(view):

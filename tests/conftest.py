@@ -30,6 +30,41 @@ def devices():
     return devs
 
 
+# ProcessCluster fixture ports: below the kernel ephemeral floor
+# (32768) so a transient client socket can never squat a base, spaced
+# wider than any per-fleet spread (driver + 8 executors × 40)
+_CLUSTER_PORT = [24200]
+
+
+def _next_cluster_port() -> int:
+    p = _CLUSTER_PORT[0]
+    _CLUSTER_PORT[0] += 500
+    return p
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """A REAL 2-process cluster: driver in this process + two full
+    TpuShuffleManager executor processes over TCP sockets.  Tests drive
+    it through the pipe command protocol (register/write/read); obs
+    dumps (flight recorder + logs) land in the workdir and are merged
+    at teardown."""
+    from sparkrdma_tpu.transport.simfleet import ProcessCluster
+
+    c = ProcessCluster(
+        2, _next_cluster_port(),
+        conf={
+            "spark.shuffle.tpu.partitionLocationFetchTimeout": "15s",
+            "spark.shuffle.tpu.connectTimeout": "10s",
+            "spark.shuffle.tpu.fetchRetryWaitMs": "100ms",
+        },
+        workdir=str(tmp_path / "cluster"),
+    )
+    yield c
+    c.stop()
+    c.collect()
+
+
 @pytest.fixture(scope="session", autouse=True)
 def collect_flight_recorder_dump():
     """Fleet-wide observability collection: with

@@ -1,0 +1,129 @@
+"""Multi-process cluster harness (transport/simfleet.ProcessCluster):
+cross-process shuffles over real sockets — bit-exactness against a
+parent-side recomputation, fleet census/obs collection, and the first
+executor-crash-mid-stage run across real process boundaries (clean
+FetchFailed on the reader, surviving fleet stays healthy)."""
+
+import pytest
+
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.transport.simfleet import (
+    ExecutorCommandError,
+    _gen_records,
+    records_digest,
+)
+
+pytestmark = pytest.mark.cluster
+
+NUM_PARTS = 4
+SHUFFLE = 7
+
+
+def _expected_partitions(gen, num_maps, num_parts):
+    """Parent-side recomputation of what every reducer must see: the
+    generators are deterministic and stable_hash is cross-process
+    stable, so the cluster's digests must match these bit-for-bit."""
+    part = HashPartitioner(num_parts)
+    by_part = {p: [] for p in range(num_parts)}
+    for map_id in range(num_maps):
+        for k, v in _gen_records(gen, map_id):
+            by_part[part.partition(k)].append((k, v))
+    return by_part
+
+
+def _write_all(cluster, shuffle_id, num_maps, gen):
+    for map_id in range(num_maps):
+        cluster.call(map_id % cluster.n_executors, "write",
+                     shuffle_id=shuffle_id, map_id=map_id, gen=gen)
+    cluster.wait_published(shuffle_id, num_maps)
+
+
+def test_cross_process_shuffle_bit_exact(cluster):
+    """terasort-shaped records written in two executor processes read
+    back with digests identical to the parent's local recomputation."""
+    gen = {"kind": "terasort", "records": 300, "value_len": 32}
+    cluster.register(SHUFFLE, num_maps=2, partitioner=("hash", NUM_PARTS))
+    _write_all(cluster, SHUFFLE, 2, gen)
+    expected = _expected_partitions(gen, 2, NUM_PARTS)
+    total = 0
+    for p in range(NUM_PARTS):
+        out = cluster.read(p % 2, SHUFFLE, p, p + 1, digest=True)
+        want = records_digest(expected[p])
+        assert out["digest"] == want, f"partition {p} diverged"
+        total += out["records"]
+    assert total == 2 * 300
+
+
+def test_cross_process_wordcount_aggregated(cluster):
+    """sum-aggregated wordcount across processes: reduced counts equal
+    the parent-side tally (map-side combine exercises the aggregator
+    rebuilt from its declarative kind inside each child)."""
+    gen = {"kind": "wordcount", "records": 400, "vocab": 23}
+    cluster.register(SHUFFLE + 1, num_maps=2,
+                     partitioner=("hash", NUM_PARTS), aggregator="sum",
+                     map_side_combine=True)
+    _write_all(cluster, SHUFFLE + 1, 2, gen)
+    tally = {}
+    for map_id in range(2):
+        for k, v in _gen_records(gen, map_id):
+            tally[k] = tally.get(k, 0) + v
+    part = HashPartitioner(NUM_PARTS)
+    got = {}
+    for p in range(NUM_PARTS):
+        out = cluster.read(p % 2, SHUFFLE + 1, p, p + 1)
+        for k, v in out["data"]:
+            assert part.partition(k) == p
+            assert k not in got, f"key {k} emitted twice"
+            got[k] = v
+    assert got == tally
+
+
+def test_fleet_census_and_obs_collection(cluster):
+    """census() reports every live process; stop() leaves per-process
+    flight-recorder dumps the collect() merge path folds into one
+    trace document."""
+    census = cluster.census()
+    assert sorted(census["executors"]) == [0, 1]
+    for info in census["executors"].values():
+        c = info["census"]
+        assert c["pid"] != census["driver"]["pid"]
+        assert c["fds"] > 0 and c["threads"] >= 1
+        assert c["cpu_user_s"] >= 0.0
+    cluster.stop()
+    merged = cluster.collect()
+    # driver + 2 executors each dump at manager.stop()
+    assert len(merged["dump_paths"]) >= 3
+    assert len(merged["processes"]) == len(merged["dump_paths"])
+    assert len(merged["log_paths"]) == 2
+
+
+def test_executor_crash_mid_stage(cluster):
+    """SIGKILL one executor after publish: a reader needing its blocks
+    gets a clean FetchFailed (through the PR-15 retry/breaker plane,
+    now across a real process boundary) and the surviving executor
+    keeps serving fresh shuffles."""
+    gen = {"kind": "terasort", "records": 120, "value_len": 16}
+    cluster.register(SHUFFLE + 2, num_maps=2,
+                     partitioner=("hash", NUM_PARTS))
+    _write_all(cluster, SHUFFLE + 2, 2, gen)
+
+    cluster.kill(1)
+    assert not cluster.executors[1].alive
+
+    # every partition spans both maps, so executor 0's read must cross
+    # the dead peer — the failure must be FetchFailed, not a hang/pipe
+    # error, and must come back through the command protocol
+    with pytest.raises(ExecutorCommandError) as exc:
+        cluster.read(0, SHUFFLE + 2, 0, 1, timeout=120.0)
+    assert exc.value.kind == "FetchFailedError"
+
+    # surviving fleet stays healthy: a new single-map shuffle written
+    # and read wholly on executor 0 completes bit-exactly
+    cluster.call(0, "register", shuffle_id=SHUFFLE + 3, num_maps=1,
+                 partitioner=("hash", 2))
+    cluster.call(0, "write", shuffle_id=SHUFFLE + 3, map_id=0, gen=gen)
+    cluster.wait_published(SHUFFLE + 3, 1)
+    expected = _expected_partitions(gen, 1, 2)
+    for p in range(2):
+        out = cluster.read(0, SHUFFLE + 3, p, p + 1, digest=True)
+        assert out["digest"] == records_digest(expected[p])

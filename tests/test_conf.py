@@ -140,3 +140,67 @@ def test_legacy_rdma_namespace_aliases():
     assert conf.lazy_staging is True
     assert conf.driver_port == 31999
     assert conf.max_agg_block == 4 << 20
+
+
+def test_core_census_resolution(monkeypatch):
+    """coreCensus override > dispatcherCpuList pin > affinity mask;
+    the cpu_count-keyed defaults (decodeThreads, bulkPipelineWindows,
+    transportPollSpinUs, tierPrefetch) all follow the census, so a
+    CPU-pinned containerized executor gets single-core-correct
+    defaults even on a many-core machine."""
+    import sparkrdma_tpu.conf as conf_mod
+
+    # pretend a 16-core machine whose cgroup allows this process 16
+    monkeypatch.setattr(conf_mod.os, "cpu_count", lambda: 16)
+    monkeypatch.setattr(conf_mod, "host_core_census", lambda: 16)
+
+    wide = TpuShuffleConf()
+    assert wide.core_census == 16
+    assert wide.decode_threads == 4
+    assert wide.transport_poll_spin_us == 40
+    assert wide.tier_prefetch is True
+    assert wide.bulk_pipeline_windows is True
+
+    # a 1-CPU dispatcher pin shrinks every derived default to the
+    # single-core fallbacks, machine count notwithstanding
+    pinned = TpuShuffleConf({"spark.shuffle.tpu.dispatcherCpuList": "3"})
+    assert pinned.core_census == 1
+    assert pinned.decode_threads == 0
+    assert pinned.transport_poll_spin_us == 0
+    assert pinned.tier_prefetch is False
+    assert pinned.bulk_pipeline_windows is False
+
+    # explicit coreCensus beats both the pin and the mask
+    forced = TpuShuffleConf({
+        "spark.shuffle.tpu.dispatcherCpuList": "3",
+        "spark.shuffle.tpu.coreCensus": 8,
+    })
+    assert forced.core_census == 8
+    assert forced.decode_threads == 4
+
+    # garbage pin spec expands to all cores — not a pin, use the mask
+    garbage = TpuShuffleConf({"spark.shuffle.tpu.dispatcherCpuList": "zzz"})
+    assert garbage.core_census == 16
+
+    # explicit per-key settings still win over any census
+    explicit = TpuShuffleConf({
+        "spark.shuffle.tpu.dispatcherCpuList": "3",
+        "spark.shuffle.tpu.decodeThreads": 2,
+        "spark.shuffle.tpu.tierPrefetch": "true",
+    })
+    assert explicit.decode_threads == 2
+    assert explicit.tier_prefetch is True
+
+
+def test_core_census_affinity_mask(monkeypatch):
+    """The census reads the scheduler-affinity mask, not the machine
+    count — a taskset/cgroup-limited process sizes itself by what it
+    can actually run on."""
+    import sparkrdma_tpu.conf as conf_mod
+
+    monkeypatch.setattr(conf_mod.os, "cpu_count", lambda: 64)
+    monkeypatch.setattr(
+        conf_mod.os, "sched_getaffinity", lambda pid: {0, 1}, raising=False
+    )
+    assert conf_mod.host_core_census() == 2
+    assert TpuShuffleConf().core_census == 2

@@ -566,3 +566,130 @@ def test_commit_spilled_files_zero_copy(tmp_path, devices):
         assert not glob.glob(str(tmp_path / "p*")), "files leaked"
     finally:
         mgr.stop()
+
+
+# -- ISSUE 17 hot-path kernels: frame walk / CRC batch / gather -------------
+
+
+def test_native_frame_spans_matches_python_walkers(monkeypatch):
+    """The native frame walkers must agree span-for-span with the
+    serde Python loops on REAL serialized payloads, and the serializers
+    must return the identical answer with the native hook disabled
+    (the pure-Python fallback path, tested both ways)."""
+    from sparkrdma_tpu.memory import staging
+    from sparkrdma_tpu.utils.columns import ColumnBatch
+    from sparkrdma_tpu.utils.serde import (
+        ColumnarSerializer,
+        CompressedSerializer,
+        PickleSerializer,
+    )
+
+    if staging._NATIVE is None:
+        pytest.skip("native staging lib not built")
+    rng = np.random.default_rng(3)
+    pick = PickleSerializer(batch_size=16)
+    comp = CompressedSerializer(PickleSerializer(batch_size=16))
+    col = ColumnarSerializer()
+    payloads = []
+    for n in (0, 1, 15, 16, 17, 300):
+        records = [(int(k), bytes(rng.bytes(8))) for k in range(n)]
+        payloads.append((pick, pick.serialize(records)))
+        payloads.append((comp, comp.serialize(records)))
+        if n:
+            batch = ColumnBatch(
+                rng.integers(0, 99, n).astype(np.int64),
+                np.frombuffer(rng.bytes(n * 16), dtype="S16"),
+            )
+            payloads.append((col, col.serialize(batch)))
+    for ser, blob in payloads:
+        native = ser.frame_spans(blob)
+        with monkeypatch.context() as m:
+            m.setattr(staging, "native_frame_spans",
+                      lambda *a, **k: None)
+            m.setattr(staging, "native_columnar_frame_spans",
+                      lambda *a, **k: None)
+            python = ser.frame_spans(blob)
+        assert native == python, type(ser).__name__
+        if blob:
+            assert native, type(ser).__name__
+
+
+def test_native_frame_spans_rejects_garbage():
+    """Truncated/garbage buffers must come back None (negative native
+    rc) so the Python walker stays the authority for error text."""
+    from sparkrdma_tpu.memory import staging
+
+    if staging._NATIVE is None:
+        pytest.skip("native staging lib not built")
+    # truncated: header promises more bytes than the buffer holds
+    bad = (1000).to_bytes(4, "little") + b"xy"
+    assert staging.native_frame_spans(bad, 0) is None
+    assert staging.native_columnar_frame_spans(b"\xc2" + b"\x00" * 3) is None
+    # empty payloads walk to zero spans, not None
+    assert staging.native_frame_spans(b"", 0).shape == (0, 2)
+
+
+def test_native_crc32_spans_bit_exact_and_bounds_checked():
+    import zlib
+
+    from sparkrdma_tpu.memory import staging
+
+    if staging._NATIVE is None or not hasattr(staging._NATIVE,
+                                              "crc32_spans"):
+        pytest.skip("native staging lib not built")
+    rng = np.random.default_rng(5)
+    buf = rng.bytes(100_000)
+    view = memoryview(buf)
+    for trial in range(30):
+        n = int(rng.integers(1, 200))
+        a = rng.integers(0, len(buf) - 1, n)
+        b = a + rng.integers(0, 4096, n)
+        spans = np.stack([a, np.minimum(b, len(buf))], axis=1)
+        got = staging.native_crc32_spans(buf, spans)
+        assert got is not None
+        want = [zlib.crc32(view[x:y]) for x, y in spans.tolist()]
+        assert got.tolist() == want, trial
+    # bounds violations and shape mismatches fall back (None)
+    assert staging.native_crc32_spans(buf, [(0, len(buf) + 1)]) is None
+    assert staging.native_crc32_spans(buf, [(-1, 4)]) is None
+    assert staging.native_crc32_spans(buf, [(8, 4)]) is None
+    assert staging.native_crc32_spans(buf, [(1, 2, 3)]) is None
+    assert staging.native_crc32_spans(buf, np.empty((0, 2), np.int64)) \
+        .shape == (0,)
+
+
+def test_native_gather_blocks_matches_slice_assignment():
+    from sparkrdma_tpu.memory import staging
+
+    if staging._NATIVE is None or not hasattr(staging._NATIVE,
+                                              "gather_blocks"):
+        pytest.skip("native staging lib not built")
+    rng = np.random.default_rng(9)
+    for trial in range(20):
+        n_blocks = int(rng.integers(0, 60))
+        srcs = [
+            np.frombuffer(rng.bytes(int(rng.integers(1, 2000))), np.uint8)
+            for _ in range(n_blocks)
+        ]
+        lens = [len(s) for s in srcs]
+        offs, acc = [], 0
+        for ln in lens:
+            offs.append(acc)
+            acc += ln
+        want = np.empty(acc, np.uint8)
+        for s, off, ln in zip(srcs, offs, lens):
+            want[off:off + ln] = s
+        got = np.zeros(acc, np.uint8)
+        ok = staging.native_gather_blocks(
+            got, [int(s.ctypes.data) for s in srcs], lens, offs)
+        assert ok
+        assert np.array_equal(got, want), trial
+    # ineligible shapes refuse (caller keeps the numpy loop)
+    dst = np.zeros(16, np.uint8)
+    src = np.arange(8, dtype=np.uint8)
+    addr = int(src.ctypes.data)
+    assert not staging.native_gather_blocks(dst, [addr], [8], [9])  # overrun
+    assert not staging.native_gather_blocks(dst, [addr], [-1], [0])
+    assert not staging.native_gather_blocks(dst, [addr], [8], [0, 8])
+    assert not staging.native_gather_blocks(
+        np.zeros((4, 4), np.uint8), [addr], [8], [0])

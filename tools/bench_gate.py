@@ -95,12 +95,27 @@ def _metrics(doc: dict) -> dict:
     }
 
 
+def _all_metrics(doc: dict) -> dict:
+    """Flat results plus any per-process-count tiers: the cluster
+    bench nests ``"clusters": {"2": {"results": [...]}, ...}`` so a
+    2-process and an 8-process run of the same metric gate
+    independently — fold each tier in under an ``[Nproc]`` prefix."""
+    out = _metrics(doc)
+    clusters = doc.get("clusters")
+    if isinstance(clusters, dict):
+        for nproc, sub in sorted(clusters.items()):
+            if isinstance(sub, dict):
+                for metric, rec in _metrics(sub).items():
+                    out[f"[{nproc}proc] {metric}"] = rec
+    return out
+
+
 def gate_file(path: pathlib.Path, pct: float):
     """(failures, notes) for one bench file."""
     failures, notes = [], []
     name = path.name
     try:
-        fresh = _metrics(json.loads(path.read_text()))
+        fresh = _all_metrics(json.loads(path.read_text()))
     except (OSError, ValueError) as e:
         failures.append(f"{name}: unreadable fresh file ({e})")
         return failures, notes
@@ -108,7 +123,7 @@ def gate_file(path: pathlib.Path, pct: float):
     if base_doc is None:
         notes.append(f"{name}: no committed baseline (new bench) — skipped")
         return failures, notes
-    base = _metrics(base_doc)
+    base = _all_metrics(base_doc)
     for metric, rec in fresh.items():
         if metric not in base:
             notes.append(f"{name}: new metric {metric!r} — skipped")
