@@ -57,6 +57,8 @@ bench-smoke:
 	python benchmarks/bench_skew.py
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_cluster.py
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	python benchmarks/bench_push.py
 	python tools/bench_gate.py
 	$(MAKE) chaos
 

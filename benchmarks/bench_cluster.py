@@ -274,7 +274,13 @@ def main():
                 gen, n_procs, port)
             port += 100
     for n_procs in PROC_COUNTS:
-        tier = {"results": [], "workloads": {}}
+        # multi-process numbers are only meaningful with real cores
+        # under them: tools/bench_gate.py skips the tier (with a note)
+        # on hosts below min_cores instead of gating timeslice noise
+        tier = {
+            "results": [], "workloads": {},
+            "min_cores": 2 if n_procs >= 2 else 0,
+        }
         for name, gen in WORKLOADS.items():
             run = cluster_run(n_procs, gen, port)
             port += 1000

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Push-based merged shuffle bench tier (ISSUE 18): many maps × many
+reduces over a real 2-process ``ProcessCluster``, push vs pull.
+
+The workload is the paper's worst case for reducer-pull: 64 maps × 64
+reduce partitions (SMOKE: 16×16) of deliberately SMALL per-(map,
+reduce) blocks sized near ``shuffleReadBlockSize``, so the pull plan
+cannot amortize — every remote block is roughly one grouped fetch RPC
+and a reduce task issues one per remote map.  Push mode moves the
+same bytes at commit and each reduce task fetches ONE merged
+sequential span instead (local blocks ride the same merged span, so
+its RPC count is flat in M).
+
+Both modes run the identical generated dataset (terasort records,
+deterministic per-map seed) on a fresh 2-executor process fleet; every
+partition's order-independent digest must agree between modes — the
+bit-exactness line the test suite proves, re-checked at bench scale.
+
+Reported:
+
+- reader data-RPC count per mode (the ``shuffle_fetch_rpcs_total``
+  counter delta over the read phase, summed across executor
+  processes) and the pull:push ratio — acceptance is ≥10×,
+- read-phase wall clock per mode, nested under a ``min_cores: 2``
+  cluster tier so 1-core hosts report but never gate the overlap
+  number (``tools/bench_gate.py`` skips with a note).
+
+Emits ``BENCH_push.json``.
+
+    BENCH_SMOKE=1 python benchmarks/bench_push.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+from benchmarks.common import emit, write_bench_json
+
+SMOKE = bool(os.environ.get("BENCH_SMOKE"))
+
+NUM_MAPS = 16 if SMOKE else 64
+NUM_PARTS = 16 if SMOKE else 64
+# terasort records are ~115 B pickled; size each per-(map, reduce)
+# block just OVER the 16k read-block floor so pull degenerates to one
+# RPC per block — the small-random-IO regime push exists to collapse
+RECORDS_PER_MAP = 2600 if SMOKE else 9600
+READ_BLOCK = "16k"
+
+BASE_PORT = 23600
+SHUFFLE_ID = 18
+
+
+def _conf(push: bool) -> dict:
+    pfx = "spark.shuffle.tpu."
+    return {
+        pfx + "metrics": True,
+        pfx + "pushEnabled": push,
+        pfx + "shuffleReadBlockSize": READ_BLOCK,
+        pfx + "partitionLocationFetchTimeout": "120s",
+        pfx + "connectTimeout": "15s",
+    }
+
+
+def _fetch_rpcs(cluster) -> dict:
+    """{mode: count} of ``shuffle_fetch_rpcs_total`` summed across the
+    executor processes (readers run there, not on the driver)."""
+    out = {}
+    for ex in cluster.executors:
+        snap = ex.call("metrics", timeout=60.0).get("metrics") or {}
+        for c in snap.get("counters", []):
+            if c["name"] == "shuffle_fetch_rpcs_total":
+                mode = c["labels"].get("mode", "?")
+                out[mode] = out.get(mode, 0) + c["value"]
+    return out
+
+
+def _run_mode(push: bool, base_port: int):
+    """One full write→read job on a fresh 2-process fleet.  Returns
+    (read_wall_seconds, {mode: data_rpc_delta}, {rid: digest})."""
+    from sparkrdma_tpu.transport.simfleet import ProcessCluster
+
+    gen = {"kind": "terasort", "records": RECORDS_PER_MAP, "seed": 0xB10C}
+    with ProcessCluster(2, base_port, conf=_conf(push)) as c:
+        c.register(SHUFFLE_ID, num_maps=NUM_MAPS,
+                   partitioner=("hash", NUM_PARTS))
+        # writes overlap across the two executor processes
+        for ex in c.executors:
+            for map_id in range(ex.idx, NUM_MAPS, 2):
+                ex.send("write", shuffle_id=SHUFFLE_ID, map_id=map_id,
+                        gen=gen)
+        for ex in c.executors:
+            for _ in range(ex.idx, NUM_MAPS, 2):
+                ex.recv(timeout=300.0)
+        mbh = c.wait_published(SHUFFLE_ID, NUM_MAPS, timeout=120.0)
+        before = _fetch_rpcs(c)
+        t0 = time.perf_counter()
+        for rid in range(NUM_PARTS):
+            c.executors[rid % 2].send(
+                "read", shuffle_id=SHUFFLE_ID, start=rid, end=rid + 1,
+                maps_by_host=mbh, digest=True)
+        digests = {}
+        for rid in range(NUM_PARTS):
+            digests[rid] = c.executors[rid % 2].recv(
+                timeout=300.0)["digest"]
+        wall = time.perf_counter() - t0
+        after = _fetch_rpcs(c)
+        c.stop()
+    rpcs = {m: after.get(m, 0) - before.get(m, 0) for m in after}
+    return wall, rpcs, digests
+
+
+def main() -> int:
+    label = f"{NUM_MAPS}x{NUM_PARTS}"
+    print(f"# push bench: {label}, {RECORDS_PER_MAP} records/map, "
+          f"readBlockSize={READ_BLOCK}, 2-process fleet", flush=True)
+
+    pull_wall, pull_rpcs, pull_digests = _run_mode(False, BASE_PORT)
+    push_wall, push_rpcs, push_digests = _run_mode(True, BASE_PORT + 200)
+
+    if pull_digests != push_digests:
+        bad = [r for r in pull_digests if pull_digests[r] != push_digests[r]]
+        print(f"FATAL: push digests diverge from pull on partitions {bad}",
+              file=sys.stderr)
+        return 1
+    print(f"# digests agree on all {NUM_PARTS} partitions", flush=True)
+
+    pull_data = pull_rpcs.get("pull", 0) + pull_rpcs.get("push", 0)
+    push_data = push_rpcs.get("pull", 0) + push_rpcs.get("push", 0)
+    ratio = pull_data / push_data if push_data else float("inf")
+
+    emit(f"pull {label} reader data RPCs", pull_data, "rpcs", 1.0)
+    emit(f"push {label} reader data RPCs", push_data, "rpcs",
+         push_data / pull_data if pull_data else 0.0)
+    emit(f"push {label} RPC cut", ratio, "x", ratio / 10.0)
+    emit(f"push {label} merged-span fetches", push_rpcs.get("push", 0),
+         "rpcs", 1.0)
+    emit(f"push {label} straggler pulls", push_rpcs.get("pull", 0),
+         "rpcs", 1.0)
+
+    cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    speedup = pull_wall / push_wall if push_wall else float("inf")
+    tier = {
+        "min_cores": 2,
+        "host_note": (
+            f"measured on a {cores}-core host; the wall-clock tier is a "
+            "multi-core-only number (min_cores gates it in bench_gate)"),
+        "results": [
+            {"metric": f"pull {label} read wall", "value": round(pull_wall, 3),
+             "unit": "s", "vs_baseline": 1.0},
+            {"metric": f"push {label} read wall", "value": round(push_wall, 3),
+             "unit": "s", "vs_baseline": round(speedup, 3)},
+        ],
+        "workloads": {label: {
+            "num_maps": NUM_MAPS, "num_parts": NUM_PARTS,
+            "records_per_map": RECORDS_PER_MAP,
+            "read_block_size": READ_BLOCK,
+        }},
+    }
+    for rec in tier["results"]:
+        print(f"# [2proc] {rec['metric']}: {rec['value']} {rec['unit']}",
+              flush=True)
+    print(f"# pull/push read-wall ratio: {speedup:.2f}x "
+          f"(host cores: {cores})", flush=True)
+
+    write_bench_json(
+        "push",
+        extra={
+            "smoke": SMOKE,
+            "clusters": {"2": tier},
+        },
+        out_dir="/tmp" if SMOKE else None,
+    )
+
+    # pull only RPCs for REMOTE blocks — half the maps on a 2-executor
+    # fleet — so the ideal cut is NUM_MAPS/2 (8x at the 16x16 smoke
+    # size).  Hold the full 64x64 config to the ISSUE's 10x line and
+    # smoke to 75% of its own ideal.
+    floor = (NUM_MAPS / 2) * 0.75 if SMOKE else 10.0
+    if ratio < floor:
+        print(f"FATAL: RPC cut {ratio:.1f}x < the {floor:g}x "
+              f"acceptance line", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -789,6 +789,51 @@ class TpuShuffleConf:
         attribution — splitting itself keys off partition bytes)."""
         return self._int_in_range("skewSampleStride", 64, 1, 1 << 20)
 
+    # -- push-based merged shuffle (sparkrdma_tpu/shuffle/push.py) ----------
+    @property
+    def push_enabled(self) -> bool:
+        """Push-based merged shuffle (the magnet idiom): at commit,
+        writers push per-partition sub-blocks to deterministic
+        per-reduce-partition merger nodes, which append them into one
+        merged per-reduce span; readers resolve the merged span first
+        and fetch it as ONE large sequential read, pulling only the
+        unmerged stragglers block-by-block through the unchanged pull
+        path.  Best-effort by construction: a dropped push, a dead
+        merger, or an old-wire-version peer only means more pull
+        traffic — never wrong bytes.  Off by default: the reader plan
+        is then byte-identical to the pure pull tree."""
+        return self._bool("pushEnabled", False)
+
+    @property
+    def push_block_target(self) -> int:
+        """Target size of each pushed sub-block: partition payloads are
+        cut at serializer frame boundaries (the skew splitter's
+        ``sub_spans``) into chunks of roughly this many bytes before
+        being pushed, so no single push RPC carries an unbounded
+        frame train."""
+        return self._bytes_in_range("pushBlockTarget", 512 << 10,
+                                    4 << 10, 1 << 30)
+
+    @property
+    def push_merge_timeout_ms(self) -> int:
+        """Reader-side bound on the merged-location query: mergers that
+        have not answered the merge-status RPC within this window are
+        treated as offering no merged coverage and their partitions
+        fall back to the pull path (best-effort push, bounded reader
+        latency)."""
+        return self._time_ms("pushMergeTimeout", 2000)
+
+    @property
+    def push_max_merged_bytes(self) -> int:
+        """Per-(shuffle, reduce-partition) cap on merged bytes a merger
+        will accept.  Sub-blocks arriving over the cap are dropped
+        (counted ``push_drops_total{reason="cap"}``) and their map
+        outputs served
+        by the pull fallback — a merger never balloons past its
+        provisioned spill budget because one reduce key ran hot."""
+        return self._bytes_in_range("pushMaxMergedBytes", 256 << 20,
+                                    1 << 20, 1 << 40)
+
     # -- observability ------------------------------------------------------
     @property
     def metrics_http_port(self) -> int:

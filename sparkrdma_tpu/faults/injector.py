@@ -63,6 +63,8 @@ KNOWN_POINTS = (
     "publish",       # executor -> driver map-output publish
     "location_rpc",  # reader -> driver location fetch
     "heartbeat",     # decision point: drop a driver heartbeat probe
+    "push_merge",    # merger rx: drop an arriving pushed sub-block
+    "merge_status",  # merger rx: fail a merge-status query (dead merger)
 )
 
 

@@ -28,6 +28,8 @@ EVENTS = {
         "fetch_fail",         # fetch group failed terminally
         "decode_wait",        # reader blocked on a decode ticket
         "consume_wait",       # reader blocked on the results queue
+        "merged_enqueue",     # push mode: one merged span planned
+        "merged_fallback",    # merged fetch failed -> provenance re-pulled
     ),
     "decode": (
         "credit_wait",        # decode worker waited for pool credits

@@ -48,6 +48,9 @@ type  class                                  direction
  10   PublishShuffleMetricsMsg               executor → driver
  11   PrefetchHintMsg                        reader → serving executor
  12   CleanShuffleMsg                        driver → all executors
+ 13   PushSubBlockMsg                        writer → merger executor
+ 14   FetchMergeStatusMsg                    reader → merger executor
+ 15   MergeStatusResponseMsg                 merger → reader
 ====  =====================================  ===========================
 
 Types 8-9 carry the BULK-SYNCHRONOUS collective shuffle plan: after the
@@ -1005,6 +1008,184 @@ class ExchangePlanMsg(RpcMsg):
         )
 
 
+#: Wire generation that introduced the push/merge messages (types
+#: 13-15).  Senders gate on the channel's NEGOTIATED version — an older
+#: peer never merges, every one of its blocks rides the pull path
+#: (``wire_version`` 0 = unversioned/in-process = current build).
+PUSH_MIN_WIRE_VERSION = 3
+
+
+@dataclass(frozen=True)
+class PushSubBlockMsg(RpcMsg):
+    """Writer pushes one span of a map task's partition payload to that
+    reduce partition's deterministic merger executor (the magnet idiom;
+    lineage: the reference's RdmaShuffleWriter commits then serves pull
+    reads — push inverts the data motion at the same commit point).
+
+    The merger assembles purely by ``(offset, data)`` against
+    ``total_len``: a message carries bytes ``[offset, offset+len(data))``
+    of the partition's full payload, so re-segmentation (``_split``),
+    duplicated frames from a retried map task, and out-of-order arrival
+    all converge to the same assembled bytes.  NEW wire type (v3): sends
+    are gated on the peer's negotiated wire version, and an old peer
+    that somehow receives one drops it as an unknown-type frame —
+    best-effort push, never a protocol error."""
+
+    sender: ShuffleManagerId
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+    total_len: int
+    offset: int
+    data: bytes
+
+    MSG_TYPE = 13
+    WIRE_SCHEMA = (
+        F.smid("sender"),
+        F.i32("shuffle_id"),
+        F.i32("map_id"),
+        F.i32("reduce_id"),
+        F.i32("total_len"),
+        F.i32("offset"),
+        F.bytes_rest("data"),
+    )
+
+    def __post_init__(self):
+        if not (0 <= self.offset
+                and self.offset + len(self.data) <= self.total_len):
+            raise ValueError(
+                f"push span [{self.offset},{self.offset + len(self.data)})"
+                f" outside total_len {self.total_len}"
+            )
+
+    def _split(self, max_payload: int) -> Sequence["PushSubBlockMsg"]:
+        fixed = self._payload_size() - len(self.data)
+        per_seg = max(1, max_payload - fixed)
+        parts: List[PushSubBlockMsg] = []
+        for start in range(0, len(self.data), per_seg):
+            parts.append(
+                PushSubBlockMsg(
+                    self.sender, self.shuffle_id, self.map_id,
+                    self.reduce_id, self.total_len,
+                    self.offset + start,
+                    self.data[start : start + per_seg],
+                )
+            )
+        return parts
+
+
+@dataclass(frozen=True)
+class FetchMergeStatusMsg(RpcMsg):
+    """Reader asks a merger executor which of ``reduce_ids`` it holds
+    merged spans for; the answer (one :class:`MergeStatusResponseMsg`
+    per reduce id, or a :class:`FetchMapStatusFailedMsg`) is routed
+    through ``callback_id``.  Querying seals the merged spans: the
+    merger commits what it has and pushes arriving late sub-blocks to
+    the pull path from then on."""
+
+    requester: ShuffleManagerId
+    shuffle_id: int
+    callback_id: int
+    reduce_ids: Tuple[int, ...]
+
+    MSG_TYPE = 14
+    WIRE_SCHEMA = (
+        F.smid("requester"),
+        F.i32("shuffle_id"),
+        F.i32("callback_id"),
+        F.list("reduce_ids", "<i"),
+    )
+
+    def __init__(self, requester, shuffle_id, callback_id, reduce_ids):
+        object.__setattr__(self, "requester", requester)
+        object.__setattr__(self, "shuffle_id", shuffle_id)
+        object.__setattr__(self, "callback_id", callback_id)
+        object.__setattr__(self, "reduce_ids",
+                           tuple(int(r) for r in reduce_ids))
+
+    def _split(self, max_payload: int) -> Sequence["FetchMergeStatusMsg"]:
+        fixed = self._payload_size() - _I32.size * len(self.reduce_ids)
+        per_seg = max(1, (max_payload - fixed) // _I32.size)
+        return [
+            FetchMergeStatusMsg(
+                self.requester, self.shuffle_id, self.callback_id,
+                self.reduce_ids[i : i + per_seg],
+            )
+            for i in range(0, len(self.reduce_ids), per_seg)
+        ]
+
+
+@dataclass(frozen=True)
+class MergeStatusResponseMsg(RpcMsg):
+    """Merger's answer for ONE reduce partition, following the
+    fetch-status response convention: ``total`` is the queried reduce-id
+    count, ``index`` this answer's position, so the requester knows when
+    the set is complete regardless of arrival order.  ``mkey == 0``
+    means no merged data for this reduce id (everything pulls).
+    ``provenance`` lists the merged span's constituent map outputs as
+    ``(map_id, rel_off, rel_len)`` rows — relative to the span start —
+    so the reader both knows which (map, reduce) blocks the span covers
+    (the rest fall back to pull) and can slice the fetched span back
+    into per-map blocks for the bit-exact k-way merge.
+
+    Wide provenance splits across segments: every fragment repeats the
+    fixed header and carries ``rows_total`` (the whole span's row
+    count), so the requester accumulates rows until a reduce id's set
+    is full — same sub-range scheme the publish path uses."""
+
+    callback_id: int
+    total: int
+    index: int
+    reduce_id: int
+    mkey: int
+    length: int
+    provenance: Tuple[Tuple[int, int, int], ...]  # (map_id, rel_off, rel_len)
+    rows_total: int = -1  # rows in the whole answer; -1 → len(provenance)
+
+    MSG_TYPE = 15
+    WIRE_SCHEMA = (
+        F.i32("callback_id"),
+        F.i32("total"),
+        F.i32("index"),
+        F.i32("reduce_id"),
+        F.i32("mkey"),
+        F.scalar("length", "<q"),
+        F.i32("rows_total"),
+        F.list("provenance", "<iqq"),
+    )
+
+    def __init__(self, callback_id, total, index, reduce_id, mkey,
+                 length, provenance, rows_total=-1):
+        object.__setattr__(self, "callback_id", callback_id)
+        object.__setattr__(self, "total", total)
+        object.__setattr__(self, "index", index)
+        object.__setattr__(self, "reduce_id", reduce_id)
+        object.__setattr__(self, "mkey", mkey)
+        object.__setattr__(self, "length", length)
+        object.__setattr__(
+            self, "provenance",
+            tuple(tuple(int(x) for x in row) for row in provenance),
+        )
+        object.__setattr__(
+            self, "rows_total",
+            len(self.provenance) if rows_total < 0 else rows_total,
+        )
+
+    def _split(self, max_payload: int) -> Sequence["MergeStatusResponseMsg"]:
+        st_size = struct.calcsize("<iqq")
+        fixed = self._payload_size() - st_size * len(self.provenance)
+        per_seg = max(1, (max_payload - fixed) // st_size)
+        return [
+            MergeStatusResponseMsg(
+                self.callback_id, self.total, self.index, self.reduce_id,
+                self.mkey, self.length,
+                self.provenance[i : i + per_seg],
+                rows_total=self.rows_total,
+            )
+            for i in range(0, len(self.provenance), per_seg)
+        ]
+
+
 MSG_TYPES: Dict[int, Type[RpcMsg]] = {
     cls.MSG_TYPE: cls
     for cls in (
@@ -1020,5 +1201,8 @@ MSG_TYPES: Dict[int, Type[RpcMsg]] = {
         PublishShuffleMetricsMsg,
         PrefetchHintMsg,
         CleanShuffleMsg,
+        PushSubBlockMsg,
+        FetchMergeStatusMsg,
+        MergeStatusResponseMsg,
     )
 }

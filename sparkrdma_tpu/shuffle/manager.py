@@ -50,11 +50,15 @@ from sparkrdma_tpu.rpc.messages import (
     FetchMapStatusFailedMsg,
     FetchMapStatusMsg,
     FetchMapStatusResponseMsg,
+    FetchMergeStatusMsg,
     HeartbeatMsg,
     HelloMsg,
+    MergeStatusResponseMsg,
     PrefetchHintMsg,
     PublishMapTaskOutputMsg,
     PublishShuffleMetricsMsg,
+    PushSubBlockMsg,
+    PUSH_MIN_WIRE_VERSION,
     RpcMsg,
     WireFormatError,
     decode_msg,
@@ -62,6 +66,7 @@ from sparkrdma_tpu.rpc.messages import (
 )
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
 from sparkrdma_tpu.shuffle.partitioner import Partitioner
+from sparkrdma_tpu.shuffle.push import PushMerger
 from sparkrdma_tpu.shuffle.resolver import ShuffleBlockResolver
 from sparkrdma_tpu.shuffle.writer import ShuffleWriter
 from sparkrdma_tpu.stats import ShuffleReaderStats
@@ -220,6 +225,57 @@ class _PlanCallback:
     def __init__(self, on_plan: Callable, on_error: Callable[[str], None]):
         self.on_plan = on_plan
         self.on_error = on_error
+
+    def on_failed(self, reason: str) -> None:
+        self.on_error(reason)
+
+
+class _MergeCallback:
+    """Registry entry for a pending merge-status query (push-based
+    merged shuffle): accumulates one answer per reduce id — a wide
+    answer's provenance may split across segments, each repeating
+    ``rows_total`` — and fires ``on_status`` once every queried id has
+    a full answer.  Shares the callback id space and the negative
+    FetchMapStatusFailed path with _FetchCallback."""
+
+    def __init__(self, on_status: Callable[[Dict], None],
+                 on_error: Callable[[str], None]):
+        self.on_status = on_status
+        self.on_error = on_error
+        # reduce_id -> (mkey, length, rows_total)
+        self._meta: Dict[int, Tuple[int, int, int]] = {}  # guarded-by: _lock
+        # reduce_id -> {rel_off: (map_id, rel_off, rel_len)}
+        self._rows: Dict[int, Dict] = {}  # guarded-by: _lock
+        self._done: set = set()  # guarded-by: _lock
+        self._fired = False  # guarded-by: _lock
+        self._lock = dbg_lock("manager.merge_callback", 23)
+
+    def on_response(self, msg: MergeStatusResponseMsg) -> None:
+        with self._lock:
+            if self._fired or msg.reduce_id in self._done:
+                return
+            meta = self._meta.setdefault(
+                msg.reduce_id, (msg.mkey, msg.length, msg.rows_total)
+            )
+            rows = self._rows.setdefault(msg.reduce_id, {})
+            for row in msg.provenance:
+                rows[row[1]] = row  # rel_off-keyed: dedups resent rows
+            if len(rows) < meta[2]:
+                return  # more provenance segments in flight
+            self._done.add(msg.reduce_id)
+            if len(self._done) < msg.total:
+                return
+            self._fired = True
+            result = {
+                rid: (
+                    self._meta[rid][0], self._meta[rid][1],
+                    tuple(sorted(self._rows[rid].values(),
+                                 key=lambda r: r[1])),
+                )
+                for rid in self._done
+            }
+        # fires outside the lock — the reader enqueues fetches from it
+        self.on_status(result)
 
     def on_failed(self, reason: str) -> None:
         self.on_error(reason)
@@ -432,6 +488,15 @@ class TpuShuffleManager:
             write_block_size=conf.shuffle_write_block_size,
             direct_io=conf.direct_io,
             tier_store=self.tier_store,
+        )
+        # push-based merged shuffle (shuffle/push.py): every manager
+        # runs a merger endpoint — receiving is cheap and peers' conf
+        # may differ — but nothing arrives unless a writer with
+        # pushEnabled selects this node for a reduce partition
+        self.push_merger = PushMerger(
+            conf, self.arena, tier_store=self.tier_store,
+            node=self.node, spill_dir=conf.spill_dir,
+            direct_io=conf.direct_io,
         )
 
         # driver-side metadata (RdmaShuffleManager.scala:46-57)
@@ -671,6 +736,12 @@ class TpuShuffleManager:
             self._handle_prefetch_hint(msg)
         elif isinstance(msg, CleanShuffleMsg):
             self._handle_clean_shuffle(msg)
+        elif isinstance(msg, PushSubBlockMsg):
+            self._handle_push_sub_block(msg)
+        elif isinstance(msg, FetchMergeStatusMsg):
+            self._handle_fetch_merge_status(msg, channel)
+        elif isinstance(msg, MergeStatusResponseMsg):
+            self._handle_merge_response(msg)
 
     # -- heartbeat / failure detection ---------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -1484,6 +1555,135 @@ class TpuShuffleManager:
             logger.debug("prefetch hint to %s dropped", host.host,
                          exc_info=True)
 
+    # -- push-based merged shuffle (shuffle/push.py) --------------------------
+    def push_merger_for(self, reduce_id: int):
+        """Deterministic merger for one reduce partition: every member
+        of the fleet maps ``reduce_id`` onto the same executor from the
+        announced membership, sorted canonically — no coordination RPC.
+        A membership mismatch (joiner mid-stage) only means a writer
+        pushes where no reader will look: the blocks pull instead, and
+        the driver's clean-shuffle broadcast sweeps the orphan merge
+        state.  Falls back to SELF when no membership was announced
+        (single-manager/in-process runs merge locally)."""
+        with self._executors_lock:
+            peers = list(self._executors if self.is_driver else self._peers)
+        if not peers:
+            return self.local_smid
+        peers.sort(key=lambda s: (s.host, s.port))
+        return peers[reduce_id % len(peers)]
+
+    def push_partition(self, host, msgs) -> None:
+        """Writer-side: best-effort push of ONE partition's sub-block
+        messages to its merger (prefetch-hint posture: a failed or
+        skipped push costs pull traffic, never the commit).  Local
+        mergers short-circuit; remote sends are gated on the channel's
+        negotiated wire generation so pre-v3 peers never see type-13
+        frames."""
+        if host == self.local_smid:
+            for m in msgs:
+                self._handle_push_sub_block(m)
+            counter("push_pushes_total", target="local").inc()
+            return
+        try:
+            ch = self.node.get_channel(
+                (host.host, host.port), ChannelType.RPC_REQUESTOR,
+                self.network.connect, must_retry=False,
+            )
+            if ch.wire_version and ch.wire_version < PUSH_MIN_WIRE_VERSION:
+                counter("push_version_skips_total").inc()
+                return
+            def on_fail(e):
+                counter("push_send_failures_total").inc()
+                logger.debug("push send to %s failed: %s", host.host, e)
+            for m in msgs:
+                self._send_msg(ch, m, on_failure=on_fail)
+            counter("push_pushes_total", target="remote").inc()
+        except Exception:
+            counter("push_send_failures_total").inc()
+            logger.debug("push to %s dropped", host.host, exc_info=True)
+
+    def send_merge_query(self, host, msg: FetchMergeStatusMsg,
+                         on_failure: Callable) -> None:
+        """Reader-side: post one merge-status query to a merger.  Any
+        inability to send — a pre-v3 peer that has no merge plane, a
+        connect failure — reports through ``on_failure``, which the
+        reader treats as no coverage (pull everything)."""
+        try:
+            ch = self.node.get_channel(
+                (host.host, host.port), ChannelType.RPC_REQUESTOR,
+                self.network.connect, must_retry=False,
+            )
+            if ch.wire_version and ch.wire_version < PUSH_MIN_WIRE_VERSION:
+                counter("push_version_skips_total").inc()
+                on_failure(TransportError(
+                    f"peer {host.host} negotiated wire v{ch.wire_version} "
+                    f"< v{PUSH_MIN_WIRE_VERSION}: no merge plane"
+                ))
+                return
+            self._send_msg(ch, msg, on_failure=on_failure)
+        except Exception as e:
+            on_failure(e)
+
+    def _handle_push_sub_block(self, msg: PushSubBlockMsg) -> None:
+        self.push_merger.on_sub_block(
+            msg.shuffle_id, msg.map_id, msg.reduce_id,
+            msg.total_len, msg.offset, msg.data,
+        )
+
+    def _handle_fetch_merge_status(self, msg: FetchMergeStatusMsg,
+                                   channel: Channel) -> None:
+        """Merger side of the reader's merged-location query: seal the
+        queried reduce partitions and answer one response per id (the
+        fetch-status response convention).  Any failure — including the
+        dead-merger fault drill — replies failed, which the reader
+        treats as no coverage → pull."""
+        try:
+            answers = self.push_merger.merge_status(
+                msg.shuffle_id, msg.reduce_ids
+            )
+        except Exception as e:
+            try:
+                self._send_msg(
+                    channel.reply_channel(),
+                    FetchMapStatusFailedMsg(
+                        msg.callback_id, f"merger unavailable: {e}"
+                    ),
+                )
+            except Exception:
+                logger.debug("merge-status failure reply failed",
+                             exc_info=True)
+            return
+        total = len(answers)
+        for idx, (rid, mkey, length, prov) in enumerate(answers):
+            try:
+                self._send_msg(
+                    channel.reply_channel(),
+                    MergeStatusResponseMsg(
+                        msg.callback_id, total, idx, rid, mkey,
+                        length, prov,
+                    ),
+                )
+            except Exception:
+                logger.warning("merge-status reply failed", exc_info=True)
+                return
+
+    def _handle_merge_response(self, msg: MergeStatusResponseMsg) -> None:
+        with self._callbacks_lock:
+            cb = self._callbacks.get(msg.callback_id)
+        if cb is None or not isinstance(cb, _MergeCallback):
+            logger.warning("merge response for unknown callback %d",
+                           msg.callback_id)
+            return
+        cb.on_response(msg)
+
+    def register_merge_callback(self, on_status: Callable,
+                                on_error: Callable[[str], None]) -> int:
+        with self._callbacks_lock:
+            cb_id = self._next_callback_id
+            self._next_callback_id += 1
+            self._callbacks[cb_id] = _MergeCallback(on_status, on_error)
+        return cb_id
+
     # -- executor handlers ---------------------------------------------------
     def _handle_fetch_response(self, msg: FetchMapStatusResponseMsg) -> None:
         with self._callbacks_lock:
@@ -1873,6 +2073,9 @@ class TpuShuffleManager:
             # sample registry counters onto the Perfetto timeline at
             # every shuffle boundary (counter tracks)
             get_registry().publish_to_tracer(get_tracer())
+        # merger first: its segments release by mkey, and the
+        # resolver's arena.release_shuffle sweep must not find them
+        self.push_merger.remove_shuffle(shuffle_id)
         self.resolver.remove_shuffle(shuffle_id)
         if self.windowed_plane is not None:
             self.windowed_plane.forget(shuffle_id)
@@ -2090,6 +2293,7 @@ class TpuShuffleManager:
             decode_pool.stop()
         if self._fetch_pool is not None:
             self._fetch_pool.shutdown(wait=False)
+        self.push_merger.stop()
         self.resolver.stop()
         self.node.stop()
         self.network.unregister(self.node)

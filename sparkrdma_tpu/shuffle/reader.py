@@ -34,7 +34,7 @@ from sparkrdma_tpu.qos import BULK, INTERACTIVE
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle
 from sparkrdma_tpu.skew import is_split_marker
 from sparkrdma_tpu.transport.channel import FnCompletionListener
-from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg
+from sparkrdma_tpu.rpc.messages import FetchMapStatusMsg, FetchMergeStatusMsg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.serde import Record
@@ -123,6 +123,12 @@ class _PendingFetch:
     # and the monotonic stamp of the first one (the deadline anchor)
     attempts: int = 0
     first_failure_at: float = 0.0
+    # push-based merged shuffle (shuffle/push.py): ``(reduce_id,
+    # provenance_rows)`` when this fetch is ONE merged per-reduce span.
+    # The rows — ``(map_id, rel_off, rel_len)`` — slice the landed span
+    # back into per-map blocks, and a failure degrades exactly those
+    # (map, reduce) pairs to the pull path instead of failing the stage.
+    merged: Optional[Any] = None
 
 
 class _Result:
@@ -218,6 +224,17 @@ class ShuffleReader:
         # the remaining fetches take the fast path
         # (guarded-by: _pending_lock)
         self._breaker_probes: set = set()
+        # push-based merged shuffle (shuffle/push.py): merged-first plan
+        # state — one phase guard on _awaiting_hosts covers the whole
+        # merge-status round, so the consumer cannot observe a false
+        # idle between the queries going out and the plan settling
+        self._push_state: Optional[Dict[str, Any]] = None  # guarded-by: _pending_lock
+        self._push_timer: Optional[threading.Timer] = None
+        # every map id this reader owes output for — merged provenance
+        # rows outside this set (a speculative attempt the map-output
+        # tracker never committed) are neither consumed nor counted as
+        # coverage, so delivery stays exactly-once per (map, reduce)
+        self._expected_maps: set = set()
         self._m_fetch_latency = histogram("shuffle_remote_fetch_ms")
         self._m_local_read = histogram("shuffle_local_read_ms")
         self._m_rpc_rtt = histogram("rpc_roundtrip_ms", op="fetch_status")
@@ -246,6 +263,19 @@ class ShuffleReader:
             self._pump_registered = True
             self._inflight.add_pump(self._pump)
         reduce_ids = range(self.start_partition, self.end_partition)
+        if self.manager.conf.push_enabled:
+            # push-based merged shuffle: EVERY map output — the local
+            # short-circuit included — resolves through the merged-first
+            # plan, so coverage accounting stays uniform and each
+            # (map, reduce) pair is delivered exactly once.  A merged
+            # span freely interleaves local and remote maps' bytes;
+            # consuming it remotely while also short-circuiting locals
+            # would double-deliver, and skipping spans that contain
+            # local bytes would forfeit most of the sequential win.
+            # Local data rides transport-to-self, same as a reader that
+            # happens to BE its reduce partition's merger.
+            self._start_push_phase(reduce_ids)
+            return iter(())
         for host, map_ids in self.maps_by_host.items():
             if host == self.manager.local_smid:
                 local_map_ids.extend(map_ids)
@@ -307,6 +337,7 @@ class ShuffleReader:
         the extended table (skew/) — the driver plane serves both
         identically, which is why splitting needs zero wire change."""
         conf = self.manager.conf
+        counter("shuffle_fetch_rpcs_total", mode="location").inc()
         t0 = time.monotonic()
         timer = threading.Timer(
             conf.partition_location_fetch_timeout_ms / 1000.0,
@@ -445,6 +476,244 @@ class ShuffleReader:
                 out_tags.append(tag)
             depth += 1
         self._enqueue_fetches(host, out_locs, out_tags)
+
+    # -- push-based merged shuffle (shuffle/push.py) -------------------------
+    def _start_push_phase(self, reduce_ids) -> None:
+        """Merged-first plan: ask each reduce partition's deterministic
+        merger (manager.push_merger_for — the writers pushed there) for
+        its merged span, then pull only what the answered provenance
+        does not cover.  Best-effort throughout: an unreachable, pre-v3,
+        timed-out or fault-drilled merger simply contributes no
+        coverage, and those pairs ride the unchanged pull path —
+        bit-exact always, the stage never retries over push."""
+        mgr = self.manager
+        self._expected_maps = {
+            mid for ids in self.maps_by_host.values() for mid in ids
+        }
+        mergers: Dict[ShuffleManagerId, List[int]] = {}
+        for rid in reduce_ids:
+            m = mgr.push_merger_for(rid)
+            if m is not None:
+                mergers.setdefault(m, []).append(rid)
+        state = {
+            "remaining": len(mergers),
+            "answered": set(),   # mergers already counted (idempotence)
+            "coverage": {},      # rid -> (merger, mkey, length, prov)
+            "done": False,
+        }
+        with self._pending_lock:
+            self._push_state = state
+            # the phase guard: held until _finish_push_phase has planned
+            # every merged fetch and pull re-query
+            self._awaiting_hosts += 1
+        if not mergers:
+            self._finish_push_phase({})
+            return
+        timer = threading.Timer(
+            mgr.conf.push_merge_timeout_ms / 1000.0, self._on_push_timeout,
+        )
+        timer.daemon = True
+        self._timers.append(timer)
+        self._push_timer = timer
+        timer.start()
+        for host, rids in mergers.items():
+            self._query_merger(host, rids)
+
+    def _query_merger(self, host: ShuffleManagerId, rids: List[int]) -> None:
+        """One merge-status round against one merger.  Every failure
+        mode — send failure, merger-side MergeUnavailable, pre-v3 peer —
+        lands in ``_merger_answered`` with no coverage."""
+        mgr = self.manager
+        counter("shuffle_fetch_rpcs_total", mode="merge_status").inc()
+
+        def on_status(result, host=host):
+            self._merger_answered(host, [
+                (rid, mkey, length, prov)
+                for rid, (mkey, length, prov) in result.items()
+            ])
+
+        def on_error(reason, host=host):
+            counter("push_merge_query_failures_total").inc()
+            logger.debug("merger %s gave no coverage: %s",
+                         host.host, reason)
+            self._merger_answered(host, [])
+
+        if host == mgr.local_smid:
+            # the reader's own manager is the merger: seal and answer
+            # in-process — no reply channel to self needed.  The merged
+            # FETCH still rides the transport (to self), keeping the
+            # data path uniform.
+            try:
+                answers = mgr.push_merger.merge_status(
+                    self.handle.shuffle_id, rids)
+            except Exception as e:
+                on_error(str(e))
+                return
+            self._merger_answered(host, answers)
+            return
+        cb_id = mgr.register_merge_callback(on_status, on_error)
+        self._callback_ids.append(cb_id)
+        msg = FetchMergeStatusMsg(
+            mgr.local_smid, self.handle.shuffle_id, cb_id, rids,
+        )
+        mgr.send_merge_query(host, msg,
+                             on_failure=lambda e: on_error(str(e)))
+
+    def _merger_answered(self, host: ShuffleManagerId, answers) -> None:
+        """Fold one merger's answers into the plan; the LAST answer (or
+        the phase timeout, whichever first) settles it."""
+        with self._pending_lock:
+            state = self._push_state
+            if state["done"] or host in state["answered"]:
+                return
+            state["answered"].add(host)
+            for rid, mkey, length, prov in answers:
+                if mkey and length > 0:
+                    state["coverage"][rid] = (host, mkey, length,
+                                              tuple(prov))
+            state["remaining"] -= 1
+            if state["remaining"] > 0:
+                return
+            state["done"] = True
+            coverage = dict(state["coverage"])
+        if self._push_timer is not None:
+            self._push_timer.cancel()
+        self._finish_push_phase(coverage)
+
+    def _on_push_timeout(self) -> None:
+        """The merge-status round overran pushMergeTimeout: settle the
+        plan from whatever answered — unanswered mergers contribute no
+        coverage and their partitions pull.  Never a stage failure (the
+        metadata-timeout analog deliberately does NOT apply: push is
+        advisory, the pull plane still owns every block)."""
+        with self._pending_lock:
+            state = self._push_state
+            if state["done"]:
+                return
+            state["done"] = True
+            coverage = dict(state["coverage"])
+        counter("push_merge_timeouts_total").inc()
+        logger.warning(
+            "merge-status round timed out after %dms; "
+            "unanswered mergers fall back to pull",
+            self.manager.conf.push_merge_timeout_ms,
+        )
+        self._finish_push_phase(coverage)
+
+    def _finish_push_phase(self, coverage: Dict) -> None:
+        """The merged-first plan is settled: enqueue one sequential
+        fetch per merged span, route every uncovered (map, reduce) pair
+        through the unchanged pull path, release the phase guard."""
+        reduce_ids = range(self.start_partition, self.end_partition)
+        expected = self._expected_maps
+        covered = set()
+        for rid, (_h, _mkey, _length, prov) in coverage.items():
+            for mid, _off, _ln in prov:
+                if mid in expected:
+                    covered.add((mid, rid))
+        pull_by_host = []
+        for host, map_ids in self.maps_by_host.items():
+            pairs = [
+                (mid, rid)
+                for mid in map_ids for rid in reduce_ids
+                if (mid, rid) not in covered
+            ]
+            if pairs:
+                pull_by_host.append((host, pairs))
+        with self._pending_lock:
+            self._awaiting_hosts += len(pull_by_host)
+        for host, pairs in pull_by_host:
+            self._query_locations(
+                host, pairs,
+                lambda locs, host=host, pairs=pairs:
+                    self._on_primary_locations(host, pairs, locs),
+            )
+        for rid in sorted(coverage):
+            host, mkey, length, prov = coverage[rid]
+            self._enqueue_merged(host, rid, mkey, length, prov)
+        with self._pending_lock:
+            self._awaiting_hosts -= 1  # release the phase guard
+        self._results.put(_Result(blocks=[], host=None))
+        self._pump()
+
+    def _enqueue_merged(self, host: ShuffleManagerId, rid: int, mkey: int,
+                        length: int, prov) -> None:
+        """One merged per-reduce span as ONE pending fetch — a single
+        sequential read of the whole span, never re-grouped by
+        read_block_size (that cap shapes RANDOM pull batches; splitting
+        the sequential run would reintroduce exactly the seeks push
+        removes).  Outstanding-block accounting counts the per-map
+        blocks the span will deliver, matching the consumer's
+        per-result decrement."""
+        rows = tuple(r for r in prov if r[0] in self._expected_maps)
+        if not rows:
+            return  # nothing consumable: the pairs pulled above
+        loc = BlockLocation(0, length, mkey)
+        pf = _PendingFetch(host, [loc], length, merged=(rid, rows))
+        with self._pending_lock:
+            self._outstanding_blocks += len(rows)
+            self._pending.append(pf)
+        if RECORDER.enabled:
+            ctx = self._trace_ctx
+            fr_event(
+                "reader", "merged_enqueue",
+                trace_id=ctx.trace_id if ctx is not None else 0,
+                host=host.host, reduce_id=rid, blocks=len(rows),
+                bytes=length,
+            )
+
+    def _slice_merged(self, fetch: _PendingFetch, blocks) -> List:
+        """Slice one landed merged span back into its per-map blocks
+        (zero-copy views) along the provenance rows the plan consumed —
+        from here on they are ordinary remote blocks to the decode and
+        merge stages."""
+        _rid, rows = fetch.merged
+        payload = blocks[0]
+        view = (
+            memoryview(payload)
+            if isinstance(payload, (bytes, bytearray)) else payload
+        )
+        return [view[off:off + ln] for _mid, off, ln in rows]
+
+    def _repull_merged(self, fetch: _PendingFetch, err) -> None:
+        """A merged-span fetch failed (the merger died after planning,
+        or its breaker is open): degrade exactly its pairs to the pull
+        path — never the stage.  The span's provenance names the
+        (map, reduce) pairs this fetch owed; re-query their origin
+        hosts like a primary round."""
+        rid, rows = fetch.merged
+        counter("push_merged_fetch_fallbacks_total").inc()
+        logger.warning(
+            "merged fetch for reduce %d from %s failed (%s); "
+            "pulling its %d blocks", rid, fetch.host.host, err, len(rows),
+        )
+        if RECORDER.enabled:
+            root = self._trace_ctx
+            fr_event(
+                "reader", "merged_fallback",
+                trace_id=root.trace_id if root is not None else 0,
+                host=fetch.host.host, reduce_id=rid, blocks=len(rows),
+            )
+        owner = {
+            mid: host
+            for host, ids in self.maps_by_host.items() for mid in ids
+        }
+        by_host: Dict[ShuffleManagerId, List] = {}
+        for mid, _off, _ln in rows:
+            h = owner.get(mid)
+            if h is not None:
+                by_host.setdefault(h, []).append((mid, rid))
+        with self._pending_lock:
+            self._outstanding_blocks -= len(rows)
+            self._awaiting_hosts += len(by_host)
+        for h, pairs in by_host.items():
+            self._query_locations(
+                h, pairs,
+                lambda locs, host=h, pairs=pairs:
+                    self._on_primary_locations(host, pairs, locs),
+            )
+        self._results.put(_Result(blocks=[], host=fetch.host))
+        self._pump()
 
     def _enqueue_fetches(self, host: ShuffleManagerId,
                          locations: Sequence[BlockLocation],
@@ -604,6 +873,12 @@ class ShuffleReader:
         # the wire — the disk reads overlap the transfer instead of
         # serializing behind it
         self._send_hint(fetch.host)
+        # the push/pull RPC ledger the bench reads: one increment per
+        # read RPC actually put on the wire (retries re-count — they
+        # ARE another RPC)
+        mode = "push" if fetch.merged is not None else "pull"
+        counter("shuffle_fetch_rpcs_total", mode=mode).inc()
+        counter("shuffle_fetch_rpc_bytes", mode=mode).inc(fetch.total_bytes)
         t0 = time.monotonic()
         # per-fetch child span: carried on the read request's v2 wire
         # tail so the serving peer's events join this reader's trace
@@ -712,6 +987,10 @@ class ShuffleReader:
                     host=fetch.host.host, bytes=fetch.total_bytes,
                     us=int(latency * 1000),
                 )
+            if fetch.merged is not None:
+                # merged span: slice back into per-map blocks before
+                # the decode stage — downstream sees ordinary blocks
+                blocks = self._slice_merged(fetch, blocks)
             stream = self._decode_stream
             if stream is not None:
                 # decode-ahead: landed payloads go to the pool NOW,
@@ -736,6 +1015,14 @@ class ShuffleReader:
             self.manager.node.invalidate_read_group(
                 (fetch.host.host, fetch.host.port)
             )
+            if fetch.merged is not None:
+                # best-effort posture: a dead merger costs pull
+                # traffic, never the stage (and never a retry timer —
+                # the pull plane re-resolves from live peers directly)
+                if health is not None:
+                    health.breaker.record_failure()
+                self._repull_merged(fetch, err)
+                return
             if health is None:
                 self._fail(
                     FetchFailedError(
@@ -802,6 +1089,13 @@ class ShuffleReader:
                 self._breaker_probes.add(peer)
             if probed:
                 settle()
+                if fetch.merged is not None:
+                    # an open merger breaker is just "no merger":
+                    # degrade this span's pairs to pull
+                    self._repull_merged(
+                        fetch, "circuit breaker open for %s:%d" % peer
+                    )
+                    return
                 counter("shuffle_fetch_failures_total").inc()
                 self._fail(
                     FetchFailedError(

@@ -12,6 +12,7 @@ RdmaWrapperShuffleWriter.scala:115-149).
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
 import time
@@ -27,6 +28,7 @@ from sparkrdma_tpu.skew import (
     PartitionSketch,
     get_skew,
     plan_commit_splits,
+    sub_spans,
 )
 from sparkrdma_tpu.shuffle.partitioner import (
     HashPartitioner,
@@ -41,6 +43,8 @@ from sparkrdma_tpu.utils.columns import (
 )
 from sparkrdma_tpu.utils.serde import Record
 from sparkrdma_tpu.utils.trace import get_tracer
+
+logger = logging.getLogger(__name__)
 
 
 def _chunked_payload(length: int, chunks_fn):
@@ -607,6 +611,59 @@ class ShuffleWriter:
         )
         mgr.record_shuffle_skew(self.handle.shuffle_id, snap)
 
+    # -- push-based merged shuffle (shuffle/push.py) --------------------------
+    def _maybe_push(self, payloads) -> None:
+        """Push-mode commit hook: AFTER the local commit + publish, cut
+        each non-empty contiguous partition payload at serializer frame
+        boundaries (the skew splitter's span packer) and push the
+        sub-blocks to the partition's deterministic merger.  Strictly
+        best-effort — any failure here costs pull traffic, never the
+        commit — and strictly additive: the local segments stay
+        registered and published, so the pull path can always serve
+        every block bit-exactly."""
+        mgr = self.manager
+        if not mgr.conf.push_enabled:
+            return
+        try:
+            self._push_payloads(payloads)
+        except Exception:
+            logger.warning(
+                "push after commit failed (shuffle=%d map=%d); blocks "
+                "will be pulled", self.handle.shuffle_id, self.map_id,
+                exc_info=True,
+            )
+
+    def _push_payloads(self, payloads) -> None:
+        mgr = self.manager
+        sid = self.handle.shuffle_id
+        target = mgr.conf.push_block_target
+        max_subs = mgr.conf.skew_max_sub_blocks
+        for pid, payload in payloads.items():
+            n = len(payload)
+            if not n:
+                continue
+            host = mgr.push_merger_for(pid)
+            if host is None:
+                continue
+            try:
+                spans = sub_spans(
+                    mgr.serializer.frame_spans(payload), target, max_subs
+                )
+            except (ValueError, IndexError):
+                spans = None  # unparseable payload: push it whole
+            from sparkrdma_tpu.rpc.messages import PushSubBlockMsg
+
+            msgs = [
+                PushSubBlockMsg(
+                    mgr.local_smid, sid, self.map_id, pid, n, off,
+                    bytes(memoryview(payload[off : off + ln])),
+                )
+                for off, ln in (spans or [(0, n)])
+            ]
+            counter("push_sub_blocks_sent_total").inc(len(msgs))
+            counter("push_bytes_sent_total").inc(n)
+            mgr.push_partition(host, msgs)
+
     def _commit(self) -> MapTaskOutput:
         t0 = time.monotonic()
         serializer = self.manager.serializer
@@ -754,6 +811,9 @@ class ShuffleWriter:
         self.manager.publish_map_output(
             self.handle.shuffle_id, self.map_id, mto
         )
+        self._maybe_push({
+            p: buf[o : o + n] for p, (o, n) in enumerate(ranges) if n
+        })
         self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
         return mto
 
@@ -821,5 +881,11 @@ class ShuffleWriter:
             split_spans=split_plan,
         )
         self.manager.publish_map_output(self.handle.shuffle_id, self.map_id, mto)
+        # chunked payloads (spill merges, streamed columnar) are not
+        # frame-walkable views — their blocks stay pull-served
+        self._maybe_push({
+            pid: b for pid, b in enumerate(partition_bytes)
+            if not isinstance(b, ChunkedPayload) and len(b)
+        })
         self.metrics.write_time_ms += (time.monotonic() - t0) * 1000
         return mto

@@ -79,6 +79,10 @@ _TRACE_CTX = struct.Struct("<QQ")    # optional read-req tail: trace, span id
 #: incompatible change to framing or message layout.  v2 adds the
 #: OPTIONAL trace-context tail to read requests and the trace fields on
 #: fetch-status/prefetch RPCs (rpc/messages.py ``since=2`` fields).
+#: v3 adds the push-based merged shuffle messages (PushSubBlockMsg /
+#: FetchMergeStatusMsg / MergeStatusResponseMsg, types 13-15): push
+#: senders gate on the channel's negotiated generation, so pre-v3
+#: peers simply never merge and every block rides the pull path.
 #: Acceptors take any hello in [MIN_WIRE_VERSION, WIRE_VERSION]; a
 #: hello above/below that range is rejected STRUCTURALLY with both
 #: versions named (pre-versioning peers sent 0 in this slot, so they
@@ -86,7 +90,7 @@ _TRACE_CTX = struct.Struct("<QQ")    # optional read-req tail: trace, span id
 #: whose version it can still speak, re-dials at the acceptor's
 #: generation — the negotiated fallback — and records the channel's
 #: ``wire_version`` so v2-only bytes stay off that channel.
-WIRE_VERSION = 2
+WIRE_VERSION = 3
 
 #: Oldest wire generation this build still speaks (for both accepting
 #: older hellos and downgrading its own).

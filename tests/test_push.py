@@ -1,0 +1,294 @@
+"""Push-based merged shuffle (shuffle/push.py): bit-exactness sweep
+across transports/decode/skew, merger-death chaos (clean pull
+fallback, zero stage retries), per-map dedup, and the pushEnabled=off
+reader-plan pin."""
+
+import itertools
+import time
+from collections import defaultdict
+
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.faults.injector import FAULTS
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+from sparkrdma_tpu.shuffle import reader as reader_mod
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
+from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+
+# fresh base per cluster: clear of test_tcp (41000), test_shuffle_e2e
+# (37000/38000), the conftest ProcessCluster range (24200+), and the
+# bench port bases (23xxx/25200)
+_PORTS = itertools.count(39300, 200)
+
+NUM_MAPS, NUM_PARTS, RECORDS = 4, 6, 40
+
+
+def _counters():
+    """{(name, ((label, value), ...)): count} snapshot of the global
+    registry — counters are cumulative, so tests diff two snapshots."""
+    out = {}
+    for c in GLOBAL_REGISTRY.snapshot()["counters"]:
+        out[(c["name"], tuple(sorted(c["labels"].items())))] = c["value"]
+    return out
+
+
+def _delta(before, after, name, **labels):
+    key = (name, tuple(sorted(labels.items())))
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def _make_cluster(transport, conf_extra):
+    """Driver + executors on a fresh port base.  Loopback shares one
+    in-memory network (3 executors); the tcp variants give every
+    manager its OWN TcpNetwork — real sockets, nothing shared."""
+    base = next(_PORTS)
+    confd = {
+        "spark.shuffle.tpu.metrics": True,
+        "spark.shuffle.tpu.driverPort": base,
+        "spark.shuffle.tpu.partitionLocationFetchTimeout": "10s",
+        "spark.shuffle.tpu.connectTimeout": "5s",
+    }
+    confd.update(conf_extra)
+    if transport == "loopback":
+        net = LoopbackNetwork()
+        conf = TpuShuffleConf(confd)
+        driver = TpuShuffleManager(conf, is_driver=True, network=net)
+        executors = [
+            TpuShuffleManager(
+                conf, is_driver=False, network=net,
+                port=base + 100 + i * 10, executor_id=str(i),
+            )
+            for i in range(3)
+        ]
+    else:
+        if transport == "tcp-threaded":
+            confd["spark.shuffle.tpu.transportAsyncDispatcher"] = False
+        driver = TpuShuffleManager(
+            TpuShuffleConf(confd), is_driver=True, network=TcpNetwork(),
+            port=base, stage_to_device=False,
+        )
+        executors = [
+            TpuShuffleManager(
+                TpuShuffleConf(confd), is_driver=False, network=TcpNetwork(),
+                port=base + 100 + i * 10, executor_id=str(i),
+                stage_to_device=False,
+            )
+            for i in range(2)
+        ]
+    n = len(executors)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(len(e._peers) == n for e in executors):
+            break
+        time.sleep(0.01)
+    return driver, executors
+
+
+def _run_job(driver, executors, shuffle_id=0):
+    """Write NUM_MAPS maps round-robin, read every partition round-robin.
+    Returns ({key: sorted values}, expected dict of the same shape)."""
+    part = HashPartitioner(NUM_PARTS)
+    handle = driver.register_shuffle(shuffle_id, NUM_MAPS, part)
+    records_per_map = [
+        [(f"k{j}", (m, j)) for j in range(RECORDS)] for m in range(NUM_MAPS)
+    ]
+    maps_by_host = defaultdict(list)
+    for map_id, records in enumerate(records_per_map):
+        ex = executors[map_id % len(executors)]
+        w = ex.get_writer(handle, map_id)
+        w.write(records)
+        w.stop(True)
+        maps_by_host[ex.local_smid].append(map_id)
+    got = {}
+    for pid in range(NUM_PARTS):
+        rd = executors[pid % len(executors)].get_reader(
+            handle, pid, pid + 1, dict(maps_by_host))
+        for k, v in rd.read():
+            got.setdefault(k, []).append(v)
+    expected = defaultdict(list)
+    for recs in records_per_map:
+        for k, v in recs:
+            expected[k].append(v)
+    return (
+        {k: sorted(v) for k, v in got.items()},
+        {k: sorted(v) for k, v in expected.items()},
+    )
+
+
+def _run_cluster(transport, conf_extra, shuffle_id=0):
+    driver, executors = _make_cluster(transport, conf_extra)
+    try:
+        return _run_job(driver, executors, shuffle_id)
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+# -- bit-exactness sweep --------------------------------------------------
+
+SWEEP = [
+    (t, dt, skew)
+    for t in ("loopback", "tcp-threaded", "tcp-async")
+    for dt in (0, 4)
+    for skew in (False, True)
+]
+
+
+@pytest.mark.parametrize(
+    "transport,decode_threads,skew", SWEEP,
+    ids=[f"{t}-dt{d}-{'skew' if s else 'noskew'}" for t, d, s in SWEEP])
+def test_push_bit_exact_sweep(transport, decode_threads, skew):
+    """Push mode returns exactly the pull answer on every transport ×
+    decodeThreads × skew combination, and the merge plane actually
+    engaged (this is a push run, not a silent pull fallback)."""
+    extra = {
+        "spark.shuffle.tpu.pushEnabled": True,
+        "spark.shuffle.tpu.decodeThreads": decode_threads,
+    }
+    if skew:
+        extra["spark.shuffle.tpu.skewEnabled"] = True
+        extra["spark.shuffle.tpu.skewSplitThreshold"] = 4096
+    before = _counters()
+    got, expected = _run_cluster(transport, extra)
+    after = _counters()
+    assert got == expected
+    assert _delta(before, after, "push_sub_blocks_total") > 0
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="merge_status") > 0
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="push") > 0
+    assert _delta(before, after, "shuffle_fetch_failures_total") == 0
+
+
+def test_push_vs_pull_same_answer_loopback():
+    """Direct A/B: the same job with push on and push off produces the
+    identical {key: sorted values} dict."""
+    pull, expected = _run_cluster("loopback", {})
+    push, _ = _run_cluster(
+        "loopback", {"spark.shuffle.tpu.pushEnabled": True}, shuffle_id=1)
+    assert pull == expected
+    assert push == pull
+
+
+# -- chaos: merger death & lossy merge plane ------------------------------
+
+@pytest.fixture()
+def clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def test_merger_dead_falls_back_to_pull(clean_faults):
+    """Every merge-status query fails (dead merger drill): the stage
+    completes bit-exact through the unchanged pull path with ZERO
+    fetch failures — push is best-effort, never a stage retry."""
+    before = _counters()
+    got, expected = _run_cluster("loopback", {
+        "spark.shuffle.tpu.pushEnabled": True,
+        "spark.shuffle.tpu.faultInject": "merge_status:nth=1",
+    })
+    after = _counters()
+    assert got == expected
+    assert _delta(before, after, "push_merge_query_failures_total") > 0
+    assert _delta(before, after, "shuffle_fetch_failures_total") == 0
+    # nothing merged was served — the whole read went over pull
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="push") == 0
+
+
+def test_lossy_merge_plane_pulls_stragglers(clean_faults):
+    """Half the pushed sub-blocks are dropped at the merger rx: the
+    reader serves merged coverage where it exists and pulls the
+    unmerged stragglers — still bit-exact, still zero failures."""
+    before = _counters()
+    got, expected = _run_cluster("loopback", {
+        "spark.shuffle.tpu.pushEnabled": True,
+        "spark.shuffle.tpu.faultInject": "push_merge:nth=2;seed=7",
+    })
+    after = _counters()
+    assert got == expected
+    assert _delta(before, after, "push_drops_total", reason="fault") > 0
+    assert _delta(before, after, "shuffle_fetch_failures_total") == 0
+    # both planes carried data: merged spans AND straggler pulls
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="push") > 0
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="pull") > 0
+
+
+# -- dedup under retried maps ---------------------------------------------
+
+def test_merger_dedups_retried_map():
+    """A retried map re-pushing its partition merges ONCE: the second
+    arrival drops as a dup and provenance lists the map a single time."""
+    driver, executors = _make_cluster("loopback", {
+        "spark.shuffle.tpu.pushEnabled": True,
+    })
+    try:
+        merger = executors[0].push_merger
+        before = _counters()
+        merger.on_sub_block(99, 5, 0, 6, 0, b"abcdef")
+        merger.on_sub_block(99, 5, 0, 6, 0, b"abcdef")  # the retry
+        after = _counters()
+        assert _delta(before, after, "push_drops_total", reason="dup") == 1
+        [(rid, mkey, length, prov)] = merger.merge_status(99, [0])
+        assert rid == 0 and mkey != 0 and length == 6
+        assert [row[0] for row in prov] == [5]  # map 5 exactly once
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+
+
+# -- pushEnabled=off: the reader plan is untouched ------------------------
+
+def test_push_off_reader_plan_identical(monkeypatch):
+    """With pushEnabled=off (the default) the reader issues exactly
+    the pre-push location plan — every remote (map, reduce) pair,
+    nothing more — and never touches the merge plane."""
+    recorded = []
+    orig = reader_mod.ShuffleReader._query_locations
+
+    def spy(self, host, pairs, on_ok):
+        recorded.append((host, tuple(sorted(pairs))))
+        return orig(self, host, pairs, on_ok)
+
+    monkeypatch.setattr(reader_mod.ShuffleReader, "_query_locations", spy)
+
+    driver, executors = _make_cluster("loopback", {})
+    before = _counters()
+    try:
+        part = HashPartitioner(NUM_PARTS)
+        handle = driver.register_shuffle(0, NUM_MAPS, part)
+        maps_by_host = defaultdict(list)
+        for map_id in range(NUM_MAPS):
+            ex = executors[map_id % len(executors)]
+            w = ex.get_writer(handle, map_id)
+            w.write([(f"k{j}", (map_id, j)) for j in range(RECORDS)])
+            w.stop(True)
+            maps_by_host[ex.local_smid].append(map_id)
+        expected_calls = []
+        for pid in range(NUM_PARTS):
+            ex = executors[pid % len(executors)]
+            rd = ex.get_reader(handle, pid, pid + 1, dict(maps_by_host))
+            n = sum(1 for _ in rd.read())
+            assert n > 0
+            for host, mids in maps_by_host.items():
+                if host == ex.local_smid:
+                    continue  # local blocks short-circuit, never queried
+                expected_calls.append(
+                    (host, tuple(sorted((mid, pid) for mid in mids))))
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+    after = _counters()
+    plan_key = lambda c: (c[0].host, c[0].port, c[1])  # noqa: E731
+    assert sorted(recorded, key=plan_key) == \
+        sorted(expected_calls, key=plan_key)
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="push") == 0
+    assert _delta(before, after,
+                  "shuffle_fetch_rpcs_total", mode="merge_status") == 0
+    assert _delta(before, after, "push_sub_blocks_total") == 0

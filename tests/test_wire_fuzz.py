@@ -17,9 +17,12 @@ from sparkrdma_tpu.rpc.messages import (
     CleanShuffleMsg,
     FetchMapStatusFailedMsg,
     FetchMapStatusMsg,
+    FetchMergeStatusMsg,
     HeartbeatMsg,
     HelloMsg,
+    MergeStatusResponseMsg,
     PrefetchHintMsg,
+    PushSubBlockMsg,
     WireFormatError,
     decode_msg,
 )
@@ -61,6 +64,12 @@ def _corpus():
                 _smid(3), _smid(4), 1, 9, block_ids=[(0, 1), (2, 3)]
             ),
             PrefetchHintMsg(2, locations=[BlockLocation(0, 64, 5)]),
+            # push-based merged shuffle (wire v3, types 13-15)
+            PushSubBlockMsg(_smid(5), 1, 2, 3, 128, 64, b"\x5a" * 64),
+            FetchMergeStatusMsg(_smid(6), 4, 17, (0, 3, 9)),
+            MergeStatusResponseMsg(
+                17, 2, 0, 3, 8, 2048, ((0, 0, 1024), (1, 1024, 1024))
+            ),
         )
     ]
 

@@ -19,11 +19,14 @@ from sparkrdma_tpu.rpc.messages import (
     FetchMapStatusFailedMsg,
     FetchMapStatusMsg,
     FetchMapStatusResponseMsg,
+    FetchMergeStatusMsg,
     HeartbeatMsg,
     HelloMsg,
+    MergeStatusResponseMsg,
     PrefetchHintMsg,
     PublishMapTaskOutputMsg,
     PublishShuffleMetricsMsg,
+    PushSubBlockMsg,
     decode_msg,
 )
 from sparkrdma_tpu.utils.types import (
@@ -175,6 +178,35 @@ CASES = [
     # CleanShuffleMsg
     CleanShuffleMsg(0),
     CleanShuffleMsg(I32_MAX),
+    # PushSubBlockMsg (push-based merged shuffle, wire v3)
+    PushSubBlockMsg(smid(8), 0, 0, 0, total_len=0, offset=0, data=b""),
+    PushSubBlockMsg(
+        smid(8), I32_MAX, I32_MAX, I32_MAX,
+        total_len=I32_MAX, offset=I32_MAX - 7, data=b"\xff" * 7,
+    ),
+    PushSubBlockMsg(
+        smid(8), 1, 2, 3, total_len=1 << 20, offset=4096,
+        data=bytes(range(256)) * 64,
+    ),
+    # FetchMergeStatusMsg
+    FetchMergeStatusMsg(smid(9), 0, 0, reduce_ids=()),
+    FetchMergeStatusMsg(smid(9), I32_MAX, I32_MAX, reduce_ids=(I32_MAX,)),
+    FetchMergeStatusMsg(smid(9), 1, 2, reduce_ids=tuple(range(4096))),
+    # MergeStatusResponseMsg
+    MergeStatusResponseMsg(0, 0, 0, 0, 0, 0, provenance=()),
+    MergeStatusResponseMsg(
+        I32_MAX, I32_MAX, I32_MAX, I32_MAX, I32_MAX, 2**63 - 1,
+        provenance=((I32_MAX, 2**63 - 1, -1),),
+    ),
+    MergeStatusResponseMsg(
+        7, 3, 1, 5, 42, 64 * 4096,
+        provenance=tuple((m, m * 4096, 4096) for m in range(64)),
+    ),
+    # a re-assembly fragment: rows_total > len(provenance)
+    MergeStatusResponseMsg(
+        7, 3, 1, 5, 42, 64 * 4096,
+        provenance=((0, 0, 4096),), rows_total=64,
+    ),
 ]
 
 

@@ -22,6 +22,14 @@ from HEAD (``git diff --name-only``) — the ``make bench-smoke`` wiring.
 Files new to the tree (no committed baseline yet) and metrics new to a
 file are noted and skipped, never failed.
 
+A bench doc (or one of its per-process-count ``clusters`` tiers) may
+declare ``"min_cores": N``: its metrics were measured with real
+parallelism and are meaningless on a smaller host, so on hosts with
+fewer cores they are skipped with an explicit note instead of gating
+garbage (the 1-core CI hosts would otherwise "regress" every
+multi-process number).  Host cores = the scheduling affinity mask when
+available, else ``os.cpu_count()``.
+
 Knobs (documented in the README "Observability" section):
 
 - ``BENCH_GATE_PCT`` — allowed regression percent (default 35: the
@@ -88,6 +96,23 @@ def _changed_bench_files():
     return [ROOT / line for line in out.stdout.splitlines() if line]
 
 
+def _host_cores() -> int:
+    """Cores this process may actually schedule on — the affinity mask
+    when the platform exposes it (a containerized CI host often pins
+    fewer cores than it advertises), else ``os.cpu_count()``."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _min_cores(doc: dict) -> int:
+    try:
+        return int(doc.get("min_cores", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 def _metrics(doc: dict) -> dict:
     return {
         r["metric"]: r for r in doc.get("results", [])
@@ -99,14 +124,22 @@ def _all_metrics(doc: dict) -> dict:
     """Flat results plus any per-process-count tiers: the cluster
     bench nests ``"clusters": {"2": {"results": [...]}, ...}`` so a
     2-process and an 8-process run of the same metric gate
-    independently — fold each tier in under an ``[Nproc]`` prefix."""
-    out = _metrics(doc)
+    independently — fold each tier in under an ``[Nproc]`` prefix.
+    Each record carries the strictest ``min_cores`` declared on its
+    doc/tier as ``_min_cores``."""
+    doc_min = _min_cores(doc)
+    out = {
+        metric: dict(rec, _min_cores=doc_min)
+        for metric, rec in _metrics(doc).items()
+    }
     clusters = doc.get("clusters")
     if isinstance(clusters, dict):
         for nproc, sub in sorted(clusters.items()):
             if isinstance(sub, dict):
+                tier_min = max(doc_min, _min_cores(sub))
                 for metric, rec in _metrics(sub).items():
-                    out[f"[{nproc}proc] {metric}"] = rec
+                    out[f"[{nproc}proc] {metric}"] = dict(
+                        rec, _min_cores=tier_min)
     return out
 
 
@@ -124,7 +157,14 @@ def gate_file(path: pathlib.Path, pct: float):
         notes.append(f"{name}: no committed baseline (new bench) — skipped")
         return failures, notes
     base = _all_metrics(base_doc)
+    cores = _host_cores()
     for metric, rec in fresh.items():
+        req = int(rec.get("_min_cores", 0) or 0)
+        if req > cores:
+            notes.append(
+                f"{name}: {metric}: needs >= {req} cores, host has "
+                f"{cores} — skipped (multi-core-only number)")
+            continue
         if metric not in base:
             notes.append(f"{name}: new metric {metric!r} — skipped")
             continue

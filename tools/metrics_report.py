@@ -487,6 +487,84 @@ def render_skew(counters: list, hists: list) -> list:
     return out
 
 
+def render_push(counters: list) -> list:
+    """Push-based merged shuffle census (shuffle/push.py): the writer's
+    push fan-out (sub-blocks and bytes pushed, local vs remote merger
+    targets), the merger's assembly outcome (blocks and bytes merged,
+    drops by reason — dup/late/cap/fault), and the reader's resulting
+    RPC mix: fetch RPCs by mode (pull / push / location / merge_status)
+    with the bytes they moved — the table the M×R→sequential claim is
+    read off — plus the degradation rows (version skips, send failures,
+    merge-status timeouts, merged-fetch fallbacks).  Renders nothing
+    when push never engaged."""
+    vals: dict = {}
+    drops: dict = {}
+    pushes: dict = {}
+    rpcs: dict = {}
+    rpc_bytes: dict = {}
+    for c in counters:
+        labels = c.get("labels") or {}
+        if c["name"] == "push_drops_total" and "reason" in labels:
+            drops[labels["reason"]] = (
+                drops.get(labels["reason"], 0.0) + c["value"])
+        elif c["name"] == "push_pushes_total" and "target" in labels:
+            pushes[labels["target"]] = (
+                pushes.get(labels["target"], 0.0) + c["value"])
+        elif c["name"] == "shuffle_fetch_rpcs_total" and "mode" in labels:
+            rpcs[labels["mode"]] = rpcs.get(labels["mode"], 0.0) + c["value"]
+        elif c["name"] == "shuffle_fetch_rpc_bytes" and "mode" in labels:
+            rpc_bytes[labels["mode"]] = (
+                rpc_bytes.get(labels["mode"], 0.0) + c["value"])
+        elif not labels:
+            vals[c["name"]] = c["value"]
+    pushed = vals.get("push_sub_blocks_sent_total", 0)
+    merged = vals.get("push_merged_blocks_total", 0)
+    if not pushed and not merged and not pushes:
+        return []
+    out = ["push-based merged shuffle (shuffle/push.py)"]
+    out.append(
+        f"  pushed: {pushed:,.0f} sub-block(s), "
+        f"{_fmt_num(vals.get('push_bytes_sent_total', 0))}B  "
+        f"(partitions local={pushes.get('local', 0):,.0f} "
+        f"remote={pushes.get('remote', 0):,.0f})"
+    )
+    out.append(
+        f"  merged: {merged:,.0f} block(s), "
+        f"{_fmt_num(vals.get('push_merged_bytes_total', 0))}B"
+    )
+    if drops:
+        per = "  ".join(
+            f"{r}={n:,.0f}" for r, n in sorted(drops.items()))
+        out.append(f"  merger drops: {per}")
+    if rpcs:
+        out.append("  reader fetch RPCs by mode:")
+        for mode in sorted(rpcs):
+            by = rpc_bytes.get(mode)
+            tail = f"  {_fmt_num(by)}B" if by else ""
+            out.append(f"    {mode:<13} {rpcs[mode]:>10,.0f}{tail}")
+        pull, push = rpcs.get("pull", 0), rpcs.get("push", 0)
+        if pull and push:
+            # the headline: merged spans fetched vs the random pulls
+            # that still happened — pure-push runs show pull=0 instead
+            out.append(
+                f"    push:pull ratio 1:{pull / push:.1f}"
+            )
+    degraded = []
+    for name, label in (
+        ("push_version_skips_total", "pre-v3 skips"),
+        ("push_send_failures_total", "send failures"),
+        ("push_merge_query_failures_total", "query failures"),
+        ("push_merge_timeouts_total", "status timeouts"),
+        ("push_merged_fetch_fallbacks_total", "fetch fallbacks"),
+    ):
+        n = vals.get(name, 0)
+        if n:
+            degraded.append(f"{label}={n:,.0f}")
+    if degraded:
+        out.append(f"  degradations: {'  '.join(degraded)}")
+    return out
+
+
 def render_recovery(counters: list) -> list:
     """Recovery census (faults/ + the reader retry plane): injected
     faults per point (conf ``faultInject``), in-task fetch retries and
@@ -651,6 +729,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_tier(counters, gauges))
     lines.extend(render_resources(counters, gauges))
     lines.extend(render_skew(counters, hists))
+    lines.extend(render_push(counters))
     lines.extend(render_recovery(counters))
     lines.extend(render_wire_health(counters))
     lines.extend(render_obs_health(counters))
