@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck flowcheck wirecheck test bench bench-smoke bench-cluster chaos dryrun clean
+.PHONY: all native lint concheck flowcheck wirecheck statecheck test bench bench-smoke bench-cluster chaos chaos-shake dryrun clean
 
 all: native
 
@@ -10,13 +10,15 @@ native:
 # style gate failing the build — the checkstyle/scalastyle analog
 # (reference pom.xml:93-141 runs both at validate, failsOnError=true)
 # — plus the concurrency lock-discipline gate (tools/concheck.py),
-# the resource-lifecycle gate (tools/flowcheck.py) and the
-# wire-protocol conformance gate (tools/wirecheck.py)
+# the resource-lifecycle gate (tools/flowcheck.py), the wire-protocol
+# conformance gate (tools/wirecheck.py) and the lifecycle
+# state-machine gate (tools/statecheck.py)
 lint:
 	python tools/lint.py
 	python tools/concheck.py
 	python tools/flowcheck.py
 	python tools/wirecheck.py
+	python tools/statecheck.py
 
 # the concurrency gate alone: lock-order cycles/rank inversions (CK01),
 # blocking-under-lock (CK02), guarded-by discipline (CK03), unranked
@@ -35,6 +37,13 @@ flowcheck:
 # (WC04), bounds discipline (WC05) across the wire surface
 wirecheck:
 	python tools/wirecheck.py
+
+# the lifecycle state-machine gate alone: raw state writes (SC01),
+# undeclared transitions (SC02), unguarded branch reads (SC03),
+# terminal escapes (SC04), annotated-but-undeclared machines (SC05)
+# across the ~13 declared machines
+statecheck:
+	python tools/statecheck.py
 
 test: native lint
 	python -m pytest tests/ -x -q
@@ -61,6 +70,7 @@ bench-smoke:
 	python benchmarks/bench_push.py
 	python tools/bench_gate.py
 	$(MAKE) chaos
+	$(MAKE) chaos-shake
 
 # the multi-process cluster tier alone (real executor processes over
 # TCP + the native hot-path kernel microbench); full config writes
@@ -76,6 +86,17 @@ bench-cluster: native
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
 	-p no:cacheprovider -k chaos
+
+# the chaos soak + push drills under the deterministic schedule shaker
+# (conf schedShake, utils/statemachine.py): every validated state
+# transition injects a seeded 0-2ms yield to widen race windows, with
+# stateDebug + lockDebug + resourceDebug all on — zero illegal
+# transitions, zero leaks, zero rank violations required
+chaos-shake:
+	SCHED_SHAKE=20260807 JAX_PLATFORMS=cpu python -m pytest \
+	tests/test_faults.py -q -p no:cacheprovider -k chaos
+	SCHED_SHAKE=20260807 JAX_PLATFORMS=cpu python -m pytest \
+	tests/test_push.py -q -p no:cacheprovider
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

@@ -903,6 +903,30 @@ class TpuShuffleConf:
         return self._bool("wireDebug", False)
 
     @property
+    def state_debug(self) -> bool:
+        """Runtime lifecycle state-machine validator
+        (utils/statemachine.py): every annotated machine's
+        ``_transition()`` validates the edge against its declared
+        TRANSITIONS table, counts
+        ``state_transitions_total{machine=,from=,to=}`` and raises
+        IllegalTransition (both states + 4-frame call site) on an
+        undeclared edge.  Off by default — transitions then cost one
+        module-global read and the plain assignment (identity-tested).
+        The static half is tools/statecheck.py; the manager flips the
+        process-global validator on BEFORE building its node."""
+        return self._bool("stateDebug", False) or self.sched_shake != 0
+
+    @property
+    def sched_shake(self) -> int:
+        """Deterministic schedule shaker seed (0 = off).  Non-zero
+        arms stateDebug and injects a seeded 0-2ms yield/sleep at
+        every validated lifecycle transition — widening the race
+        window at exactly the points where lifecycle races live.
+        Per-machine streams are seeded ``seed ^ crc32(machine)``, so a
+        fixed seed replays the same perturbation schedule."""
+        return self._int_in_range("schedShake", 0, 0, 2**31 - 1)
+
+    @property
     def metrics_json_path(self) -> str:
         """When set, manager.stop() writes a JSON snapshot of the
         registry here (executors suffix ``.<executor_id>`` so

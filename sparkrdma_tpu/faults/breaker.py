@@ -24,15 +24,24 @@ from typing import Callable, Dict, Tuple
 from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.statemachine import StateMachine
 
-_CLOSED, _OPEN, _HALF_OPEN = 0, 1, 2
 
-
-class CircuitBreaker:
+class CircuitBreaker(StateMachine):
     """Consecutive-failure breaker with half-open probing.
 
     ``failures=0`` disables the breaker: :meth:`allow` is always true
     and nothing ever trips.  ``clock`` is injectable for tests."""
+
+    MACHINE = "faults.breaker"
+    STATES = ("closed", "open", "half-open")
+    INITIAL = "closed"
+    TERMINAL = ()
+    TRANSITIONS = {
+        "closed": ("open",),          # strike budget burned: trip
+        "open": ("half-open",),       # reset window elapsed: probe
+        "half-open": ("closed", "open"),  # probe verdict
+    }
 
     def __init__(self, failures: int, reset_ms: float, name: str = "",
                  clock: Callable[[], float] = time.monotonic):
@@ -41,7 +50,7 @@ class CircuitBreaker:
         self.name = name
         self._clock = clock
         self._lock = dbg_lock("faults.breaker", 47)
-        self._state = _CLOSED  # guarded-by: _lock
+        self._state = "closed"  # state: faults.breaker guarded-by: _lock
         self._strikes = 0  # guarded-by: _lock
         self._opened_at = 0.0  # guarded-by: _lock
         self.trips = 0  # guarded-by: _lock
@@ -54,15 +63,15 @@ class CircuitBreaker:
             return True
         probe = False
         with self._lock:
-            if self._state == _CLOSED:
+            if self._state == "closed":
                 return True
-            if self._state == _OPEN:
+            if self._state == "open":
                 if self._clock() - self._opened_at >= self.reset_s:
-                    self._state = _HALF_OPEN
+                    self._transition("half-open")
                     probe = True
                 else:
                     return False
-            elif self._state == _HALF_OPEN:
+            elif self._state == "half-open":
                 return False  # probe already out
         if probe:
             if RECORDER.enabled:
@@ -72,8 +81,17 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
-            self._strikes = 0
-            self._state = _CLOSED
+            if self._state == "half-open":
+                # the probe came back: close and forget the strikes
+                self._strikes = 0
+                self._transition("closed")
+            elif self._state == "closed":
+                self._strikes = 0
+            # OPEN: a stale success from a fetch issued BEFORE the trip.
+            # Closing here would skip the probe protocol entirely — the
+            # peer gets the full parallel fetch load again off one
+            # straggler response that predates its failure burst.  The
+            # half-open probe is the only path back to closed.
 
     def record_failure(self) -> None:
         if self.failures <= 0:
@@ -82,12 +100,12 @@ class CircuitBreaker:
         with self._lock:
             self._strikes += 1
             strikes = self._strikes
-            if self._state == _HALF_OPEN:
+            if self._state == "half-open":
                 # the probe failed: straight back to OPEN, clock restarts
-                self._state = _OPEN
+                self._transition("open")
                 self._opened_at = self._clock()
-            elif self._state == _CLOSED and self._strikes >= self.failures:
-                self._state = _OPEN
+            elif self._state == "closed" and self._strikes >= self.failures:
+                self._transition("open")
                 self._opened_at = self._clock()
                 self.trips += 1
                 tripped = True
@@ -106,7 +124,7 @@ class CircuitBreaker:
     @property
     def state(self) -> str:
         with self._lock:
-            return ("closed", "open", "half-open")[self._state]
+            return self._state
 
 
 class StripeHealth:

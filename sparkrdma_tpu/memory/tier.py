@@ -50,6 +50,7 @@ from sparkrdma_tpu.metrics import counter, gauge
 from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport.channel import TransportError
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
+from sparkrdma_tpu.utils.statemachine import StateMachine
 
 logger = logging.getLogger(__name__)
 
@@ -65,17 +66,31 @@ TIER_DIRECT_READ_MIN = 1 << 20
 TIER_READ_MAX_GAP = 8 << 20
 
 
-class _Block:
-    """Residency state of one partition block of one map output."""
+class _Block(StateMachine):
+    """Residency state of one partition block of one map output:
+    ``cold`` (disk only) → ``loading`` (one promotion in flight) →
+    ``hot`` (pinned row installed), demoting back to ``cold`` on
+    eviction or a failed/raced load."""
 
     __slots__ = ("index", "offset", "length", "row", "pins", "seq",
-                 "loading", "prefetched", "touched", "hot_tkt")
+                 "loading", "prefetched", "touched", "hot_tkt", "_state")
+
+    MACHINE = "tier.block"
+    STATES = ("cold", "loading", "hot")
+    INITIAL = "cold"
+    TERMINAL = ()
+    TRANSITIONS = {
+        "cold": ("loading",),
+        "loading": ("hot", "cold"),  # install, or rollback/raced release
+        "hot": ("cold",),            # demote
+    }
 
     def __init__(self, index: int, offset: int, length: int):
         self.index = index
         self.offset = offset
         self.length = length
         # all mutable state below guarded-by the owning store's _lock
+        self._state = "cold"  # state: tier.block guarded-by: TieredBlockStore._lock
         self.row: Optional[np.ndarray] = None  # hot: exact-length view
         self.pins = 0  # resource: tier.pins (live consumer views)
         self.hot_tkt = NOOP_TICKET  # this block's hot-byte reservation
@@ -343,6 +358,7 @@ class TieredBlockStore:
                 ev = blk.loading
                 if ev is None and want_promote \
                         and self._reserve_locked(blk.length, entry=entry):
+                    blk._transition("loading", frm="cold")
                     blk.loading = threading.Event()
                     blk.hot_tkt = ledger_acquire(
                         "tier.hot_bytes", blk.length
@@ -460,6 +476,7 @@ class TieredBlockStore:
                 return 0
             self._seq += 1  # noqa: CK03 - held
             blk.seq = self._seq  # noqa: CK03 - held
+            blk._transition("loading", frm="cold")
             blk.loading = threading.Event()
             blk.hot_tkt = ledger_acquire("tier.hot_bytes", blk.length)
             blk.prefetched = True
@@ -636,6 +653,7 @@ class TieredBlockStore:
 
     def _demote_locked(self, blk: _Block) -> None:
         entry = self._hot.pop(blk, None)  # noqa: CK03 - caller holds _lock
+        blk._transition("cold", frm="hot")
         blk.row = None  # cold tier is the source of truth: no write-back
         tkt, blk.hot_tkt = blk.hot_tkt, NOOP_TICKET
         tkt.release()
@@ -656,9 +674,11 @@ class TieredBlockStore:
         with self._lock:
             ev, blk.loading = blk.loading, None
             if row is not None and entry.mkey in self._by_mkey:
+                blk._transition("hot", frm="loading")
                 blk.row = row
                 self._hot[blk] = entry
             else:
+                blk._transition("cold", frm="loading")
                 # failed load, or the entry was released mid-load
                 tkt, blk.hot_tkt = blk.hot_tkt, NOOP_TICKET
                 tkt.release()

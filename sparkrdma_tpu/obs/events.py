@@ -51,6 +51,10 @@ EVENTS = {
         "breaker_probe",      # half-open probe issued
         "ledger_leak",        # resource ledger found leaked resources
     ),
+    "state": (
+        "transition",         # validated lifecycle state transition
+        "illegal",            # transition absent from the declared table
+    ),
 }
 
 

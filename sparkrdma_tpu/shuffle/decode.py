@@ -51,6 +51,7 @@ from sparkrdma_tpu.qos import CreditLedger
 from sparkrdma_tpu.utils.dbglock import dbg_condition
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
 from sparkrdma_tpu.utils.serde import as_view
+from sparkrdma_tpu.utils.statemachine import StateMachine
 
 # blocks at or above this size are considered for frame-boundary
 # splitting across workers; span groups aim for at least _SPLIT_CHUNK
@@ -59,10 +60,11 @@ _SPLIT_MIN_BYTES = 1 << 20
 _SPLIT_CHUNK_BYTES = 256 << 10
 
 # ticket states (guarded by the pool's condition)
-_QUEUED, _DECODING, _STOLEN, _DONE, _CANCELLED = range(5)
+_QUEUED, _DECODING, _STOLEN, _DONE, _CANCELLED = (
+    "queued", "decoding", "stolen", "done", "cancelled")
 
 
-class DecodeTicket:
+class DecodeTicket(StateMachine):
     """One submitted block (or block fragment) flowing through the
     pool.  ``len(ticket)`` is the encoded payload size, so reader
     byte accounting works on tickets and raw payloads alike."""
@@ -73,6 +75,16 @@ class DecodeTicket:
         "_tkt",
     )
 
+    MACHINE = "decode.ticket"
+    STATES = (_QUEUED, _DECODING, _STOLEN, _DONE, _CANCELLED)
+    INITIAL = _QUEUED
+    TERMINAL = (_DONE, _CANCELLED)
+    TRANSITIONS = {
+        "queued": ("decoding", "stolen", "cancelled"),
+        "decoding": ("done",),   # worker finishes (even on decode error)
+        "stolen": ("done",),     # consumer's inline decode finishes
+    }
+
     def __init__(self, pool: "DecodePool", stream: "DecodeStream",
                  fn: Callable, data, cost: int):
         self._pool = pool
@@ -81,7 +93,7 @@ class DecodeTicket:
         self._data = data
         self.cost = cost
         self.nbytes = cost
-        self._state = _QUEUED
+        self._state = _QUEUED  # state: decode.ticket guarded-by: DecodePool._cv
         self._held = 0
         self._tkt = NOOP_TICKET  # this ticket's held-credit reservation
         self._event = threading.Event()
@@ -101,7 +113,7 @@ class DecodeTicket:
         pool = self._pool
         with pool._cv:
             if self._state == _QUEUED:
-                self._state = _STOLEN
+                self._transition(_STOLEN, frm=_QUEUED)
                 pool._cv.notify_all()  # unblock a worker credit-waiting on it
                 steal = True
             else:
@@ -130,7 +142,7 @@ class DecodeTicket:
             self._error = e
         self._pool._observe(self.nbytes, time.monotonic() - t0)
         with self._pool._cv:
-            self._state = _DONE
+            self._transition(_DONE, frm=_STOLEN)
         self._event.set()
 
     def discard(self) -> None:
@@ -142,7 +154,7 @@ class DecodeTicket:
         pool = self._pool
         with pool._cv:
             if self._state == _QUEUED:
-                self._state = _CANCELLED
+                self._transition(_CANCELLED, frm=_QUEUED)
                 self._error = RuntimeError("decode ticket discarded")
                 self._settle_locked()
                 self._event.set()
@@ -209,12 +221,18 @@ class _CompositeTicket:
         return items, records
 
 
-class DecodeStream:
+class DecodeStream(StateMachine):
     """Per-reader handle onto the shared pool.  ``decode_fn(data)``
     must return ``(items, record_count)`` for one self-contained
     payload; ``split_fn(data)`` (optional — the serializer's
     ``frame_spans``) yields the frame boundaries used to fan one large
     block out across workers."""
+
+    MACHINE = "decode.stream"
+    STATES = ("open", "closed")
+    INITIAL = "open"
+    TERMINAL = ("closed",)
+    TRANSITIONS = {"open": ("closed",)}
 
     def __init__(self, pool: "DecodePool", decode_fn: Callable,
                  split_fn: Optional[Callable] = None,
@@ -228,7 +246,7 @@ class DecodeStream:
         # the pool's weighted ledger under it (None = plain credits)
         self._tenant = tenant
         self._tickets: set = set()  # guarded-by: (pool) _cv
-        self._closed = False  # guarded-by: (pool) _cv
+        self._state = "open"  # state: decode.stream guarded-by: DecodePool._cv
 
     def submit(self, data, cost: Optional[int] = None) -> DecodeTicket:
         """Enqueue one payload for decode; never blocks (transport
@@ -237,8 +255,8 @@ class DecodeStream:
         t = DecodeTicket(self._pool, self, self._decode_fn, data, n)
         pool = self._pool
         with pool._cv:
-            if self._closed or pool._stopped:
-                t._state = _CANCELLED
+            if self._state == "closed" or pool._stopped:
+                t._transition(_CANCELLED, frm=_QUEUED)
                 t._error = RuntimeError("decode stream closed")
                 t._event.set()
                 return t
@@ -277,12 +295,12 @@ class DecodeStream:
         calls it on success, fetch failure AND abandoned iteration)."""
         pool = self._pool
         with pool._cv:
-            if self._closed:
+            if self._state == "closed":
                 return
-            self._closed = True
+            self._transition("closed", frm="open")
             for t in list(self._tickets):
                 if t._state == _QUEUED:
-                    t._state = _CANCELLED
+                    t._transition(_CANCELLED, frm=_QUEUED)
                     t._error = RuntimeError("decode stream closed")
                     t._event.set()
                 t._settle_locked()
@@ -381,7 +399,7 @@ class DecodePool:
                             tenant, cost, self._waiting_view())
                        and not self._stopped
                        and item._state == _QUEUED
-                       and not item._stream._closed):
+                       and item._stream._state == "open"):
                     if not waited:
                         waited = True
                         self._m_credit_waits.inc()
@@ -396,8 +414,8 @@ class DecodePool:
                     self._waiting_remove(tenant)
                 if item._state != _QUEUED:
                     continue  # stolen mid-wait: the consumer owns it now
-                if self._stopped or item._stream._closed:
-                    item._state = _CANCELLED
+                if self._stopped or item._stream._state == "closed":
+                    item._transition(_CANCELLED, frm=_QUEUED)
                     item._error = RuntimeError("decode stream closed")
                     item._settle_locked()
                     item._event.set()
@@ -411,7 +429,7 @@ class DecodePool:
                 item._tkt = ledger_acquire(
                     "decode.credit_bytes", cost
                 )  # acquires: decode.credit_bytes
-                item._state = _DECODING
+                item._transition(_DECODING, frm=_QUEUED)
             t0 = time.monotonic()
             try:
                 if FAULTS.enabled:
@@ -430,8 +448,8 @@ class DecodePool:
                     err=1 if item._error is not None else 0,
                 )
             with self._cv:
-                item._state = _DONE
-                if item._stream._closed or item._abandoned:
+                item._transition(_DONE, frm=_DECODING)
+                if item._stream._state == "closed" or item._abandoned:
                     # consumer is gone: nobody will get() — release now
                     item._settle_locked()
             item._event.set()
@@ -452,7 +470,7 @@ class DecodePool:
             with self._cv:
                 self._m_depth.dec()
                 if item._state == _QUEUED:
-                    item._state = _CANCELLED
+                    item._transition(_CANCELLED, frm=_QUEUED)
                     item._error = RuntimeError("decode pool stopped")
                     item._settle_locked()
                     item._event.set()

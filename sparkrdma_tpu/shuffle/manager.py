@@ -41,6 +41,7 @@ from sparkrdma_tpu.obs import RECORDER, TRACING
 from sparkrdma_tpu.qos import WeightedCreditBroker, get_qos
 from sparkrdma_tpu.skew import get_skew
 from sparkrdma_tpu.utils.dbglock import dbg_lock, dbg_rlock
+from sparkrdma_tpu.utils.statemachine import StateMachine
 from sparkrdma_tpu.utils.trace import get_tracer
 from sparkrdma_tpu.rpc.messages import (
     AnnounceShuffleManagersMsg,
@@ -281,9 +282,18 @@ class _MergeCallback:
         self.on_error(reason)
 
 
-class TpuShuffleManager:
+class TpuShuffleManager(StateMachine):
     """One per process.  ``network`` supplies the transport connector
     (LoopbackNetwork in-process; a real fabric connector on a pod)."""
+
+    MACHINE = "manager.lifecycle"
+    STATES = ("running", "stopping", "stopped")
+    INITIAL = "running"
+    TERMINAL = ("stopped",)
+    TRANSITIONS = {
+        "running": ("stopping",),
+        "stopping": ("stopped",),
+    }
 
     def __init__(
         self,
@@ -337,6 +347,16 @@ class TpuShuffleManager:
             from sparkrdma_tpu.utils.wiredbg import set_wire_debug
 
             set_wire_debug(True)
+        if conf.state_debug:
+            # and the lifecycle state-machine validator
+            # (utils/statemachine.py): every _transition() from here on
+            # is checked against its declared table; a non-zero
+            # schedShake seed additionally perturbs the schedule at
+            # each validated transition
+            from sparkrdma_tpu.utils.statemachine import get_state_debug
+
+            get_state_debug().enabled = True
+            get_state_debug().shake_seed = conf.sched_shake
         # deterministic fault plane (faults/): arm the process-global
         # injector from the seeded spec BEFORE building the node, so
         # every fault point the transport/memory/control planes pass
@@ -551,7 +571,13 @@ class TpuShuffleManager:
         self._callbacks_lock = dbg_lock("manager.callbacks", 18)
         self._next_callback_id = 1
         self._hello_sent = False
-        self._stopped = False
+        # manager lifecycle: check-and-flip UNDER _life_lock — two
+        # concurrent stop() calls (SparkContext teardown racing an
+        # atexit hook or a test fixture) must not both run the
+        # teardown body, which releases owner-counted globals
+        # (RECORDER/TRACING/ledger) and would double-release them
+        self._life_lock = dbg_lock("manager.lifecycle", 16)
+        self._state = "running"  # state: manager.lifecycle guarded-by: _life_lock
         # per-shuffle telemetry: local accumulators (writers/readers
         # record in), published to the driver at unregister time the
         # same way map-output locations flow; the driver keeps the last
@@ -802,11 +828,13 @@ class TpuShuffleManager:
         """A control-plane send to an executor failed outright: its
         channel is dead (partition / closed peer).  Prune immediately —
         the reference gets this signal from CM DISCONNECTED events."""
-        if self._stopped or self._hb_stop.is_set():
+        # racy shutdown hint only — stop() re-checks under _life_lock
+        if self._state != "running" or self._hb_stop.is_set():  # noqa: SC03 hint
             return
         import sys as _sys
 
-        if (self._stopped or self.node._stopped.is_set()
+        # racy quiescence probe, not a decision point
+        if (self._state != "running" or self.node._stopped.is_set()  # noqa: SC03
                 or _sys.is_finalizing()):
             # OUR node (or the interpreter) is shutting down — that is
             # quiescence, not an executor failure; stop probing instead
@@ -1854,14 +1882,16 @@ class TpuShuffleManager:
         stopped.  Workers pin to ``dispatcherCpuList`` exactly like the
         transport dispatcher and serve-pool threads."""
         n = self.conf.decode_threads
-        if n <= 0 or self._stopped:
+        if n <= 0 or self._state != "running":  # noqa: SC03 re-checked below
             return None
         pool = self._decode_pool
         if pool is None:
             from sparkrdma_tpu.shuffle.decode import DecodePool
 
             with self._decode_lock:
-                if self._stopped:
+                # _decode_lock (not _life_lock) orders this against
+                # _stop_decode_pool
+                if self._state != "running":  # noqa: SC03 ordered by _decode_lock
                     # re-checked under the lock: a create racing
                     # manager.stop() must not resurrect a pool whose
                     # stop already ran (leaked pinned workers)
@@ -2244,9 +2274,15 @@ class TpuShuffleManager:
 
     def stop(self) -> None:
         """Teardown (reference: RdmaShuffleManager.scala:348-357)."""
-        if self._stopped:
-            return
-        self._stopped = True
+        with self._life_lock:
+            if self._state != "running":
+                # a second stop() — concurrent or repeated — must
+                # observe the flip atomically with the check: the old
+                # unguarded check-then-set let two racing callers both
+                # enter the teardown body and double-release the
+                # owner-counted RECORDER/TRACING/ledger globals
+                return
+            self._transition("stopping", frm="running")
         self.quiesce()
         if self.stats is not None:
             self.stats.print_stats()
@@ -2319,3 +2355,5 @@ class TpuShuffleManager:
             # until every member has stopped
             FAULTS.stop()
             self._faults_armed = False
+        with self._life_lock:
+            self._transition("stopped", frm="stopping")

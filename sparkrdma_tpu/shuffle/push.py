@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 from sparkrdma_tpu.faults.injector import FAULTS
 from sparkrdma_tpu.metrics import counter
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.statemachine import StateMachine
 
 logger = logging.getLogger(__name__)
 
@@ -57,11 +58,23 @@ class MergeUnavailable(Exception):
     the failed-reply the reader treats as no-coverage → pull."""
 
 
-class _ReduceMerge:
-    """Merge state of ONE (shuffle, reduce partition) on this merger."""
+class _ReduceMerge(StateMachine):
+    """Merge state of ONE (shuffle, reduce partition) on this merger:
+    ``accepting`` sub-blocks until the first status query seals it,
+    ``committed`` once the merged span is registered and servable (a
+    failed commit stays ``sealed`` with no segment — pure pull)."""
 
     __slots__ = ("pending", "totals", "payloads", "done", "nbytes",
-                 "sealed", "seg", "length", "provenance")
+                 "_state", "seg", "length", "provenance")
+
+    MACHINE = "push.merge"
+    STATES = ("accepting", "sealed", "committed")
+    INITIAL = "accepting"
+    TERMINAL = ("committed",)
+    TRANSITIONS = {
+        "accepting": ("sealed",),
+        "sealed": ("committed",),
+    }
 
     def __init__(self):
         self.pending: Dict[int, Dict[int, bytes]] = {}  # map -> off -> bytes
@@ -69,7 +82,7 @@ class _ReduceMerge:
         self.payloads: List[Tuple[int, bytes]] = []     # completed, in order
         self.done: set = set()       # map_ids no longer accepted
         self.nbytes = 0              # merged bytes (completed payloads)
-        self.sealed = False
+        self._state = "accepting"  # state: push.merge guarded-by: PushMerger._lock
         self.seg = None              # registered segment once sealed
         self.length = 0
         self.provenance: Tuple[ProvRow, ...] = ()
@@ -110,7 +123,7 @@ class PushMerger:
             st = self._shuffles.setdefault(shuffle_id, {}).setdefault(
                 reduce_id, _ReduceMerge()
             )
-            if st.sealed:
+            if st._state != "accepting":
                 counter("push_drops_total", reason="late").inc()
                 return
             if map_id in st.done:
@@ -180,8 +193,8 @@ class PushMerger:
                 st = self._shuffles.setdefault(shuffle_id, {}).setdefault(
                     reduce_id, _ReduceMerge()
                 )
-            if not st.sealed:
-                st.sealed = True
+            if st._state == "accepting":
+                st._transition("sealed")
                 st.pending.clear()
                 st.totals.clear()
                 if st.payloads:
@@ -239,6 +252,7 @@ class PushMerger:
         st.seg = seg
         st.length = off
         st.provenance = tuple(prov)
+        st._transition("committed", frm="sealed")
         # assembled payloads now live in the committed file
         st.payloads = []
 

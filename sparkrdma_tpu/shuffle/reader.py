@@ -937,7 +937,15 @@ class ShuffleReader:
             self._pump()
 
         def settle():
-            # idempotent: release whatever progress callbacks didn't
+            # idempotent: release whatever progress callbacks didn't.
+            # EXPLICIT remainders, never the no-arg close: a progress
+            # callback claims its n under the lock but releases the
+            # ticket after dropping it, so a no-arg settle racing that
+            # window closes the ticket first and turns the late
+            # release(n) into a double release (the schedule shaker
+            # caught exactly this interleaving in the tcp-async soak).
+            # With amounts pinned to the under-lock claims the releases
+            # sum to the acquisition exactly, in any order.
             with self._pending_lock:
                 if settled[0]:
                     return
@@ -947,10 +955,10 @@ class ShuffleReader:
                     self._bytes_in_flight -= left
                 rel = qos_left[0]
                 qos_left[0] = 0
-            fetch.win_tkt.release()  # releases: reader.inflight_bytes  # one-shot
+            fetch.win_tkt.release(left)  # releases: reader.inflight_bytes  # one-shot
             if rel and broker is not None:
                 broker.release(rel, self._tenant)
-            fetch.qos_tkt.release()  # releases: reader.qos_inflight_bytes  # one-shot
+            fetch.qos_tkt.release(rel)  # releases: reader.qos_inflight_bytes # one-shot
 
         def finish_once() -> bool:
             # the group's FIRST outcome wins: a channel torn down while

@@ -30,6 +30,7 @@ from typing import Callable, List, Optional, Sequence
 
 from sparkrdma_tpu.metrics import gauge
 from sparkrdma_tpu.utils.dbglock import dbg_lock
+from sparkrdma_tpu.utils.statemachine import StateMachine
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -121,7 +122,7 @@ class FnCompletionListener(CompletionListener):
         self._err(error)
 
 
-class Channel:
+class Channel(StateMachine):
     """Base channel: state machine + send budgeting.
 
     Subclasses implement ``_post_rpc`` and ``_post_read`` which perform
@@ -129,6 +130,17 @@ class Channel:
     ``_fail(listener, err)`` exactly once when done (possibly on another
     thread), then ``_release_budget()``.
     """
+
+    MACHINE = "channel.lifecycle"
+    STATES = ("idle", "connecting", "connected", "error", "stopped")
+    INITIAL = "idle"
+    TERMINAL = ("stopped",)
+    TRANSITIONS = {
+        "idle": ("connecting", "connected", "error", "stopped"),
+        "connecting": ("connected", "error", "stopped"),
+        "connected": ("error", "stopped"),
+        "error": ("stopped",),
+    }
 
     #: whether this channel's ``_post_read`` honors ``dest`` scatter
     #: buffers and ``on_progress`` callbacks (the striped-read group
@@ -142,7 +154,7 @@ class Channel:
         #: the handshake's accepted/negotiated version here, and senders
         #: suppress v2-only bytes when it reads 1
         self.wire_version = 0
-        self._state = ChannelState.IDLE
+        self._state = ChannelState.IDLE  # state: channel.lifecycle
         self._state_lock = dbg_lock("channel.state", 60)
         # send-WR budget: number of outstanding posted operations
         self._budget = threading.Semaphore(send_queue_depth)
@@ -168,7 +180,8 @@ class Channel:
         with self._state_lock:
             if self._state in (ChannelState.ERROR, ChannelState.STOPPED):
                 return  # sticky terminal states
-            prev, self._state = self._state, new
+            prev = self._state
+            self._transition(new)
         if (new == ChannelState.CONNECTED
                 and prev != ChannelState.CONNECTED
                 and self._m_active_gauge is None):
@@ -247,7 +260,7 @@ class Channel:
         with self._state_lock:
             if self._state == ChannelState.STOPPED:
                 return
-            self._state = ChannelState.STOPPED
+            self._transition(ChannelState.STOPPED)
         g, self._m_active_gauge = self._m_active_gauge, None
         if g is not None:
             g.dec()
@@ -339,7 +352,7 @@ class Channel:
         RdmaChannel.java:611-637)."""
         with self._state_lock:
             if self._state not in (ChannelState.STOPPED,):
-                self._state = ChannelState.ERROR
+                self._transition(ChannelState.ERROR)
 
     # -- subclass hooks -----------------------------------------------------
     def _post_rpc(self, frames: List[bytes], listener: CompletionListener) -> None:

@@ -89,6 +89,11 @@ from sparkrdma_tpu.transport import tcp as wire
 from sparkrdma_tpu.utils import wiredbg
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.ledger import NOOP_TICKET, ledger_acquire
+from sparkrdma_tpu.utils.statemachine import (
+    GLOBAL_STATE_DEBUG,
+    StateMachine,
+    check_named,
+)
 from sparkrdma_tpu.utils.types import BlockLocation
 
 logger = logging.getLogger(__name__)
@@ -174,12 +179,25 @@ def _run_batch(batch: List[Tuple]) -> None:
         _safe(fn, *args)
 
 
-class _SendOp:
+class _SendOp(StateMachine):
     """One outbound frame descriptor: iovec views + a cursor advanced
     across partial sends, completed (on the completion queue) when the
-    whole frame has been handed to the kernel."""
+    whole frame has been handed to the kernel.  Lifecycle: ``new`` until
+    it enters a channel's tx queue, then ``sent`` (fully written) or
+    ``failed`` (queue swept by teardown / rejected by a closed
+    channel)."""
 
-    __slots__ = ("views", "i", "total", "frames", "on_done", "tkt")
+    __slots__ = ("views", "i", "total", "frames", "on_done", "tkt",
+                 "_state")
+
+    MACHINE = "dispatcher.sendop"
+    STATES = ("new", "queued", "sent", "failed")
+    INITIAL = "new"
+    TERMINAL = ("sent", "failed")
+    TRANSITIONS = {
+        "new": ("queued", "failed"),
+        "queued": ("sent", "failed"),
+    }
 
     def __init__(self, views: List[memoryview], total: int, frames: int,
                  on_done=None):
@@ -189,6 +207,7 @@ class _SendOp:
         self.frames = frames        # logical frames in this descriptor
         self.on_done = on_done      # callable(err-or-None) | None
         self.tkt = NOOP_TICKET      # ledger ticket, set when queued
+        self._state = "new"  # state: dispatcher.sendop guarded-by: AsyncTcpChannel._tx_lock  # noqa: PY02
 
     def advance(self, n: int) -> None:
         while n and self.i < len(self.views):
@@ -702,7 +721,32 @@ class AsyncTcpChannel(Channel):
 
     #: recv-machine states
     _HDR, _RPC, _REQ, _RESP_HDR, _RESP_WHOLE, _RESP_LEN, _RESP_BLOCK, \
-        _RESP_ERR, _DISCARD = range(9)
+        _RESP_ERR, _DISCARD = (
+            "hdr", "rpc", "req", "resp_hdr", "resp_whole", "resp_len",
+            "resp_block", "resp_err", "discard",
+        )
+
+    #: the recv machine rides NEXT TO the inherited channel.lifecycle
+    #: machine, so its table lives under the RX_ prefix (``table: RX``)
+    RX_STATES = _HDR, _RPC, _REQ, _RESP_HDR, _RESP_WHOLE, _RESP_LEN, \
+        _RESP_BLOCK, _RESP_ERR, _DISCARD
+    RX_INITIAL = "hdr"
+    RX_TERMINAL = ()
+    RX_TRANSITIONS = {
+        "hdr": ("rpc", "req", "resp_hdr"),
+        "rpc": ("hdr",),
+        "req": ("hdr",),
+        # resp_hdr fans out: empty/error bodies settle straight back to
+        # hdr, torn-down reads drain via discard, scatter reads walk
+        # the len/block loop, whole-frame landings take resp_whole
+        "resp_hdr": ("hdr", "discard", "resp_err", "resp_whole",
+                     "resp_len"),
+        "resp_whole": ("hdr",),
+        "resp_len": ("resp_block", "hdr"),
+        "resp_block": ("resp_len", "hdr"),
+        "resp_err": ("hdr",),
+        "discard": ("hdr",),
+    }
 
     def __init__(self, channel_type: ChannelType, node, peer, sock,
                  dispatcher: Dispatcher):
@@ -794,7 +838,7 @@ class AsyncTcpChannel(Channel):
         self._events = 0
         self._registered = False
         self._read_paused = False
-        self._rx_state = self._HDR
+        self._rx_state = self._HDR  # state: channel.recv table: RX
         self._rx_view: Optional[memoryview] = None  # current fill target
         self._rx_got = 0
         self._rx_store = None       # backing object of _rx_view
@@ -923,6 +967,7 @@ class AsyncTcpChannel(Channel):
                 op.tkt = ledger_acquire(
                     "dispatcher.send_ops"
                 )  # acquires: dispatcher.send_ops
+                op._transition("queued", frm="new")
                 self._tx.append(op)
                 self._tx_bytes += op.total
                 self._m_backlog.inc(op.total)
@@ -963,6 +1008,7 @@ class AsyncTcpChannel(Channel):
         if rejected is not None:
             # closed before the post: the op was never queued and the
             # teardown already ran — fail JUST this descriptor
+            rejected._transition("failed", frm="new")
             if rejected.on_done is not None:
                 _safe(rejected.on_done, err)
             return
@@ -1004,6 +1050,7 @@ class AsyncTcpChannel(Channel):
                 self._m_msgs_sent.inc(op.frames)
                 self._m_bytes_sent.inc(op.total)
                 op.tkt.release()  # releases: dispatcher.send_ops
+                op._transition("sent", frm="queued")
                 done_ops.append(op)
         return None
 
@@ -1101,6 +1148,7 @@ class AsyncTcpChannel(Channel):
             self._tx_bytes = 0
         for op in tx:
             op.tkt.release()  # releases: dispatcher.send_ops
+            op._transition("failed")
             if op.on_done is not None:
                 _safe(op.on_done, err)
 
@@ -1232,14 +1280,20 @@ class AsyncTcpChannel(Channel):
             self._disp.sel_modify(self._sock, want, self)
 
     # -- recv machine (loop thread) -----------------------------------------
-    def _arm_fixed(self, state: int, n: int) -> None:  # on-loop
+    def _transition_rx(self, state: str) -> None:  # on-loop
+        if GLOBAL_STATE_DEBUG.enabled:
+            check_named(self, state, name="channel.recv", field="_rx_state",
+                        transitions=self.RX_TRANSITIONS)
         self._rx_state = state
+
+    def _arm_fixed(self, state: str, n: int) -> None:  # on-loop
+        self._transition_rx(state)
         self._rx_store = bytearray(n)
         self._rx_view = memoryview(self._rx_store)
         self._rx_got = 0
 
-    def _arm_into(self, state: int, store, view: memoryview) -> None:  # on-loop
-        self._rx_state = state
+    def _arm_into(self, state: str, store, view: memoryview) -> None:  # on-loop
+        self._transition_rx(state)
         self._rx_store = store
         self._rx_view = view
         self._rx_got = 0
@@ -1489,7 +1543,7 @@ class AsyncTcpChannel(Channel):
                 self._arm_fixed(self._HDR, wire._HDR.size)
             else:
                 self._rx_discard = body
-                self._rx_state = self._DISCARD
+                self._transition_rx(self._DISCARD)
             return
         self._rx_entry = entry
         self._rx_idx = 0
@@ -2059,6 +2113,7 @@ class AsyncTcpChannel(Channel):
                                     entry[2])
         for op in tx:
             op.tkt.release()  # releases: dispatcher.send_ops
+            op._transition("failed")
             if op.on_done is not None:
                 self._disp.complete(op.on_done, err)
         self._disp.complete(self._on_loop_dead, err)

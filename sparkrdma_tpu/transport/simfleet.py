@@ -33,6 +33,7 @@ from typing import List, Tuple
 
 from sparkrdma_tpu.obs import RECORDER, fr_event
 from sparkrdma_tpu.transport import tcp as wire
+from sparkrdma_tpu.utils.statemachine import StateMachine
 
 logger = logging.getLogger(__name__)
 
@@ -699,7 +700,7 @@ class ExecutorProcess:
         return acked
 
 
-class ProcessCluster:
+class ProcessCluster(StateMachine):
     """Driver in THIS process + ``n_executors`` full shuffle-manager
     processes over real TCP sockets.
 
@@ -709,6 +710,15 @@ class ProcessCluster:
     ``workdir`` receives per-process logs, metrics JSONs, and
     flight-recorder dumps; ``collect()`` folds the dumps into one
     merged trace document via obs/collect.merge_dumps."""
+
+    MACHINE = "cluster.proc"
+    STATES = ("running", "stopping", "stopped")
+    INITIAL = "running"
+    TERMINAL = ("stopped",)
+    TRANSITIONS = {
+        "running": ("stopping",),
+        "stopping": ("stopped",),
+    }
 
     def __init__(self, n_executors: int, base_port: int,
                  conf: dict = None, host: str = "127.0.0.1",
@@ -736,7 +746,7 @@ class ProcessCluster:
             stage_to_device=False,
         )
         self.executors: List[ExecutorProcess] = []
-        self._stopped = False
+        self._state = "running"  # state: cluster.proc
         try:
             # bound-port broadcast: children dial the port the driver
             # ACTUALLY bound, not the one we asked for
@@ -826,9 +836,9 @@ class ProcessCluster:
         self.executors[idx].kill()
 
     def stop(self, graceful: bool = True) -> None:
-        if self._stopped:
+        if self._state != "running":
             return
-        self._stopped = True
+        self._transition("stopping", frm="running")
         # deliberate shutdown must not race the heartbeat monitor into
         # declaring executor deaths (manager.quiesce contract)
         try:
@@ -844,6 +854,7 @@ class ProcessCluster:
             self.driver.stop()
         except Exception:
             logger.exception("cluster driver stop failed")
+        self._transition("stopped", frm="stopping")
 
     def collect(self) -> dict:
         """Merge every per-process flight-recorder dump in ``workdir``

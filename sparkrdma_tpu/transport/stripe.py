@@ -57,6 +57,7 @@ from sparkrdma_tpu.transport.channel import (
 )
 from sparkrdma_tpu.utils.dbglock import dbg_lock
 from sparkrdma_tpu.utils.ledger import ledger_acquire
+from sparkrdma_tpu.utils.statemachine import StateMachine
 from sparkrdma_tpu.utils.types import BlockLocation
 
 
@@ -71,7 +72,7 @@ def _alloc_row(pool, nbytes: int) -> np.ndarray:
     )
 
 
-class _GroupRead:
+class _GroupRead(StateMachine):
     """Completion combiner for one group read: N sub-reads, one
     caller-facing listener.  First failure wins and suppresses further
     progress reports; success fires once when every sub-read landed.
@@ -79,7 +80,15 @@ class _GroupRead:
     finished transition, before the caller's listener."""
 
     __slots__ = ("listener", "out", "rows", "on_progress", "pending",
-                 "lock", "finished", "on_finish")
+                 "lock", "_state", "on_finish")
+
+    MACHINE = "stripe.group_read"
+    STATES = ("pending", "done", "failed")
+    INITIAL = "pending"
+    TERMINAL = ("done", "failed")
+    TRANSITIONS = {
+        "pending": ("done", "failed"),
+    }
 
     def __init__(self, listener: CompletionListener, out: list,
                  rows: List[int], on_progress, pending: int,
@@ -93,7 +102,7 @@ class _GroupRead:
         # read UNLOCKED by progress() as a suppress hint (racy by
         # design — a late progress report is harmless); writes stay
         # under the lock
-        self.finished = False
+        self._state = "pending"  # state: stripe.group_read guarded-by: lock
         self.on_finish = on_finish
 
     def _finish(self) -> None:
@@ -107,17 +116,18 @@ class _GroupRead:
 
     def progress(self, n: int) -> None:
         cb = self.on_progress
-        if cb is not None and not self.finished:
+        # racy suppress hint — a late progress report is harmless
+        if cb is not None and self._state == "pending":  # noqa: SC03 hint
             cb(n)
 
     def part_done(self) -> None:
         with self.lock:
-            if self.finished:
+            if self._state != "pending":
                 return
             self.pending -= 1
             if self.pending:
                 return
-            self.finished = True
+            self._transition("done", frm="pending")
         self._finish()
         for i in self.rows:
             row = self.out[i]
@@ -127,9 +137,9 @@ class _GroupRead:
 
     def fail(self, err: BaseException) -> None:
         with self.lock:
-            if self.finished:
+            if self._state != "pending":
                 return
-            self.finished = True
+            self._transition("failed", frm="pending")
         self._finish()
         self.listener.on_failure(err)
 

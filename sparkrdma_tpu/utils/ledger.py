@@ -47,10 +47,11 @@ import threading
 from typing import Dict, List, Optional
 
 from sparkrdma_tpu.metrics import counter, gauge
+from sparkrdma_tpu.utils.statemachine import StateMachine
 
 logger = logging.getLogger("sparkrdma_tpu.ledger")
 
-_LIVE, _CLOSED, _TRANSFERRED = 0, 1, 2
+_LIVE, _CLOSED, _TRANSFERRED = "live", "closed", "transferred"
 
 
 class DoubleReleaseError(RuntimeError):
@@ -80,11 +81,19 @@ def _acquire_site(limit: int = 4) -> str:
     return " < ".join(frames) if frames else "<unknown>"
 
 
-class ResourceTicket:
+class ResourceTicket(StateMachine):
     """One outstanding acquisition of ``amount`` units of a resource."""
 
     __slots__ = ("_ledger", "resource", "outstanding", "site",
                  "_epoch", "_state")
+
+    MACHINE = "ledger.ticket"
+    STATES = (_LIVE, _CLOSED, _TRANSFERRED)
+    INITIAL = _LIVE
+    TERMINAL = (_CLOSED, _TRANSFERRED)
+    TRANSITIONS = {
+        _LIVE: (_CLOSED, _TRANSFERRED),
+    }
 
     def __init__(self, ledger: "ResourceLedger", resource: str,
                  amount: int, site: str, epoch: int):
@@ -93,7 +102,7 @@ class ResourceTicket:
         self.outstanding = amount  # guarded-by: (ledger) _lock
         self.site = site
         self._epoch = epoch  # guarded-by: (ledger) _lock
-        self._state = _LIVE  # guarded-by: (ledger) _lock
+        self._state = _LIVE  # state: ledger.ticket guarded-by: ResourceLedger._lock
 
     def release(self, amount: Optional[int] = None) -> None:
         """Return ``amount`` units (default: all still outstanding).
@@ -209,7 +218,7 @@ class ResourceLedger:
                     # exactly-once final release() (the reader's
                     # per-stripe progress + settle() pairing)
                     if amount is None:
-                        t._state = _CLOSED
+                        t._transition(_CLOSED, frm=_LIVE)
                     if t.outstanding == 0:
                         self._tickets.discard(t)
             if err is not None:
@@ -230,7 +239,7 @@ class ResourceLedger:
                        f"ticket (acquired at {t.site})")
                 self._double_releases += 1
             else:
-                t._state = _TRANSFERRED
+                t._transition(_TRANSFERRED, frm=_LIVE)
                 self._tickets.discard(t)
                 nt = ResourceTicket(self, t.resource, t.outstanding,
                                     t.site, self._epoch)

@@ -54,6 +54,7 @@ from sparkrdma_tpu.transport.channel import (
 )
 from sparkrdma_tpu.utils.dbglock import get_lock_factory
 from sparkrdma_tpu.utils.ledger import get_resource_ledger
+from sparkrdma_tpu.utils.statemachine import shake_confs_from_env
 
 BASE_PORT = 42400
 
@@ -521,6 +522,91 @@ def test_striped_lane_kill_demotes_to_unstriped(faults_env):
             m.stop()
 
 
+def test_late_stripe_progress_release_races_settle_clean(
+        faults_env, monkeypatch):
+    """Regression (found by the shaken tcp-async chaos soak): the
+    reader's per-stripe progress callback claims its n bytes under the
+    pending lock but releases the window ticket AFTER dropping it,
+    while settle() used to close the ticket with a no-arg release — a
+    settle overtaking that claim->release window turned the late
+    release(n) into a DoubleReleaseError.  settle() now releases the
+    explicit remainder, so the releases sum exactly in any order.
+
+    The interleaving is forced deterministically: every group read
+    fires one injected progress report from a side thread, the ticket
+    release under it parks on an event inside the claim->release
+    window, and only then does the completion (and thus settle) run."""
+    from sparkrdma_tpu.transport import stripe as stripe_mod
+    from sparkrdma_tpu.transport.channel import (
+        FnCompletionListener as FnCL,
+    )
+    from sparkrdma_tpu.utils import ledger as ledger_mod
+
+    parked = threading.Event()
+    orig_release = ledger_mod.ResourceTicket.release
+
+    def parking_release(self, amount=None):
+        if self.resource == "reader.inflight_bytes" and amount:
+            parked.set()  # the claim happened; now park in the window
+            time.sleep(0.05)
+        return orig_release(self, amount)
+
+    monkeypatch.setattr(
+        ledger_mod.ResourceTicket, "release", parking_release)
+
+    orig_rb = stripe_mod.ReadGroup.read_blocks
+
+    def racing_rb(self, locations, listener, on_progress=None,
+                  tenant=None, ctx=None):
+        if on_progress is None:
+            return orig_rb(self, locations, listener, tenant=tenant,
+                           ctx=ctx)
+        total = sum(loc.length for loc in locations)
+        racer = threading.Thread(target=on_progress, args=(total // 2,))
+
+        def on_success(blocks):
+            parked.clear()
+            racer.start()
+            # wait until the progress claim is parked inside its
+            # claim->release window, THEN let completion settle
+            assert parked.wait(5), "progress release never parked"
+            listener.on_success(blocks)
+            racer.join(10)
+
+        # the real per-stripe progress stays suppressed (on_progress
+        # None below) — the injected racer is the only window release
+        # besides settle, so the arithmetic stays exact
+        return orig_rb(self, locations, FnCL(on_success,
+                                             listener.on_failure),
+                       tenant=tenant, ctx=ctx)
+
+    monkeypatch.setattr(stripe_mod.ReadGroup, "read_blocks", racing_rb)
+
+    net, conf, driver, executors = _loop_cluster({
+        "spark.shuffle.tpu.resourceDebug": True,
+        "spark.shuffle.tpu.transportNumStripes": 2,
+        "spark.shuffle.tpu.transportStripeThreshold": "64k",
+    }, BASE_PORT + 340)
+    ledger = get_resource_ledger()
+    assert ledger.enabled
+    got = defaultdict(list)
+    try:
+        handle, maps_by_host, expected = _write_maps(
+            driver, executors, 0, rows=240, vbytes=1500)
+        for pid in range(4):
+            rd = executors[pid % 2].get_reader(
+                handle, pid, pid + 1, dict(maps_by_host))
+            for k, v in rd.read():
+                got[k].append(bytes(v) if not isinstance(v, bytes) else v)
+    finally:
+        for m in executors + [driver]:
+            m.stop()
+    assert set(got) == set(expected)
+    for k in expected:
+        assert sorted(got[k]) == sorted(expected[k]), k
+    assert ledger.double_releases() == 0, ledger.leak_report()
+
+
 def test_location_rpc_fault_is_a_clean_metadata_failure(faults_env):
     net, conf, driver, executors = _loop_cluster({
         "spark.shuffle.tpu.faultInject": "location_rpc:nth=1",
@@ -687,6 +773,9 @@ def test_chaos_soak_exact_or_clean_zero_leaks(
         "spark.shuffle.tpu.partitionLocationFetchTimeout": "8s",
         "spark.shuffle.tpu.connectTimeout": "5s",
     }
+    # make chaos-shake: SCHED_SHAKE=<seed> layers the deterministic
+    # schedule shaker + state validator onto the same soak
+    extra.update(shake_confs_from_env())
     if transport != "loopback":
         extra["spark.shuffle.tpu.transportAsyncDispatcher"] = (
             transport == "tcp-async")
@@ -788,3 +877,13 @@ def test_chaos_soak_exact_or_clean_zero_leaks(
         if getattr(inst, "name", "") == "resource_double_release_total"
     ]
     assert all(v == 0 for v in doubles), doubles
+    # under stateDebug/schedShake (make chaos-shake) every lifecycle
+    # transition was validated against its declared table: zero
+    # illegal-transition attempts allowed anywhere in the soak
+    illegal = [
+        (dict(inst.labels), inst.value)
+        for _k, inst in GLOBAL_REGISTRY.instruments()
+        if getattr(inst, "name", "") == "state_transitions_illegal_total"
+        and inst.value > 0
+    ]
+    assert not illegal, illegal

@@ -16,6 +16,7 @@ from sparkrdma_tpu.shuffle import reader as reader_mod
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
 from sparkrdma_tpu.shuffle.partitioner import HashPartitioner
 from sparkrdma_tpu.transport import LoopbackNetwork, TcpNetwork
+from sparkrdma_tpu.utils.statemachine import shake_confs_from_env
 
 # fresh base per cluster: clear of test_tcp (41000), test_shuffle_e2e
 # (37000/38000), the conftest ProcessCluster range (24200+), and the
@@ -50,6 +51,9 @@ def _make_cluster(transport, conf_extra):
         "spark.shuffle.tpu.partitionLocationFetchTimeout": "10s",
         "spark.shuffle.tpu.connectTimeout": "5s",
     }
+    # make chaos-shake: SCHED_SHAKE=<seed> reruns every push drill
+    # under the schedule shaker + state validator
+    confd.update(shake_confs_from_env())
     confd.update(conf_extra)
     if transport == "loopback":
         net = LoopbackNetwork()
@@ -124,6 +128,15 @@ def _run_cluster(transport, conf_extra, shuffle_id=0):
     finally:
         for m in executors + [driver]:
             m.stop()
+        # under stateDebug/schedShake every lifecycle transition was
+        # table-validated; a drill must never attempt an illegal one
+        illegal = [
+            (c["labels"], c["value"])
+            for c in GLOBAL_REGISTRY.snapshot()["counters"]
+            if c["name"] == "state_transitions_illegal_total"
+            and c["value"] > 0
+        ]
+        assert not illegal, illegal
 
 
 # -- bit-exactness sweep --------------------------------------------------

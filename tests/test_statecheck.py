@@ -1,0 +1,372 @@
+"""Tier-1 wrapper + unit fixtures for the lifecycle state-machine gate
+(tools/statecheck.py): the real tree must be clean with the full
+machine census discovered, and seeded violations must each produce
+exactly their SC finding."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_statecheck():
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_statecheck", REPO / "tools" / "statecheck.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _analyze_src(tmp_path, src: str, name="fixture.py"):
+    sc = _load_statecheck()
+    body = textwrap.dedent(src)
+    compile(body, name, "exec")  # a broken fixture must not pass as clean
+    f = tmp_path / name
+    f.write_text(body)
+    return sc.analyze([f], root=tmp_path)
+
+
+def _codes(findings):
+    return sorted(code for _rel, _line, code, _msg in findings)
+
+
+# a well-formed machine the violation fixtures perturb: three states,
+# one terminal, a linear a -> b -> c table, the mixin-shaped helper
+BASE = """\
+    class C:
+        MACHINE = "fix.c"
+        STATES = ("a", "b", "c")
+        INITIAL = "a"
+        TERMINAL = ("c",)
+        TRANSITIONS = {"a": ("b",), "b": ("c",)}
+
+        def __init__(self):
+            self._state = "a"  # state: fix.c
+
+        def _transition(self, to, frm=None):
+            self._state = to
+
+        def go(self):
+            self._transition("b", frm="a")
+"""
+
+
+# -- tier-1: the real tree ----------------------------------------------------
+
+
+def test_library_is_statecheck_clean():
+    sc = _load_statecheck()
+    findings = sc.analyze([REPO / "sparkrdma_tpu"])
+    assert not findings, "\n".join(
+        f"{rel}:{line}: {code} {msg}" for rel, line, code, msg in findings
+    )
+
+
+def test_library_machine_census_discovered():
+    """Clean AND nonempty: the analyzer actually discovered the
+    declared machine population (a discovery regression would pass
+    vacuously) — the inventory the README documents is >= 8 complete
+    machines with a real table and real call sites behind them."""
+    sc = _load_statecheck()
+    an = sc.Analyzer()
+    an.analyze_paths([REPO / "sparkrdma_tpu"])
+    machines = [m for m in an.machines if m.complete]
+    assert len(machines) >= 8, sorted(m.name for m in machines)
+    edges = sum(
+        len(dsts) for m in machines for dsts in m.transitions.values()
+    )
+    assert edges >= 40, edges
+    assert an.transition_sites >= 20, an.transition_sites
+    # every complete machine's seed token is its declared INITIAL
+    for m in machines:
+        assert m.initial in m.states, (m.name, m.initial)
+        assert set(m.terminal) <= set(m.states), m.name
+
+
+def test_base_fixture_is_clean(tmp_path):
+    assert _analyze_src(tmp_path, BASE) == []
+
+
+def test_runtime_module_is_skipped(tmp_path):
+    """utils/statemachine.py is the blessed writer (and its docstrings
+    hold grammar examples): a file by that name is never scanned."""
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def poke(c):
+            c._state = "b"
+    """, name="statemachine.py")
+    assert findings == []
+
+
+# -- SC01: raw state writes ---------------------------------------------------
+
+
+def test_sc01_raw_write_outside_helper(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        class User:
+            def poke(self, c):
+                c._state = "b"
+    """)
+    assert _codes(findings) == ["SC01"], findings
+    assert "raw write" in findings[0][3]
+
+
+def test_sc01_self_write_in_plain_method(tmp_path):
+    findings = _analyze_src(tmp_path, BASE.replace(
+        '        def go(self):\n'
+        '            self._transition("b", frm="a")',
+        '        def go(self):\n'
+        '            self._state = "b"',
+    ))
+    assert _codes(findings) == ["SC01"], findings
+
+
+def test_sc01_seeding_line_and_helper_are_exempt(tmp_path):
+    # BASE itself writes _state in __init__ (annotated) and in
+    # _transition (the helper) — both blessed
+    assert _analyze_src(tmp_path, BASE) == []
+
+
+# -- SC02: undeclared transitions ---------------------------------------------
+
+
+def test_sc02_transition_to_unknown_state(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def zap(c):
+            c._transition("vanished")
+    """)
+    assert _codes(findings) == ["SC02"], findings
+    assert "undeclared state" in findings[0][3]
+
+
+def test_sc02_missing_edge_with_frm(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def skip(c):
+            c._transition("c", frm="a")
+    """)
+    assert _codes(findings) == ["SC02"], findings
+    assert "not in the declared table" in findings[0][3]
+
+
+def test_sc02_no_edge_into_dest_without_frm(tmp_path):
+    src = BASE.replace(
+        'STATES = ("a", "b", "c")', 'STATES = ("a", "b", "c", "orphan")'
+    ) + """\
+
+        def strand(c):
+            c._transition("orphan")
+    """
+    findings = _analyze_src(tmp_path, src)
+    assert _codes(findings) == ["SC02"], findings
+    assert "no declared edge into" in findings[0][3]
+
+
+def test_sc02_seed_disagrees_with_initial(tmp_path):
+    findings = _analyze_src(tmp_path, BASE.replace(
+        'self._state = "a"  # state: fix.c',
+        'self._state = "b"  # state: fix.c',
+    ))
+    assert _codes(findings) == ["SC02"], findings
+
+
+def test_sc02_dynamic_arguments_are_runtime_territory(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def relay(c, nxt):
+            c._transition(nxt)
+    """)
+    assert findings == []
+
+
+def test_self_edge_is_a_legal_noop(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def reassert(c):
+            c._transition("a", frm="a")
+    """)
+    assert findings == []
+
+
+# -- SC03: unguarded branch reads ---------------------------------------------
+
+GUARDED = BASE.replace(
+    '# state: fix.c', '# state: fix.c guarded-by: _lock'
+).replace(
+    'def __init__(self):',
+    'def __init__(self):\n'
+    '            import threading\n'
+    '            self._lock = threading.Lock()',
+)
+
+
+def test_sc03_branch_read_without_guard(tmp_path):
+    findings = _analyze_src(tmp_path, GUARDED.replace(
+        '        def go(self):\n'
+        '            self._transition("b", frm="a")',
+        '        def go(self):\n'
+        '            if self._state == "a":\n'
+        '                self._transition("b", frm="a")',
+    ))
+    assert _codes(findings) == ["SC03"], findings
+    assert "without holding its declared guard" in findings[0][3]
+
+
+def test_sc03_read_under_the_guard_is_clean(tmp_path):
+    findings = _analyze_src(tmp_path, GUARDED.replace(
+        '        def go(self):\n'
+        '            self._transition("b", frm="a")',
+        '        def go(self):\n'
+        '            with self._lock:\n'
+        '                if self._state == "a":\n'
+        '                    self._transition("b", frm="a")',
+    ))
+    assert findings == []
+
+
+def test_sc03_external_owner_guard(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        import threading
+
+
+        class Ticket:
+            MACHINE = "fix.tkt"
+            STATES = ("queued", "done")
+            INITIAL = "queued"
+            TERMINAL = ("done",)
+            TRANSITIONS = {"queued": ("done",)}
+
+            def __init__(self):
+                self._state = "queued"  # state: fix.tkt guarded-by: Pool._cv
+
+            def _transition(self, to, frm=None):
+                self._state = to
+
+
+        class Pool:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def scan(self, t):
+                if t._state == "queued":
+                    return t
+
+            def scan_locked(self, t):
+                with self._cv:
+                    if t._state == "queued":
+                        return t
+    """)
+    assert _codes(findings) == ["SC03"], findings
+    # only the unlocked scan() read fires, not scan_locked()
+    assert findings[0][1] == 23, findings
+
+
+# -- SC04: terminal escapes ---------------------------------------------------
+
+
+def test_sc04_table_edge_out_of_terminal(tmp_path):
+    findings = _analyze_src(tmp_path, BASE.replace(
+        'TRANSITIONS = {"a": ("b",), "b": ("c",)}',
+        'TRANSITIONS = {"a": ("b",), "b": ("c",), "c": ("a",)}',
+    ))
+    assert _codes(findings) == ["SC04"], findings
+    assert "terminal" in findings[0][3]
+
+
+def test_sc04_call_site_frm_terminal(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def reopen(c):
+            c._transition("a", frm="c")
+    """)
+    assert _codes(findings) == ["SC04"], findings
+    assert "out of terminal" in findings[0][3]
+
+
+def test_sc04_lexical_use_after_terminal(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def finish(c):
+            c._transition("c", frm="b")
+            c._transition("b")
+    """)
+    assert _codes(findings) == ["SC04"], findings
+    assert "same path" in findings[0][3]
+
+
+def test_sc04_rebound_receiver_resets_the_path(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def recycle(mk):
+            c = mk()
+            c._transition("c", frm="b")
+            c = mk()
+            c._transition("b")
+    """)
+    assert findings == []
+
+
+def test_sc04_branches_are_separate_paths(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        def either(c, stop):
+            if stop:
+                c._transition("c", frm="b")
+            else:
+                c._transition("b")
+    """)
+    assert findings == []
+
+
+# -- SC05: undeclared / inconsistent machines ---------------------------------
+
+
+def test_sc05_annotation_without_a_table(tmp_path):
+    findings = _analyze_src(tmp_path, """\
+        class Bare:
+            def __init__(self):
+                self._state = "new"  # state: fix.bare
+    """)
+    assert _codes(findings) == ["SC05"], findings
+
+
+def test_sc05_machine_name_disagrees(tmp_path):
+    findings = _analyze_src(tmp_path, BASE.replace(
+        'MACHINE = "fix.c"', 'MACHINE = "fix.other"'
+    ))
+    assert "SC05" in _codes(findings), findings
+
+
+def test_sc05_transition_token_outside_states(tmp_path):
+    findings = _analyze_src(tmp_path, BASE.replace(
+        'TRANSITIONS = {"a": ("b",), "b": ("c",)}',
+        'TRANSITIONS = {"a": ("b",), "b": ("zz",)}',
+    ))
+    assert "SC05" in _codes(findings), findings
+
+
+# -- suppression: code-scoped noqa --------------------------------------------
+
+
+def test_noqa_silences_exactly_its_code(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        class User:
+            def poke(self, c):
+                c._state = "b"  # noqa: SC01 deliberate test write
+    """)
+    assert findings == []
+
+
+def test_noqa_for_another_code_does_not_silence(tmp_path):
+    findings = _analyze_src(tmp_path, BASE + """\
+
+        class User:
+            def poke(self, c):
+                c._state = "b"  # noqa: SC03 wrong code
+    """)
+    assert _codes(findings) == ["SC01"], findings

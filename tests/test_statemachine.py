@@ -1,0 +1,533 @@
+"""Runtime lifecycle state-machine validator + schedule shaker
+(utils/statemachine.py, conf stateDebug / schedShake):
+
+- with the conf OFF, ``_transition()`` is the plain assignment —
+  structural identity plus a striped-fetch A/B microbench;
+- with it ON, legal transitions count
+  ``state_transitions_total{machine=,from=,to=}``, terminal entries
+  count the terminal census, illegal edges raise
+  :class:`IllegalTransition` with a 4-frame call site, and ``frm=``
+  mismatches report expected-vs-seen;
+- the schedule shaker replays a deterministic per-machine perturbation
+  stream for a fixed seed;
+- pinning regressions for the two ordering bugs the annotation sweep
+  surfaced: the breaker's stale-success-in-OPEN window and
+  ``manager.stop()``'s unguarded check-then-set."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.conf import TpuShuffleConf
+from sparkrdma_tpu.faults.breaker import CircuitBreaker
+from sparkrdma_tpu.metrics import GLOBAL_REGISTRY
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.transport import LoopbackNetwork
+from sparkrdma_tpu.utils.statemachine import (
+    GLOBAL_STATE_DEBUG,
+    IllegalTransition,
+    StateMachine,
+    check_named,
+    get_state_debug,
+    shake_confs_from_env,
+    state_token,
+)
+
+BASE_PORT = 26400
+
+
+@pytest.fixture()
+def state_env():
+    """Save/restore the process-global validator + metrics registry."""
+    sd = get_state_debug()
+    prev_enabled, prev_seed = sd.enabled, sd.shake_seed
+    prev_reg = GLOBAL_REGISTRY.enabled
+    GLOBAL_REGISTRY.enabled = True
+    GLOBAL_REGISTRY.reset()
+    sd.reset()
+    yield sd
+    sd.enabled, sd.shake_seed = prev_enabled, prev_seed
+    sd.reset()
+    GLOBAL_REGISTRY.enabled = prev_reg
+    GLOBAL_REGISTRY.reset()
+
+
+def _metric(name, **labels):
+    for c in GLOBAL_REGISTRY.snapshot()["counters"]:
+        if c["name"] == name and c["labels"] == labels:
+            return c["value"]
+    return 0
+
+
+class Door(StateMachine):
+    MACHINE = "test.door"
+    STATES = ("open", "closing", "closed")
+    INITIAL = "open"
+    TERMINAL = ("closed",)
+    TRANSITIONS = {
+        "open": ("closing",),
+        "closing": ("closed",),
+    }
+
+    def __init__(self):
+        self._state = "open"  # state: test.door
+
+
+# -- state_token --------------------------------------------------------------
+
+
+def test_state_token_strings_pass_through():
+    assert state_token("half-open") == "half-open"
+
+
+def test_state_token_enum_members_lower_name():
+    import enum
+
+    class S(enum.Enum):
+        IDLE = 0
+        RESP_HDR = 7
+
+    assert state_token(S.IDLE) == "idle"
+    assert state_token(S.RESP_HDR) == "resp_hdr"
+
+
+# -- disabled: plain assignment ----------------------------------------------
+
+
+def test_disabled_transition_is_plain_assignment(state_env):
+    state_env.enabled = False
+    d = Door()
+    d._transition("closed")  # illegal edge — nobody checks when off
+    assert d._state == "closed"
+    assert _metric("state_transitions_total", machine="test.door",
+                   **{"from": "open", "to": "closed"}) == 0
+    assert not state_env._rngs
+
+
+# -- enabled: validation, counters, terminal census ---------------------------
+
+
+def test_legal_walk_counts_transitions_and_terminal(state_env):
+    state_env.enabled = True
+    d = Door()
+    d._transition("closing", frm="open")
+    d._transition("closed", frm="closing")
+    assert d._state == "closed"
+    assert _metric("state_transitions_total", machine="test.door",
+                   **{"from": "open", "to": "closing"}) == 1
+    assert _metric("state_transitions_total", machine="test.door",
+                   **{"from": "closing", "to": "closed"}) == 1
+    assert _metric("state_terminal_total", machine="test.door",
+                   state="closed") == 1
+
+
+def test_illegal_edge_raises_with_site_chain(state_env):
+    state_env.enabled = True
+    d = Door()
+    with pytest.raises(IllegalTransition) as ei:
+        d._transition("closed")  # open -> closed not declared
+    err = ei.value
+    assert (err.machine, err.frm, err.to) == ("test.door", "open", "closed")
+    # 4-frame site chain: file:line:function, joined by ' <- '
+    assert "test_statemachine.py" in err.site
+    assert err.site.count(" <- ") >= 1
+    assert d._state == "open"  # the write never happened
+    assert _metric("state_transitions_illegal_total",
+                   machine="test.door") == 1
+
+
+def test_frm_mismatch_reports_expected_vs_seen(state_env):
+    state_env.enabled = True
+    d = Door()
+    with pytest.raises(IllegalTransition) as ei:
+        d._transition("closing", frm="closing")
+    assert "expected from='closing' saw 'open'" in str(ei.value)
+
+
+def test_self_transition_is_silent_noop(state_env):
+    state_env.enabled = True
+    d = Door()
+    d._transition("open")  # re-assert current state: legal, uncounted
+    assert d._state == "open"
+    assert _metric("state_transitions_total", machine="test.door",
+                   **{"from": "open", "to": "open"}) == 0
+
+
+def test_terminal_writes_raise(state_env):
+    state_env.enabled = True
+    d = Door()
+    d._transition("closing")
+    d._transition("closed")
+    with pytest.raises(IllegalTransition):
+        d._transition("open")  # terminal states declare no edges out
+
+
+def test_check_named_secondary_table(state_env):
+    state_env.enabled = True
+
+    class Host:
+        RX_TRANSITIONS = {"hdr": ("rpc",), "rpc": ("hdr",)}
+
+        def __init__(self):
+            self._rx_state = "hdr"
+
+        def _transition_rx(self, state):
+            if GLOBAL_STATE_DEBUG.enabled:
+                check_named(self, state, name="test.rx", field="_rx_state",
+                            transitions=self.RX_TRANSITIONS)
+            self._rx_state = state
+
+    h = Host()
+    h._transition_rx("rpc")
+    h._transition_rx("hdr")
+    assert _metric("state_transitions_total", machine="test.rx",
+                   **{"from": "hdr", "to": "rpc"}) == 1
+    with pytest.raises(IllegalTransition):
+        h._transition_rx("nonsense")
+
+
+# -- the schedule shaker ------------------------------------------------------
+
+
+def test_shaker_streams_are_deterministic_per_machine(state_env):
+    state_env.enabled = True
+    state_env.shake_seed = 20260807
+    d = Door()
+    d._transition("closing")
+    d._transition("closed")
+    draws_a = dict(state_env._rngs)
+    assert "test.door" in draws_a
+    # re-arm with the same seed: the stream replays bit-for-bit
+    state_env.reset()
+    d2 = Door()
+    d2._transition("closing")
+    d2._transition("closed")
+    # same seed + same machine + same call count => same rng position
+    a = draws_a["test.door"].random()
+    b = state_env._rngs["test.door"].random()
+    assert a == b
+
+
+def test_shake_implies_state_debug_via_conf():
+    conf = TpuShuffleConf({"spark.shuffle.tpu.schedShake": 123})
+    assert conf.sched_shake == 123
+    assert conf.state_debug  # shake without validation is meaningless
+    off = TpuShuffleConf({})
+    assert off.sched_shake == 0 and not off.state_debug
+
+
+def test_shake_confs_from_env():
+    assert shake_confs_from_env({}) == {}
+    got = shake_confs_from_env({"SCHED_SHAKE": "7"})
+    assert got["spark.shuffle.tpu.schedShake"] == "7"
+    assert got["spark.shuffle.tpu.stateDebug"] is True
+
+
+def test_manager_arms_global_validator_from_conf(state_env):
+    state_env.enabled = False
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.stateDebug": True,
+        "spark.shuffle.tpu.driverPort": BASE_PORT,
+    })
+    m = TpuShuffleManager(conf, is_driver=True, network=LoopbackNetwork())
+    try:
+        assert state_env.enabled
+    finally:
+        m.stop()
+
+
+# -- pinning: the breaker probe window ----------------------------------------
+
+
+def test_breaker_stale_success_does_not_close_open_breaker(state_env):
+    """A success recorded while OPEN is a response to a fetch issued
+    BEFORE the trip: closing on it would skip the half-open probe
+    protocol off one straggler.  The sweep found record_success()
+    doing exactly that; it must stay OPEN now."""
+    state_env.enabled = True
+    clk = [0.0]
+    br = CircuitBreaker(failures=2, reset_ms=100, name="p",
+                        clock=lambda: clk[0])
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"
+    br.record_success()  # the straggler lands
+    assert br.state == "open"  # NOT closed: probe is the only way back
+    assert not br.allow()  # still refusing inside the reset window
+    clk[0] = 0.2
+    assert br.allow()  # the probe
+    assert br.state == "half-open"
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_probe_failure_reopens(state_env):
+    state_env.enabled = True
+    clk = [0.0]
+    br = CircuitBreaker(failures=1, reset_ms=50, name="p",
+                        clock=lambda: clk[0])
+    br.record_failure()
+    clk[0] = 0.1
+    assert br.allow()
+    br.record_failure()  # probe failed
+    assert br.state == "open"
+    assert not br.allow()  # clock restarted
+    assert _metric("state_transitions_illegal_total",
+                   machine="faults.breaker") == 0
+
+
+# -- pinning: concurrent manager.stop() ---------------------------------------
+
+
+def test_concurrent_manager_stop_single_teardown(state_env):
+    """The sweep found stop()'s stopped-check was check-then-set
+    without a lock: two racing stops could BOTH run teardown (double
+    ledger flush, double node stop).  Under stateDebug a double
+    teardown would now raise IllegalTransition (running->stopping
+    twice); the _life_lock transition makes the loser return early."""
+    state_env.enabled = True
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.stateDebug": True,
+        "spark.shuffle.tpu.driverPort": BASE_PORT + 40,
+    })
+    m = TpuShuffleManager(conf, is_driver=True, network=LoopbackNetwork())
+    errors = []
+    gate = threading.Barrier(4)
+
+    def stopper():
+        try:
+            gate.wait(5)
+            m.stop()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=stopper) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+        assert not t.is_alive(), "stop() hung"
+    assert not errors, errors
+    assert m._state == "stopped"
+    # exactly one winner made each lifecycle edge
+    assert _metric("state_transitions_total", machine="manager.lifecycle",
+                   **{"from": "running", "to": "stopping"}) == 1
+    assert _metric("state_transitions_total", machine="manager.lifecycle",
+                   **{"from": "stopping", "to": "stopped"}) == 1
+    assert _metric("state_transitions_illegal_total",
+                   machine="manager.lifecycle") == 0
+    m.stop()  # idempotent afterwards
+
+
+# -- identity: stateDebug=off on the striped-fetch microbench -----------------
+
+
+def _striped_fetch_once(port):
+    """One striped read pair on loopback (the test_striped_transport
+    harness shape, shrunk): returns elapsed seconds for 12 reads."""
+    from sparkrdma_tpu.memory.arena import ArenaManager
+    from sparkrdma_tpu.transport.channel import FnCompletionListener
+    from sparkrdma_tpu.transport.node import Node
+    from sparkrdma_tpu.utils.types import BlockLocation
+
+    pattern = (np.arange(1 << 20, dtype=np.uint32) % 251).astype(np.uint8)
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.transportNumStripes": 2,
+        "spark.shuffle.tpu.transportStripeThreshold": "64k",
+    })
+    net = LoopbackNetwork()
+    a = Node(("127.0.0.1", port), conf)
+    b = Node(("127.0.0.1", port + 7), conf)
+    net.register(a)
+    net.register(b)
+    arena = ArenaManager()
+    seg = arena.register(pattern, zero_copy_ok=True)
+    b.register_block_store(seg.mkey, arena)
+    try:
+        group = a.get_read_group(b.address, net.connect)
+        t0 = time.perf_counter()
+        for _ in range(12):
+            done = threading.Event()
+            res = {}
+            group.read_blocks(
+                [BlockLocation(0, len(pattern), seg.mkey)],
+                FnCompletionListener(
+                    lambda blocks: (res.setdefault("b", blocks),
+                                    done.set()),
+                    lambda e: (res.setdefault("e", e), done.set()),
+                ),
+            )
+            assert done.wait(30), "striped read hung"
+            assert "e" not in res, res.get("e")
+        return time.perf_counter() - t0
+    finally:
+        a.stop()
+        b.stop()
+        net.unregister(a)
+        net.unregister(b)
+
+
+def test_identity_state_debug_off_striped_fetch(state_env):
+    """stateDebug=off must not tax the striped fetch path: B (the
+    _transition helper, debug off) vs A (raw assignment, the pre-gate
+    baseline reconstructed by patching the mixin) at >= 0.95x."""
+    state_env.enabled = False
+    raw = StateMachine._transition
+
+    def plain(self, to, frm=None):
+        setattr(self, self.STATE_FIELD, to)
+
+    try:
+        # interleave A/B pairs, keep the best of each: one warmup pair
+        # absorbs import/JIT costs, min-of-3 absorbs scheduler noise
+        a_times, b_times = [], []
+        _striped_fetch_once(BASE_PORT + 60)
+        for i in range(3):
+            StateMachine._transition = plain
+            a_times.append(_striped_fetch_once(BASE_PORT + 80 + i * 20))
+            StateMachine._transition = raw
+            b_times.append(_striped_fetch_once(BASE_PORT + 160 + i * 20))
+    finally:
+        StateMachine._transition = raw
+    a, b = min(a_times), min(b_times)
+    assert b <= a / 0.95 + 0.05, (
+        f"stateDebug=off striped fetch {b:.4f}s vs raw-assignment "
+        f"baseline {a:.4f}s — more than 5% overhead"
+    )
+
+
+# -- metrics_report: the state-machines table ---------------------------------
+
+
+def test_metrics_report_state_machine_table():
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "sparkrdma_tpu_metrics_report", repo / "tools" / "metrics_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    counters = [
+        {"name": "state_transitions_total",
+         "labels": {"machine": "m.x", "from": "a", "to": "b"}, "value": 3},
+        {"name": "state_terminal_total",
+         "labels": {"machine": "m.x", "state": "b"}, "value": 1},
+        {"name": "state_transitions_illegal_total",
+         "labels": {"machine": "m.y"}, "value": 2},
+        {"name": "unrelated_total", "labels": {}, "value": 9},
+    ]
+    lines = mod.render_state_machines(counters)
+    joined = "\n".join(lines)
+    assert lines[0].startswith("state machines")
+    assert "m.x" in joined and "top=a->b (3)" in joined
+    assert "terminal: b=1" in joined
+    assert "ILLEGAL=2" in joined
+    assert mod.render_state_machines([]) == []
+
+
+# -- property-based transition fuzz (hypothesis, optional dev dep) ------------
+
+
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # optional dev dep (pyproject [dev]); not in the image
+    HAVE_HYPOTHESIS = False
+
+    def given(**kw):  # pragma: no cover - placeholder decorators
+        return lambda fn: fn
+
+    def settings(**kw):  # pragma: no cover
+        return lambda fn: fn
+
+    class st:  # pragma: no cover - strategy args evaluate at import
+        lists = staticmethod(lambda *a, **kw: None)
+        sampled_from = staticmethod(lambda *a, **kw: None)
+
+needs_hypothesis = pytest.mark.skipif(
+    not HAVE_HYPOTHESIS, reason="hypothesis not installed (optional dev dep)")
+
+
+def _walk_machine(states, transitions, steps):
+    """Drive a Door-like object through a random token walk; every
+    step must either be a declared edge (mutates) or raise without
+    mutating.  Returns the number of accepted steps."""
+
+    class M(StateMachine):
+        MACHINE = "fuzz.m"
+        STATES = tuple(states)
+        INITIAL = states[0]
+        TERMINAL = ()
+        TRANSITIONS = transitions
+
+        def __init__(self):
+            self._state = states[0]  # state: fuzz.m
+
+    m = M()
+    accepted = 0
+    for to in steps:
+        cur = m._state
+        legal = to == cur or to in transitions.get(cur, ())
+        if legal:
+            m._transition(to)
+            assert m._state == to
+            accepted += 1
+        else:
+            with pytest.raises(IllegalTransition):
+                m._transition(to)
+            assert m._state == cur  # a refused edge never mutates
+    return accepted
+
+
+@needs_hypothesis
+@settings(max_examples=60, deadline=None)
+@given(steps=st.lists(
+    st.sampled_from(["closed", "open", "half-open", "bogus"]),
+    max_size=40))
+def test_fuzz_breaker_table_walk(steps):
+    sd = get_state_debug()
+    prev = sd.enabled
+    sd.enabled = True
+    try:
+        _walk_machine(
+            ["closed", "open", "half-open"],
+            dict(CircuitBreaker.TRANSITIONS), steps)
+    finally:
+        sd.enabled = prev
+
+
+@needs_hypothesis
+@settings(max_examples=60, deadline=None)
+@given(steps=st.lists(
+    st.sampled_from(["accepting", "sealed", "committed"]), max_size=40))
+def test_fuzz_push_merge_table_walk(steps):
+    from sparkrdma_tpu.shuffle.push import _ReduceMerge
+
+    sd = get_state_debug()
+    prev = sd.enabled
+    sd.enabled = True
+    try:
+        _walk_machine(
+            ["accepting", "sealed", "committed"],
+            dict(_ReduceMerge.TRANSITIONS), steps)
+    finally:
+        sd.enabled = prev
+
+
+@needs_hypothesis
+@settings(max_examples=40, deadline=None)
+@given(steps=st.lists(st.sampled_from(["open", "closed"]), max_size=20))
+def test_fuzz_decode_stream_table_walk(steps):
+    from sparkrdma_tpu.shuffle.decode import DecodeStream
+
+    sd = get_state_debug()
+    prev = sd.enabled
+    sd.enabled = True
+    try:
+        _walk_machine(["open", "closed"],
+                      dict(DecodeStream.TRANSITIONS), steps)
+    finally:
+        sd.enabled = prev

@@ -69,10 +69,6 @@ from typing import Dict, List, Optional, Set, Tuple
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 LIB = ROOT / "sparkrdma_tpu"
 
-THREADING_LOCKS = {"Lock": "Lock", "RLock": "RLock",
-                   "Condition": "Condition"}
-DBG_CTORS = {"dbg_lock": "Lock", "dbg_rlock": "RLock",
-             "dbg_condition": "Condition"}
 SOCKET_BLOCKING = {"sendall", "sendmsg", "recv", "recv_into", "accept",
                    "connect", "create_connection"}
 # the tiered block store's disk-read entry points (memory/tier.py /
@@ -82,8 +78,6 @@ SOCKET_BLOCKING = {"sendall", "sendmsg", "recv", "recv_into", "accept",
 DISK_BLOCKING = {"pread", "preadv", "ensure_mapped", "_disk_read",
                  "_load_row"}
 
-RANK_RE = re.compile(r"#\s*lock-order:\s*(-?\d+)")
-GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 ONLOOP_RE = re.compile(r"#\s*on-loop\b")
 
 # op tags CK02 never flags (sleep-under-lock predates the tagging;
@@ -96,245 +90,25 @@ CK05_OPS = {"sendall", "connect", "create_connection", "subprocess",
             "join", "queue-get", "event-wait", "cond-wait",
             "cond-wait-self", "sleep"}
 
-# ONE noqa grammar + suppression decision for both gates: tools/lint.py
-# owns the definition (code-scoped sets, bare-noqa = everything, alias
-# handling)
+# the shared gate plumbing (noqa grammar, finding shape, file walking,
+# lock declaration + guard resolution) lives in tools/gatelib.py; the
+# historical local names are bound here so the analysis passes and the
+# gate's tests read unchanged
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
-from lint import _suppressed as _lint_suppressed
-
-Finding = Tuple[object, int, str, str]  # (rel, line, code, message)
-LockId = Tuple[str, ...]
-
-
-class _Suppressor:
-    def __init__(self, lines: List[str]):
-        self._lines = lines
-
-    def suppressed(self, lineno: int, code: str) -> bool:
-        return _lint_suppressed(self._lines, lineno, code)
-
-
-class LockDecl:
-    __slots__ = ("lock_id", "kind", "rank", "line", "group", "name")
-
-    def __init__(self, lock_id: LockId, kind: str, rank: Optional[int],
-                 line: int, group: bool, name: str):
-        self.lock_id = lock_id
-        self.kind = kind
-        self.rank = rank
-        self.line = line
-        self.group = group
-        self.name = name
-
-
-class ClassInfo:
-    def __init__(self, name: str):
-        self.name = name
-        self.locks: Dict[str, LockDecl] = {}
-        self.events: Set[str] = set()
-        self.queues: Set[str] = set()
-        self.threads: Set[str] = set()
-        self.guarded: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
-        self.methods: Dict[str, ast.AST] = {}
-
-
-class ModuleInfo:
-    def __init__(self, rel: str, lines: List[str], tree: ast.Module):
-        self.rel = rel
-        self.lines = lines
-        self.tree = tree  # parsed once, shared by both passes
-        self.locks: Dict[str, LockDecl] = {}  # module-level, by name
-        self.classes: Dict[str, ClassInfo] = {}
-
-
-def _call_name(func: ast.expr) -> str:
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return ""
-
-
-def _lock_ctor(node: ast.expr) -> Optional[Tuple[str, Optional[int]]]:
-    """(kind, dbg rank or None) when ``node`` constructs a lock."""
-    if not isinstance(node, ast.Call):
-        return None
-    f = node.func
-    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
-            and f.value.id == "threading"
-            and f.attr in THREADING_LOCKS):
-        return THREADING_LOCKS[f.attr], None
-    name = _call_name(f)
-    if name in DBG_CTORS:
-        rank = None
-        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
-                and isinstance(node.args[1].value, int):
-            rank = node.args[1].value
-        for kw in node.keywords:
-            if kw.arg == "rank" and isinstance(kw.value, ast.Constant) \
-                    and isinstance(kw.value.value, int):
-                rank = kw.value.value
-        return DBG_CTORS[name], rank
-    return None
-
-
-def _lock_group_ctor(node: ast.expr) -> Optional[str]:
-    """Kind when ``node`` builds a list of locks (lock striping)."""
-    elts: List[ast.expr] = []
-    if isinstance(node, (ast.List, ast.Tuple)):
-        elts = list(node.elts)
-    elif isinstance(node, ast.ListComp):
-        elts = [node.elt]
-    for e in elts:
-        got = _lock_ctor(e)
-        if got is not None:
-            return got[0]
-    return None
-
-
-def _ctor_of(node: ast.expr, module: str, names: Set[str]) -> bool:
-    """``node`` is a call to module.name() or a bare name() in names."""
-    if not isinstance(node, ast.Call):
-        return False
-    f = node.func
-    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
-            and f.value.id == module and f.attr in names):
-        return True
-    return isinstance(f, ast.Name) and f.id in names
-
-
-# -- pass 1: declarations ----------------------------------------------------
-def _collect_module(rel: str, tree: ast.Module,
-                    lines: List[str], findings: List[Finding],
-                    sup: _Suppressor) -> ModuleInfo:
-    mod = ModuleInfo(rel, lines, tree)
-    for stmt in tree.body:
-        target = None
-        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
-                and isinstance(stmt.targets[0], ast.Name):
-            target, value = stmt.targets[0].id, stmt.value
-        elif isinstance(stmt, ast.AnnAssign) \
-                and isinstance(stmt.target, ast.Name) \
-                and stmt.value is not None:
-            target, value = stmt.target.id, stmt.value
-        if target is None:
-            continue
-        got = _lock_ctor(value)
-        if got is not None:
-            kind, dbg_rank = got
-            mod.locks[target] = _make_decl(
-                ("mod", rel, target), kind, dbg_rank, stmt.lineno,
-                False, target, lines, findings, sup, rel,
-                stmt.end_lineno,
-            )
-    for stmt in tree.body:
-        if isinstance(stmt, ast.ClassDef):
-            mod.classes[stmt.name] = _collect_class(
-                rel, stmt, lines, findings, sup
-            )
-    # nested classes (e.g. helper classes defined inside functions) are
-    # rare; classes nested one level inside classes are picked up too
-    for stmt in ast.walk(tree):
-        if isinstance(stmt, ast.ClassDef) and stmt.name not in mod.classes:
-            mod.classes[stmt.name] = _collect_class(
-                rel, stmt, lines, findings, sup
-            )
-    return mod
-
-
-def _span_search(pattern: re.Pattern, lines: List[str], lineno: int,
-                 end_lineno: Optional[int]):
-    """Search a statement's whole line span (multi-line assignments
-    carry their trailing annotation comment on the LAST line)."""
-    for i in range(lineno, (end_lineno or lineno) + 1):
-        if i <= len(lines):
-            m = pattern.search(lines[i - 1])
-            if m is not None:
-                return m
-    return None
-
-
-def _make_decl(lock_id: LockId, kind: str, dbg_rank: Optional[int],
-               lineno: int, group: bool, name: str, lines: List[str],
-               findings: List[Finding], sup: _Suppressor,
-               rel: str, end_lineno: Optional[int] = None) -> LockDecl:
-    m = _span_search(RANK_RE, lines, lineno, end_lineno)
-    rank = int(m.group(1)) if m else None
-    if rank is not None and dbg_rank is not None and rank != dbg_rank:
-        if not sup.suppressed(lineno, "CK04"):
-            findings.append((rel, lineno, "CK04",
-                             f"lock {name}: # lock-order comment ({rank}) "
-                             f"disagrees with dbg rank ({dbg_rank})"))
-    if rank is None:
-        rank = dbg_rank
-    if rank is None and not sup.suppressed(lineno, "CK04"):
-        findings.append(
-            (rel, lineno, "CK04",
-             f"lock {name} has no rank — annotate its creation line "
-             f"with '# lock-order: N' (or create it via dbg_lock/"
-             f"dbg_rlock/dbg_condition with a rank argument)")
-        )
-    return LockDecl(lock_id, kind, rank, lineno, group, name)
-
-
-def _collect_class(rel: str, cls: ast.ClassDef, lines: List[str],
-                   findings: List[Finding],
-                   sup: _Suppressor) -> ClassInfo:
-    info = ClassInfo(cls.name)
-    for item in cls.body:
-        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            info.methods[item.name] = item
-    for meth in info.methods.values():
-        for node in ast.walk(meth):
-            target = None
-            if isinstance(node, ast.Assign) and len(node.targets) == 1:
-                tgt = node.targets[0]
-                if isinstance(tgt, ast.Attribute) \
-                        and isinstance(tgt.value, ast.Name) \
-                        and tgt.value.id == "self":
-                    target, value = tgt.attr, node.value
-            elif isinstance(node, ast.AnnAssign) \
-                    and isinstance(node.target, ast.Attribute) \
-                    and isinstance(node.target.value, ast.Name) \
-                    and node.target.value.id == "self" \
-                    and node.value is not None:
-                target, value = node.target.attr, node.value
-            if target is None:
-                continue
-            got = _lock_ctor(value)
-            group_kind = _lock_group_ctor(value) if got is None else None
-            if got is not None or group_kind is not None:
-                kind, dbg_rank = got if got is not None \
-                    else (group_kind, None)
-                info.locks[target] = _make_decl(
-                    ("attr", rel, cls.name, target), kind, dbg_rank,
-                    node.lineno, got is None, f"{cls.name}.{target}",
-                    lines, findings, sup, rel, node.end_lineno,
-                )
-                continue
-            if _ctor_of(value, "threading", {"Event"}):
-                info.events.add(target)
-            elif _ctor_of(value, "queue", {"Queue", "SimpleQueue",
-                                           "LifoQueue", "PriorityQueue"}):
-                info.queues.add(target)
-            elif _ctor_of(value, "threading", {"Thread", "Timer"}):
-                info.threads.add(target)
-            g = _span_search(GUARD_RE, lines, node.lineno,
-                             node.end_lineno)
-            if g is not None:
-                info.guarded[target] = (g.group(1), node.lineno)
-    return info
-
-
-# -- pass 2: per-function region analysis ------------------------------------
-class _Held:
-    __slots__ = ("key", "lock_id", "kind", "line")
-
-    def __init__(self, key, lock_id, kind, line):
-        self.key = key        # (receiver, attr) or ("", name)
-        self.lock_id = lock_id
-        self.kind = kind
-        self.line = line
+from gatelib import (  # noqa: E402
+    ClassInfo,
+    Finding,
+    Held as _Held,
+    LockDecl,
+    LockId,
+    ModuleInfo,
+    Suppressor as _Suppressor,
+    collect_module as _collect_module,
+    ctor_of as _ctor_of,
+    lock_ctor as _lock_ctor,
+    resolve_lock as _resolve_lock_expr,
+    walk_py as _walk_py,
+)
 
 
 class _FnScan(ast.NodeVisitor):
@@ -363,32 +137,9 @@ class _FnScan(ast.NodeVisitor):
     # -- resolution ---------------------------------------------------------
     def _resolve_lock(self, expr: ast.expr):
         """(key, decl-or-None) for a with-item that looks like a lock;
-        None when it is not lock-shaped at all."""
-        if isinstance(expr, ast.Subscript):
-            expr = expr.value
-        if isinstance(expr, ast.Attribute) \
-                and isinstance(expr.value, ast.Name):
-            recv, attr = expr.value.id, expr.attr
-            decl = None
-            if self.cls is not None and attr in self.cls.locks:
-                decl = self.cls.locks[attr]
-            else:
-                owners = [
-                    c for c in self.mod.classes.values()
-                    if attr in c.locks
-                ]
-                if len(owners) == 1:
-                    decl = owners[0].locks[attr]
-            if decl is not None or attr.endswith("lock") \
-                    or attr.endswith("_cv"):
-                return (recv, attr), decl
-            return None
-        if isinstance(expr, ast.Name):
-            if expr.id in self.mod.locks:
-                return ("", expr.id), self.mod.locks[expr.id]
-            if expr.id in self.local_locks:
-                return ("", expr.id), None
-        return None
+        None when it is not lock-shaped at all (gatelib.resolve_lock)."""
+        return _resolve_lock_expr(self.mod, self.cls, self.local_locks,
+                                  expr)
 
     # -- traversal ----------------------------------------------------------
     def visit_ClassDef(self, node):
@@ -656,13 +407,7 @@ class Analyzer:
 
     # -- entry points --------------------------------------------------------
     def analyze_paths(self, paths) -> List[Finding]:
-        files: List[pathlib.Path] = []
-        for p in paths:
-            p = pathlib.Path(p)
-            if p.is_dir():
-                files.extend(sorted(p.rglob("*.py")))
-            else:
-                files.append(p)
+        files = _walk_py(paths)
         for f in files:
             self._load(f)
         for f in files:

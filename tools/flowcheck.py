@@ -79,21 +79,18 @@ OWNS_RE = re.compile(
 )
 ONESHOT_RE = re.compile(r"#\s*one-shot\b")
 
-# ONE noqa grammar + suppression decision for all three gates:
-# tools/lint.py owns the definition (code-scoped sets, bare-noqa =
-# everything, alias handling)
+# the shared gate plumbing (noqa grammar, finding shape, file walking,
+# span helpers) lives in tools/gatelib.py; the historical local names
+# are bound here so the analysis passes and the gate's tests read
+# unchanged
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
-from lint import _suppressed as _lint_suppressed  # noqa: E402
-
-Finding = Tuple[object, int, str, str]  # (rel, line, code, message)
-
-
-class _Suppressor:
-    def __init__(self, lines: List[str]):
-        self._lines = lines
-
-    def suppressed(self, lineno: int, code: str) -> bool:
-        return _lint_suppressed(self._lines, lineno, code)
+from gatelib import (  # noqa: E402
+    Finding,
+    Suppressor as _Suppressor,
+    stmt_header_span as _stmt_header_span,
+    string_lines as _string_lines,
+    walk_py as _walk_py,
+)
 
 
 class Site:
@@ -140,16 +137,6 @@ _COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
 
 def _split_names(spec: str) -> List[str]:
     return [s.strip() for s in spec.split(",") if s.strip()]
-
-
-def _stmt_header_span(stmt: ast.stmt) -> Tuple[int, int]:
-    """Line span carrying a statement's trailing annotation: the whole
-    span for simple statements, only the header line(s) for compound
-    ones (their bodies' annotations belong to the inner statements)."""
-    if isinstance(stmt, _COMPOUND):
-        first_body = stmt.body[0].lineno if stmt.body else stmt.lineno
-        return stmt.lineno, max(stmt.lineno, first_body - 1)
-    return stmt.lineno, stmt.end_lineno or stmt.lineno
 
 
 def _span_find(pattern: re.Pattern, lines: List[str], lo: int,
@@ -257,20 +244,6 @@ class _FnWalk:
                 self.walk_suite(case.body, in_finally, prot, fin)
 
 
-def _string_lines(tree: ast.Module) -> Set[int]:
-    """Lines covered by multi-line string constants (docstrings,
-    embedded text): annotation grammar EXAMPLES live there — never
-    live annotations — so every scan skips these lines."""
-    out: Set[int] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) \
-                and isinstance(node.value, str) \
-                and node.end_lineno is not None \
-                and node.end_lineno > node.lineno:
-            out.update(range(node.lineno, node.end_lineno + 1))
-    return out
-
-
 class ModuleInfo:
     def __init__(self, rel: str, lines: List[str], tree: ast.Module):
         self.rel = rel
@@ -299,13 +272,7 @@ class Analyzer:
 
     # -- entry points --------------------------------------------------------
     def analyze_paths(self, paths) -> List[Finding]:
-        files: List[pathlib.Path] = []
-        for p in paths:
-            p = pathlib.Path(p)
-            if p.is_dir():
-                files.extend(sorted(p.rglob("*.py")))
-            else:
-                files.append(p)
+        files = _walk_py(paths)
         for f in files:
             self._load(f)
         for mod in self.modules.values():

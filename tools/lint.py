@@ -78,46 +78,19 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 PY_MAX_LINE = 88
 CC_MAX_LINE = 100
 
-NOQA_RE = re.compile(r"#\s*noqa\b(?:\s*:\s*(?P<codes>[^#]*))?", re.I)
-_CODE_TOKEN_RE = re.compile(r"[A-Za-z]+\d+")
-# foreign linter codes accepted as aliases for ours
-_CODE_ALIASES = {"PY05": {"F401"}}
+# the noqa grammar + file walking live in tools/gatelib.py (shared by
+# every gate); the historical private names are re-exported here so
+# the other gates' ``from lint import _suppressed`` keeps meaning ONE
+# suppression decision
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from gatelib import (  # noqa: PY05 _noqa_codes re-exported for tests
+    PY_DIRS,
+    noqa_codes as _noqa_codes,
+    suppressed as _suppressed,
+)
+
 _IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
 
-
-def _noqa_codes(line: str):
-    """None = no noqa on the line; empty set = bare ``# noqa``
-    (suppresses everything); else the set of named codes.  Code
-    tokens (letters+digits, comma/space separated) may be followed by
-    a justification — ``# noqa: CK02 serialized frame writes`` scopes
-    to CK02; prose with no leading code degrades to a bare noqa."""
-    m = NOQA_RE.search(line)
-    if m is None:
-        return None
-    spec = m.group("codes")
-    if spec is None:
-        return set()
-    codes = set()
-    for tok in re.split(r"[,\s]+", spec.strip()):
-        if _CODE_TOKEN_RE.fullmatch(tok):
-            codes.add(tok.upper())
-        else:
-            break  # justification prose starts here
-    return codes
-
-
-def _suppressed(lines, lineno: int, code: str) -> bool:
-    """Code-scoped noqa check for a finding at ``lineno``."""
-    if not (1 <= lineno <= len(lines)):
-        return False
-    codes = _noqa_codes(lines[lineno - 1])
-    if codes is None:
-        return False
-    if not codes:
-        return True  # bare noqa
-    return bool(codes & ({code} | _CODE_ALIASES.get(code, set())))
-
-PY_DIRS = ["sparkrdma_tpu", "tests", "benchmarks", "tools"]
 LIB_DIR = ROOT / "sparkrdma_tpu"
 
 

@@ -35,6 +35,12 @@ a wire-health table — frames validated/rejected per engine and opcode,
 unknown-frame counts by kind, hello version rejections — so a snapshot
 diff shows exactly what the frame validator saw during a run.
 
+State-machine series (utils/statemachine.py, conf ``stateDebug``)
+render as a per-machine lifecycle table — validated transitions with
+the hottest edge, terminal-entry census, and any ILLEGAL transition
+attempts the runtime validator refused — so a shaken soak's report
+shows exactly which lifecycles moved and that none moved illegally.
+
 Observability-plane series (obs/ + utils/trace.py) render as an
 obs-health table — tracer events dropped at the ring cap
 (``trace_dropped_total``, formerly a silent loss), flight-recorder
@@ -714,6 +720,62 @@ def render_obs_health(counters: list) -> list:
     return out
 
 
+def render_state_machines(counters: list) -> list:
+    """Lifecycle state-machine census (utils/statemachine.py, conf
+    stateDebug): one row per machine — validated transitions, terminal
+    entries by state, and any ILLEGAL transition attempts the runtime
+    validator refused.  The busiest edge per machine is named so a
+    diff shows what a run's lifecycles actually did.  A healthy run
+    shows zeros in the illegal column; renders nothing when the
+    validator was off."""
+    rows: dict = {}
+
+    def row(machine):
+        return rows.setdefault(machine, {
+            "transitions": 0.0, "illegal": 0.0,
+            "edges": {}, "terminal": {},
+        })
+
+    for c in counters:
+        labels = c.get("labels") or {}
+        m = labels.get("machine")
+        if not m:
+            continue
+        if c["name"] == "state_transitions_total":
+            r = row(m)
+            r["transitions"] += c["value"]
+            edge = f"{labels.get('from', '?')}->{labels.get('to', '?')}"
+            r["edges"][edge] = r["edges"].get(edge, 0.0) + c["value"]
+        elif c["name"] == "state_transitions_illegal_total":
+            row(m)["illegal"] += c["value"]
+        elif c["name"] == "state_terminal_total":
+            r = row(m)
+            st = labels.get("state", "?")
+            r["terminal"][st] = r["terminal"].get(st, 0.0) + c["value"]
+    if not rows:
+        return []
+    out = ["state machines (utils/statemachine.py)"]
+    width = max([len(m) for m in rows] + [16]) + 2
+    for machine in sorted(rows):
+        r = rows[machine]
+        hot = max(r["edges"].items(), key=lambda kv: kv[1]) \
+            if r["edges"] else None
+        term = "  ".join(
+            f"{s}={n:,.0f}" for s, n in sorted(r["terminal"].items()))
+        line = (
+            f"  {machine:<{width}}"
+            f"transitions={r['transitions']:,.0f}"
+        )
+        if hot is not None:
+            line += f"  top={hot[0]} ({hot[1]:,.0f})"
+        if term:
+            line += f"  terminal: {term}"
+        if r["illegal"]:
+            line += f"  ILLEGAL={r['illegal']:,.0f}"
+        out.append(line)
+    return out
+
+
 def render(snap: dict, title: str = "") -> str:
     lines = []
     if title:
@@ -732,6 +794,7 @@ def render(snap: dict, title: str = "") -> str:
     lines.extend(render_push(counters))
     lines.extend(render_recovery(counters))
     lines.extend(render_wire_health(counters))
+    lines.extend(render_state_machines(counters))
     lines.extend(render_obs_health(counters))
     width = max(
         [len(_fmt_series(r)) for r in counters + gauges + hists] + [20]

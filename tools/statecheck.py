@@ -1,0 +1,781 @@
+#!/usr/bin/env python
+"""Lifecycle state-machine analyzer — the transition-discipline gate.
+
+``make lint`` runs this fifth, next to lint/concheck/flowcheck/
+wirecheck.  The library's lifecycle-bearing objects (channel connect
+states, the dispatcher's send ops and recv framing machine, decode
+tickets/streams, push merges, breaker trip states, tier residency,
+reader phases, manager/cluster teardown, ledger tickets) each declare
+an explicit machine — ``STATES`` / ``INITIAL`` / ``TERMINAL`` /
+``TRANSITIONS`` class attributes bound to a state field by a
+``# state:`` annotation on its ``__init__`` seeding line — and
+``sparkrdma_tpu/utils/statemachine.py`` validates the same tables at
+runtime under conf ``stateDebug``.  This pass discovers every declared
+machine and enforces:
+
+  SC01  raw state write: a declared state field may only be assigned
+        inside a ``_transition()`` helper (the ``StateMachine`` mixin
+        or a hand-rolled ``_transition_<table>``), or on its annotated
+        ``__init__`` seeding line.  Any other store — even of a legal
+        state — bypasses the runtime validator, the transition
+        counters, and the schedule shaker.  Deliberate raw writes
+        carry a justified ``# noqa: SC01``.
+  SC02  undeclared transition: every statically-resolvable
+        ``_transition(X)`` / ``_transition(X, frm=Y)`` call site must
+        name a declared state, and with ``frm=`` given the edge
+        ``Y -> X`` must exist in the table (self-edges are legal
+        no-ops).  The seeded initial value must equal ``INITIAL``.
+        Arguments that do not resolve to a constant (variables,
+        parameters) are the runtime validator's job and are skipped.
+  SC03  unguarded branch read: a machine declaring
+        ``guarded-by: <lock>`` promises its state is only *branched
+        on* while that lock is held — inside the declaring class for
+        own-class guards, and inside the owning class for
+        ``Owner._lock``-style external guards (non-``self``
+        receivers).  Reads in ``__init__`` and ``_transition*``
+        helpers are exempt; deliberate racy reads carry a justified
+        ``# noqa: SC03``.
+  SC04  terminal escape: a ``TRANSITIONS`` table with an outgoing
+        edge from a declared ``TERMINAL`` state, a call site
+        transitioning ``frm=`` a terminal state, or a second
+        transition lexically following a terminal-entering one on the
+        same straight-line path.
+  SC05  undeclared machine: a ``# state:`` annotation whose class has
+        no (or an inconsistent) table — missing ``STATES`` /
+        ``TRANSITIONS``, a ``MACHINE`` name disagreeing with the
+        annotation, tokens outside ``STATES``, or an unresolvable
+        ``INITIAL``.
+
+Annotation grammar (the seeding line in ``__init__``)::
+
+    self._state = "closed"  # state: faults.breaker guarded-by: _lock
+    self._state = _QUEUED   # state: decode.ticket guarded-by: DecodePool._cv
+    self._rx_state = self._HDR  # state: channel.recv table: RX
+
+``table: RX`` binds the field to the prefixed ``RX_STATES`` /
+``RX_TRANSITIONS`` attributes (a class hosting a secondary machine)
+and to the hand-rolled ``_transition_rx`` helper.  ``guarded-by:``
+takes either an own-class lock attribute or ``OwnerClass.attr`` when
+the object's state is guarded by another class's lock (tickets under
+their pool's condition, merges under their merger's lock).
+
+State tokens resolve through string literals, module/class constants
+(including tuple unpacks), and ``EnumClass.MEMBER`` (lowered member
+name — the runtime's ``state_token``).
+
+Suppressions are code-scoped: ``# noqa: SC01`` silences only SC01 on
+that line; a bare ``# noqa`` silences everything (discouraged).
+
+Usage: ``python tools/statecheck.py [paths...]`` (default: the
+library).  Exit status 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+LIB = ROOT / "sparkrdma_tpu"
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from gatelib import (  # noqa: E402
+    COMPOUND_STMTS,
+    Finding,
+    Suppressor,
+    span_search,
+    walk_py,
+)
+
+STATE_RE = re.compile(
+    r"#\s*state:\s*(?P<name>[A-Za-z_][\w.\-]*)"
+    r"(?:\s+table:\s*(?P<table>[A-Za-z_]\w*))?"
+    r"(?:\s+guarded-by:\s*(?P<guard>[A-Za-z_]\w*(?:\.[A-Za-z_]\w*)?))?"
+)
+TRANSITION_HELPER_RE = re.compile(r"^_transition(?:_(?P<suffix>\w+))?$")
+
+# the runtime half: its mixin IS the blessed writer, so its own store
+# through setattr / its docstring grammar examples are never findings
+RUNTIME_MODULE = "statemachine.py"
+
+
+class Machine:
+    """One declared machine: annotation + resolved table."""
+
+    __slots__ = ("name", "rel", "cls_name", "field", "prefix", "guard",
+                 "guard_owner", "guard_attr", "states", "initial",
+                 "terminal", "transitions", "decl_line", "table_line",
+                 "seed_token", "seed_fn", "complete")
+
+    def __init__(self, name: str, rel: str, cls_name: str, field: str,
+                 prefix: str, guard: Optional[str], decl_line: int):
+        self.name = name
+        self.rel = rel
+        self.cls_name = cls_name
+        self.field = field
+        self.prefix = prefix  # "" or e.g. "RX_"
+        self.guard = guard
+        self.guard_owner: Optional[str] = None
+        self.guard_attr: Optional[str] = None
+        if guard is not None:
+            if "." in guard:
+                self.guard_owner, self.guard_attr = guard.split(".", 1)
+            else:
+                self.guard_attr = guard
+        self.states: Set[str] = set()
+        self.initial: Optional[str] = None
+        self.terminal: Set[str] = set()
+        self.transitions: Dict[str, Tuple[str, ...]] = {}
+        self.decl_line = decl_line
+        self.table_line = decl_line
+        self.seed_token: Optional[str] = None
+        self.seed_fn: Optional[str] = None
+        self.complete = False
+
+    def dests(self) -> Set[str]:
+        out: Set[str] = set()
+        for vals in self.transitions.values():
+            out.update(vals)
+        return out
+
+
+class _Consts:
+    """Constant-resolution index for one module: module/class string
+    constants (incl. tuple unpacks) and enum classes."""
+
+    def __init__(self, tree: ast.Module):
+        self.mod: Dict[str, str] = {}
+        self.cls: Dict[str, Dict[str, str]] = {}
+        self.enums: Set[str] = set()
+        self._collect(tree.body, self.mod)
+        for stmt in ast.walk(tree):
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            if any(
+                (isinstance(b, ast.Attribute) and b.attr in
+                 ("Enum", "IntEnum", "Flag", "IntFlag"))
+                or (isinstance(b, ast.Name) and b.id in
+                    ("Enum", "IntEnum", "Flag", "IntFlag"))
+                for b in stmt.bases
+            ):
+                self.enums.add(stmt.name)
+                continue
+            table = self.cls.setdefault(stmt.name, {})
+            self._collect(stmt.body, table)
+
+    @staticmethod
+    def _collect(body, table: Dict[str, str]) -> None:
+        for stmt in body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) \
+                        and isinstance(stmt.value, ast.Constant) \
+                        and isinstance(stmt.value.value, str):
+                    table[tgt.id] = stmt.value.value
+                elif isinstance(tgt, ast.Tuple) \
+                        and isinstance(stmt.value, (ast.Tuple, ast.List)) \
+                        and len(tgt.elts) == len(stmt.value.elts):
+                    for name, val in zip(tgt.elts, stmt.value.elts):
+                        if isinstance(name, ast.Name) \
+                                and isinstance(val, ast.Constant) \
+                                and isinstance(val.value, str):
+                            table[name.id] = val.value
+
+    def token(self, node: ast.expr, cls_name: Optional[str],
+              class_scope: bool = False) -> Optional[str]:
+        """Resolve an expression to a state token, or None (dynamic).
+        ``class_scope`` is set when resolving CLASS-BODY expressions,
+        where bare names see the class's own constants; method bodies
+        do not (python scoping), so call sites resolve module-only."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        if isinstance(node, ast.Name):
+            if class_scope and cls_name is not None:
+                got = self.cls.get(cls_name, {}).get(node.id)
+                if got is not None:
+                    return got
+            return self.mod.get(node.id)
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name):
+            recv = node.value.id
+            if recv in self.enums:
+                # the runtime's state_token: member NAME, lowered
+                return node.attr.lower()
+            if recv == "self" and cls_name is not None:
+                return self.cls.get(cls_name, {}).get(node.attr)
+            return self.cls.get(recv, {}).get(node.attr)
+        return None
+
+
+class ModuleScan:
+    def __init__(self, rel: str, tree: ast.Module, lines: List[str]):
+        self.rel = rel
+        self.tree = tree
+        self.lines = lines
+        self.consts = _Consts(tree)
+        self.machines: List[Machine] = []
+        # field -> machines declaring it (SC01's write index)
+        self.fields: Dict[str, List[Machine]] = {}
+        # class name -> machines it declares
+        self.by_class: Dict[str, List[Machine]] = {}
+
+
+class Analyzer:
+    def __init__(self, root: pathlib.Path = ROOT):
+        self.root = root
+        self.findings: List[Finding] = []
+        self.modules: Dict[str, ModuleScan] = {}
+        self._sups: Dict[str, Suppressor] = {}
+        self.transition_sites = 0
+
+    # -- plumbing ------------------------------------------------------------
+    def emit(self, rel: str, line: int, code: str, msg: str) -> None:
+        sup = self._sups.get(rel)
+        if sup is not None and sup.suppressed(line, code):
+            return
+        self.findings.append(Finding(rel, line, code, msg))
+
+    def _rel(self, path: pathlib.Path) -> str:
+        try:
+            return str(path.relative_to(self.root))
+        except ValueError:
+            return str(path)
+
+    # -- entry ---------------------------------------------------------------
+    def analyze_paths(self, paths) -> List[Finding]:
+        files = walk_py(paths)
+        for f in files:
+            self._load(f)
+        for scan in self.modules.values():
+            self._check_module(scan)
+        self.findings.sort(key=lambda x: (str(x[0]), x[1], x[2]))
+        return self.findings
+
+    @property
+    def machines(self) -> List[Machine]:
+        out: List[Machine] = []
+        for scan in self.modules.values():
+            out.extend(scan.machines)
+        return out
+
+    # -- collection ----------------------------------------------------------
+    def _load(self, path: pathlib.Path) -> None:
+        rel = self._rel(path)
+        if path.name == RUNTIME_MODULE:
+            return  # the validator itself: grammar examples, setattr
+        try:
+            text = path.read_text()
+            tree = ast.parse(text)
+        except (UnicodeDecodeError, SyntaxError):
+            return  # tools/lint.py owns PY01
+        lines = text.splitlines()
+        self._sups[rel] = Suppressor(lines)
+        scan = ModuleScan(rel, tree, lines)
+        for stmt in ast.walk(tree):
+            if isinstance(stmt, ast.ClassDef):
+                self._collect_class(scan, stmt)
+        self.modules[rel] = scan
+
+    def _collect_class(self, scan: ModuleScan, cls: ast.ClassDef) -> None:
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(item):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                m = span_search(STATE_RE, scan.lines, node.lineno,
+                                node.end_lineno)
+                if m is None:
+                    continue
+                mach = Machine(
+                    m.group("name"), scan.rel, cls.name, tgt.attr,
+                    (m.group("table") + "_") if m.group("table") else "",
+                    m.group("guard"), node.lineno,
+                )
+                mach.seed_token = scan.consts.token(node.value, cls.name)
+                mach.seed_fn = item.name
+                self._resolve_table(scan, cls, mach)
+                scan.machines.append(mach)
+                scan.fields.setdefault(mach.field, []).append(mach)
+                scan.by_class.setdefault(cls.name, []).append(mach)
+
+    def _resolve_table(self, scan: ModuleScan, cls: ast.ClassDef,
+                       mach: Machine) -> None:
+        """Pull {prefix}STATES / INITIAL / TERMINAL / TRANSITIONS off
+        the class body and validate internal consistency (SC05)."""
+        p = mach.prefix
+        attrs: Dict[str, ast.expr] = {}
+        attr_lines: Dict[str, int] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                attrs[stmt.targets[0].id] = stmt.value
+                attr_lines[stmt.targets[0].id] = stmt.lineno
+
+        def tok(node: ast.expr) -> Optional[str]:
+            return scan.consts.token(node, cls.name, class_scope=True)
+
+        def tok_seq(node: ast.expr) -> Optional[List[str]]:
+            if not isinstance(node, (ast.Tuple, ast.List)):
+                return None
+            out: List[str] = []
+            for e in node.elts:
+                t = tok(e)
+                if t is None:
+                    return None
+                out.append(t)
+            return out
+
+        states_node = attrs.get(p + "STATES")
+        trans_node = attrs.get(p + "TRANSITIONS")
+        if states_node is None or trans_node is None:
+            self.emit(
+                scan.rel, mach.decl_line, "SC05",
+                f"machine {mach.name}: field {mach.field} is annotated "
+                f"'# state:' but class {cls.name} declares no "
+                f"{p}STATES/{p}TRANSITIONS table",
+            )
+            return
+        mach.table_line = attr_lines.get(p + "TRANSITIONS",
+                                         mach.decl_line)
+        states = tok_seq(states_node)
+        if states is None:
+            self.emit(
+                scan.rel, attr_lines[p + "STATES"], "SC05",
+                f"machine {mach.name}: {p}STATES does not resolve to a "
+                f"tuple of state tokens",
+            )
+            return
+        mach.states = set(states)
+        if not p:
+            declared = attrs.get("MACHINE")
+            dname = tok(declared) if declared is not None else None
+            if dname is not None and dname != mach.name:
+                self.emit(
+                    scan.rel, mach.decl_line, "SC05",
+                    f"annotation names machine {mach.name} but "
+                    f"{cls.name}.MACHINE says {dname}",
+                )
+        init_node = attrs.get(p + "INITIAL")
+        if init_node is not None:
+            mach.initial = tok(init_node)
+            if mach.initial is None or mach.initial not in mach.states:
+                self.emit(
+                    scan.rel, attr_lines[p + "INITIAL"], "SC05",
+                    f"machine {mach.name}: {p}INITIAL is not one of "
+                    f"{p}STATES",
+                )
+        term_node = attrs.get(p + "TERMINAL")
+        if term_node is not None:
+            terms = tok_seq(term_node)
+            if terms is None or not set(terms) <= mach.states:
+                self.emit(
+                    scan.rel, attr_lines[p + "TERMINAL"], "SC05",
+                    f"machine {mach.name}: {p}TERMINAL lists states "
+                    f"outside {p}STATES",
+                )
+            else:
+                mach.terminal = set(terms)
+        if not isinstance(trans_node, ast.Dict):
+            self.emit(
+                scan.rel, mach.table_line, "SC05",
+                f"machine {mach.name}: {p}TRANSITIONS is not a dict "
+                f"literal",
+            )
+            return
+        ok = True
+        for k, v in zip(trans_node.keys, trans_node.values):
+            src = tok(k) if k is not None else None
+            dsts = tok_seq(v)
+            if src is None or src not in mach.states or dsts is None \
+                    or not set(dsts) <= mach.states:
+                self.emit(
+                    scan.rel, (k or v).lineno, "SC05",
+                    f"machine {mach.name}: {p}TRANSITIONS entry uses "
+                    f"states outside {p}STATES",
+                )
+                ok = False
+                continue
+            mach.transitions[src] = tuple(dsts)
+        if not ok:
+            return
+        mach.complete = True
+        # SC04 at the table itself: terminal states with outgoing edges
+        for term in sorted(mach.terminal):
+            if mach.transitions.get(term):
+                self.emit(
+                    scan.rel, mach.table_line, "SC04",
+                    f"machine {mach.name}: terminal state '{term}' has "
+                    f"outgoing transitions declared — terminal states "
+                    f"must be sinks",
+                )
+        # the seed must be INITIAL (when both are statically known)
+        if mach.seed_token is not None and mach.initial is not None \
+                and mach.seed_token != mach.initial:
+            self.emit(
+                scan.rel, mach.decl_line, "SC02",
+                f"machine {mach.name}: seeded with "
+                f"'{mach.seed_token}' but {p}INITIAL is "
+                f"'{mach.initial}'",
+            )
+
+    # -- per-module checks ----------------------------------------------------
+    def _check_module(self, scan: ModuleScan) -> None:
+        for stmt in ast.walk(scan.tree):
+            if isinstance(stmt, ast.ClassDef):
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._check_fn(scan, stmt.name, item)
+        for stmt in scan.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_fn(scan, None, stmt)
+
+    def _check_fn(self, scan: ModuleScan, cls_name: Optional[str],
+                  fn) -> None:
+        helper = TRANSITION_HELPER_RE.match(fn.name)
+        visitor = _FnScan(self, scan, cls_name, fn.name,
+                          in_helper=helper is not None)
+        for stmt in fn.body:
+            visitor.visit(stmt)
+        if helper is None:
+            self._terminal_paths(scan, cls_name, fn)
+
+    # -- SC04: straight-line terminal escapes ---------------------------------
+    def _terminal_paths(self, scan: ModuleScan, cls_name: Optional[str],
+                        fn) -> None:
+        """Within each statement list, a transition lexically after a
+        terminal-entering one on the same receiver is dead or illegal."""
+        for node in ast.walk(fn):
+            for body in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, body, None)
+                if not isinstance(stmts, list) or len(stmts) < 2:
+                    continue
+                # receiver-source -> (machine, line of terminal entry)
+                dead: Dict[str, Tuple[Machine, int]] = {}
+                for stmt in stmts:
+                    if not isinstance(stmt, ast.stmt):
+                        continue
+                    if isinstance(stmt, COMPOUND_STMTS):
+                        # a compound statement's branches/iterations
+                        # are NOT the same straight-line path; its
+                        # body lists get their own scan
+                        continue
+                    if isinstance(stmt, ast.Assign):
+                        # re-binding a receiver name starts a fresh
+                        # object: its terminal marker dies with it
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                dead.pop(tgt.id, None)
+                    for call in ast.walk(stmt):
+                        t = self._transition_call(scan, cls_name, call)
+                        if t is None:
+                            continue
+                        recv_src, cands, to, _frm = t
+                        if recv_src in dead and to is not None:
+                            mach, tline = dead[recv_src]
+                            self.emit(
+                                scan.rel, call.lineno, "SC04",
+                                f"machine {mach.name}: transition after "
+                                f"the terminal transition at line "
+                                f"{tline} on the same path",
+                            )
+                            continue
+                        if to is not None and any(
+                                to in m.terminal for m in cands):
+                            mach = next(m for m in cands
+                                        if to in m.terminal)
+                            dead.setdefault(recv_src,
+                                            (mach, call.lineno))
+
+    def _transition_call(self, scan: ModuleScan,
+                         cls_name: Optional[str], node):
+        """(receiver-src, candidate machines, to, frm) when ``node``
+        is a _transition*/check_named call; None otherwise."""
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            m = TRANSITION_HELPER_RE.match(f.attr)
+            if m is None:
+                return None
+            suffix = m.group("suffix")
+            recv = f.value
+            recv_src = ast.unparse(recv)
+            is_self = isinstance(recv, ast.Name) and recv.id == "self"
+            cands = self._candidates(scan, cls_name, is_self, suffix)
+            to = scan.consts.token(node.args[0], cls_name) \
+                if node.args else None
+            frm = None
+            if len(node.args) > 1:
+                frm = scan.consts.token(node.args[1], cls_name)
+            for kw in node.keywords:
+                if kw.arg == "frm":
+                    frm = scan.consts.token(kw.value, cls_name)
+            return recv_src, cands, to, frm
+        if isinstance(f, ast.Name) and f.id == "check_named" \
+                and len(node.args) >= 2:
+            name = None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name = scan.consts.token(kw.value, cls_name)
+            cands = [m for m in scan.machines if m.name == name] \
+                if name else []
+            to = scan.consts.token(node.args[1], cls_name)
+            frm = None
+            for kw in node.keywords:
+                if kw.arg == "frm":
+                    frm = scan.consts.token(kw.value, cls_name)
+            return ast.unparse(node.args[0]), cands, to, frm
+        return None
+
+    def _candidates(self, scan: ModuleScan, cls_name: Optional[str],
+                    is_self: bool, suffix: Optional[str]
+                    ) -> List[Machine]:
+        if is_self and cls_name is not None:
+            pool = scan.by_class.get(cls_name, [])
+            # a class with no machine of its own forwarding self._xx
+            # falls back to the module population (mixin hosts)
+            if not pool:
+                pool = scan.machines
+        else:
+            pool = scan.machines
+        if suffix is not None:
+            return [m for m in pool if m.prefix and m.complete
+                    and m.prefix[:-1].lower() == suffix.lower()]
+        return [m for m in pool if not m.prefix and m.complete]
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function body: held-lock attr stack + SC01/SC02/SC03."""
+
+    def __init__(self, an: Analyzer, scan: ModuleScan,
+                 cls_name: Optional[str], fn_name: str,
+                 in_helper: bool):
+        self.an = an
+        self.scan = scan
+        self.cls_name = cls_name
+        self.fn_name = fn_name
+        self.in_helper = in_helper
+        self.held: List[str] = []  # lock attr/name per with-item
+
+    # nested defs/classes: scanned separately (their own _check_fn /
+    # _collect pass); a nested function's writes still count as raw
+    # writes, so descend into FunctionDef but not ClassDef
+    def visit_ClassDef(self, node):
+        pass
+
+    # -- held-lock tracking ---------------------------------------------------
+    def _lock_name(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            name = self._lock_name(item.context_expr)
+            if name is not None and ("lock" in name.lower()
+                                     or name.endswith("_cv")):
+                self.held.append(name)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- SC01: raw writes -----------------------------------------------------
+    def _check_write(self, tgt: ast.expr, node: ast.stmt) -> None:
+        if not isinstance(tgt, ast.Attribute):
+            return
+        machines = self.scan.fields.get(tgt.attr)
+        if not machines:
+            return
+        if self.in_helper:
+            return  # the blessed writer
+        is_self = isinstance(tgt.value, ast.Name) \
+            and tgt.value.id == "self"
+        owners = [m for m in machines if m.cls_name == self.cls_name]
+        if is_self and self.cls_name is not None and not owners:
+            return  # another class's unrelated same-named field
+        ann = span_search(STATE_RE, self.scan.lines, node.lineno,
+                          getattr(node, "end_lineno", None))
+        if ann is not None and self.fn_name == "__init__":
+            return  # the annotated seeding line
+        mach = (owners or machines)[0]
+        self.an.emit(
+            self.scan.rel, node.lineno, "SC01",
+            f"raw write to state field {tgt.attr} (machine "
+            f"{mach.name}) outside a _transition helper — bypasses "
+            f"the table validator, counters, and shaker",
+        )
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._check_write(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._check_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_write(node.target, node)
+        self.generic_visit(node)
+
+    # -- SC02 / SC04 at call sites --------------------------------------------
+    def visit_Call(self, node):
+        t = self.an._transition_call(self.scan, self.cls_name, node)
+        if t is not None:
+            _recv, cands, to, frm = t
+            self.an.transition_sites += 1
+            self._check_edge(node, cands, to, frm)
+        self.generic_visit(node)
+
+    def _check_edge(self, node: ast.Call, cands: List[Machine],
+                    to: Optional[str], frm: Optional[str]) -> None:
+        if to is None or not cands:
+            return  # dynamic argument or unresolvable receiver:
+            #         the runtime validator's job
+        line = node.lineno
+        rel = self.scan.rel
+        if not any(to in m.states for m in cands):
+            names = ", ".join(sorted({m.name for m in cands}))
+            self.an.emit(
+                rel, line, "SC02",
+                f"transition to undeclared state '{to}' (not in "
+                f"STATES of {names})",
+            )
+            return
+        if frm is not None:
+            if any(frm in m.terminal and frm in m.states
+                   for m in cands) and not any(
+                       to == frm or to in m.transitions.get(frm, ())
+                       for m in cands):
+                mach = next(m for m in cands if frm in m.terminal)
+                self.an.emit(
+                    rel, line, "SC04",
+                    f"machine {mach.name}: transition out of terminal "
+                    f"state '{frm}'",
+                )
+                return
+            if not any(to == frm or to in m.transitions.get(frm, ())
+                       for m in cands):
+                names = ", ".join(sorted({m.name for m in cands}))
+                self.an.emit(
+                    rel, line, "SC02",
+                    f"transition '{frm}' -> '{to}' is not in the "
+                    f"declared table of {names}",
+                )
+            return
+        dests: Set[str] = set()
+        for m in cands:
+            dests |= m.dests()
+        if to not in dests:
+            names = ", ".join(sorted({m.name for m in cands}))
+            self.an.emit(
+                rel, line, "SC02",
+                f"no declared edge into state '{to}' (machine "
+                f"{names})",
+            )
+
+    # -- SC03: branch reads ---------------------------------------------------
+    def visit_If(self, node):
+        self._check_branch(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_branch(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_branch(node.test)
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.expr) -> None:
+        if self.in_helper or self.fn_name == "__init__":
+            return
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Attribute) \
+                    or not isinstance(node.ctx, ast.Load):
+                continue
+            machines = self.scan.fields.get(node.attr)
+            if not machines:
+                continue
+            is_self = isinstance(node.value, ast.Name) \
+                and node.value.id == "self"
+            for mach in machines:
+                if mach.guard_attr is None:
+                    continue
+                if mach.guard_owner is None:
+                    # own-class guard: reads of self.<field> inside
+                    # the declaring class
+                    if not (is_self and self.cls_name == mach.cls_name):
+                        continue
+                elif not (self.cls_name == mach.guard_owner
+                          and not is_self):
+                    # external guard: non-self receivers inside the
+                    # owning class
+                    continue
+                if mach.guard_attr not in self.held:
+                    self.an.emit(
+                        self.scan.rel, node.lineno, "SC03",
+                        f"branch on state field "
+                        f"{ast.unparse(node)} (machine {mach.name}) "
+                        f"without holding its declared guard "
+                        f"{mach.guard}",
+                    )
+                break
+
+
+def analyze(paths, root: pathlib.Path = ROOT) -> List[Finding]:
+    return Analyzer(root=root).analyze_paths(paths)
+
+
+def main(argv) -> int:
+    paths = [pathlib.Path(a) for a in argv[1:]] or [LIB]
+    an = Analyzer()
+    findings = an.analyze_paths(paths)
+    for rel, line, code, msg in findings:
+        print(f"{rel}:{line}: {code} {msg}")
+    if findings:
+        print(f"statecheck: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    machines = sorted(an.machines, key=lambda m: m.name)
+    edges = sum(len(d) for m in machines for d in m.transitions.values())
+    print(f"statecheck: clean ({len(machines)} machine(s), {edges} "
+          f"declared edge(s), {an.transition_sites} transition "
+          f"site(s))")
+    for m in machines:
+        guard = f" guarded-by {m.guard}" if m.guard else ""
+        print(f"  {m.name}: {len(m.states)} states, "
+              f"{sum(len(d) for d in m.transitions.values())} edges"
+              f"{guard}  [{m.rel}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

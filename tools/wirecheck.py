@@ -66,13 +66,15 @@ DEFAULT_PATHS = [
     LIB / "shuffle" / "manager.py",
 ]
 
-# ONE noqa grammar + suppression decision for all four gates:
-# tools/lint.py owns the definition (code-scoped sets, bare-noqa =
-# everything, alias handling)
+# ONE noqa grammar + suppression decision for all five gates:
+# tools/gatelib.py owns the definition (code-scoped sets, bare-noqa =
+# everything, alias handling) plus the finding shape and file walking
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
-from lint import _suppressed as _lint_suppressed  # noqa: E402
-
-Finding = Tuple[str, int, str, str]  # (rel, line, code, message)
+from gatelib import (  # noqa: E402
+    Finding,
+    suppressed as _lint_suppressed,
+    walk_py as _walk_py,
+)
 
 # sub-header structs whose consumption arity must match across engines
 _WIRE_HDRS = {"_HDR", "_REQ_HDR", "_RESP_HDR", "_LEN"}
@@ -185,13 +187,7 @@ class Analyzer:
 
     # -- entry ---------------------------------------------------------------
     def analyze_paths(self, paths) -> List[Finding]:
-        files: List[pathlib.Path] = []
-        for p in paths:
-            p = pathlib.Path(p)
-            if p.is_dir():
-                files.extend(sorted(p.rglob("*.py")))
-            else:
-                files.append(p)
+        files = _walk_py(paths)
         for f in files:
             self._load(f)
         for mod in self.modules.values():
