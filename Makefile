@@ -1,6 +1,6 @@
 # Build/test entry points (the pom.xml analog).
 
-.PHONY: all native lint concheck flowcheck wirecheck statecheck test bench bench-smoke bench-cluster chaos chaos-shake dryrun clean
+.PHONY: all native lint concheck flowcheck wirecheck statecheck test bench bench-smoke bench-cluster bench-device chaos chaos-shake dryrun clean
 
 all: native
 
@@ -68,6 +68,9 @@ bench-smoke:
 	python benchmarks/bench_cluster.py
 	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
 	python benchmarks/bench_push.py
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	python benchmarks/bench_device_exchange.py
 	python tools/bench_gate.py
 	$(MAKE) chaos
 	$(MAKE) chaos-shake
@@ -77,6 +80,15 @@ bench-smoke:
 # BENCH_cluster.json at the repo root
 bench-cluster: native
 	JAX_PLATFORMS=cpu python benchmarks/bench_cluster.py
+
+# the device-native exchange tier alone (padded collective plane,
+# bucketized headline, end-to-end loopback clusters) on a spoofed
+# ≥2-device CPU mesh; full config writes BENCH_device_exchange.json
+# at the repo root
+bench-device:
+	BENCH_SMOKE=1 SPARKRDMA_TPU_BENCH_SPOOFED=1 JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	python benchmarks/bench_device_exchange.py
 
 # the seeded chaos soak alone (faults/, conf faultInject): the full
 # engine matrix — loopback / tcp-threaded / tcp-async × decode

@@ -147,6 +147,7 @@ class TpuShuffleContext:
                 # executors share one process, so any executor's pool
                 # serves; release rides view GC)
                 out_alloc=self.executors[0].staging_pool.alloc_gc,
+                window_rounds=self.conf.device_exchange_window_rounds,
             )
             for ex in self.executors:
                 ex.windowed_plane = WindowedReadPlane(ex, session=session)
@@ -337,6 +338,7 @@ class TpuShuffleContext:
             TileExchange.from_conf(self.conf, make_mesh(E)), E,
             timeout_s=self.conf.bulk_barrier_timeout_ms / 1000.0,
             out_alloc=self.executors[0].staging_pool.alloc_gc,
+            window_rounds=self.conf.device_exchange_window_rounds,
         )
 
         def bulk_task(i: int):

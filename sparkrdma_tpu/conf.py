@@ -54,6 +54,40 @@ def host_core_census() -> int:
         return os.cpu_count() or 1
 
 
+_FORCED_DEVICES_RE = re.compile(
+    r"--xla_force_host_platform_device_count=(\d+)"
+)
+
+
+def device_census() -> int:
+    """Accelerator devices THIS process's jax backend will expose — the
+    ``host_core_census`` analog every multi-device default keys off.
+
+    Resolution order: when the process is pinned to the cpu backend
+    (``JAX_PLATFORMS``/``JAX_PLATFORM_NAME``), trust an
+    ``XLA_FLAGS --xla_force_host_platform_device_count=N`` forcing —
+    readable WITHOUT initializing jax, so conf defaults never pin the
+    backend choice for the whole process.  Otherwise ask
+    ``jax.device_count()`` (authoritative on real TPU/GPU hosts; the
+    forced-count flag only applies to the cpu platform, so it must not
+    be trusted there).  Answers 1 when jax is unavailable — a 1-device
+    host can then never silently gate (or fake-pass) a
+    multi-device-only default."""
+    platform = os.getenv(
+        "JAX_PLATFORMS", os.getenv("JAX_PLATFORM_NAME", "")
+    ).strip().lower()
+    if platform == "cpu":
+        m = _FORCED_DEVICES_RE.search(os.getenv("XLA_FLAGS", ""))
+        if m:
+            return max(1, int(m.group(1)))
+    try:
+        import jax
+
+        return max(1, jax.device_count())
+    except Exception:
+        return 1
+
+
 class TpuShuffleConf:
     """Config accessor over a plain dict of ``spark.shuffle.tpu.*`` keys.
 
@@ -176,6 +210,21 @@ class TpuShuffleConf:
             if pinned and len(pinned) < machine:
                 return len(pinned)
         return host_core_census()
+
+    # -- device census (every device_count-derived default reads this) ------
+    @property
+    def device_census(self) -> int:
+        """The device count that multi-device defaults key off
+        (``deviceExchangeEnabled``, bench host notes).  An explicit
+        ``deviceCensus`` setting wins (> 0); else the module-level
+        :func:`device_census` (XLA_FLAGS forcing on a cpu-pinned
+        process, ``jax.device_count()`` otherwise) — NOT a hardcoded
+        mesh size, so a 1-device host can never silently gate (or
+        fake-pass) a multi-device-only path."""
+        explicit = self._int_in_range("deviceCensus", 0, 0, 1 << 16)
+        if explicit > 0:
+            return explicit
+        return device_census()
 
     # -- transport / control-plane queues (reference: recv/sendQueueDepth) --
     @property
@@ -644,6 +693,41 @@ class TpuShuffleConf:
         """Bounded outstanding exchange rounds (maxBytesInFlight analog
         for the collective data plane)."""
         return self._int_in_range("exchangeMaxRoundsInFlight", 2, 1, 64)
+
+    @property
+    def device_exchange_enabled(self) -> bool:
+        """Device-native exchange data path
+        (``TileExchange.exchange_padded``): staged source rows are
+        assembled ONCE into pooled padded device-layout buffers and
+        ride the mesh as device arrays — on-device tile staging
+        (reshape + index, no per-round host matrix fills) and zero
+        intermediate ``bytes`` materialization between the map-output
+        store and HBM.  Output is bit-identical to the host-staged
+        path.  Default: enabled on ≥2-device hosts; a 1-device mesh
+        has no collective to win (the ``decodeThreads`` convention).
+        An explicit setting always wins."""
+        return self._bool("deviceExchangeEnabled", self.device_census > 1)
+
+    @property
+    def device_exchange_window_rounds(self) -> int:
+        """Bounded in-flight window of DEVICE exchange tile rounds:
+        round k's collective dispatches while round k-1's landed rows
+        are collected (and, on the windowed plane, handed to the
+        decode pool) — the collective/decode overlap.  0 runs the
+        whole exchange as ONE fused program instead (zero-copy result
+        views, no per-round collect), trading overlap for the lowest
+        total copy cost; the windowed plane wants rounds, bulk batch
+        readers want the fused shot."""
+        return self._int_in_range("deviceExchangeWindowRounds", 2, 0, 64)
+
+    @property
+    def device_bucketize_enabled(self) -> bool:
+        """On-device partition prep before the exchange
+        (``ops.partition.bucketize_segments``): partition fan-out runs
+        as a jit'd bucketize+counts+segment-offsets kernel so the
+        collective moves already-bucketed contiguous segments.  Same
+        ≥2-device default as ``deviceExchangeEnabled``."""
+        return self._bool("deviceBucketizeEnabled", self.device_census > 1)
 
     @property
     def verify_exchange_integrity(self) -> bool:

@@ -213,3 +213,79 @@ class DeviceArena:
                 "free_extents": len(self._free),
                 "writes": self.writes,
             }
+
+
+class DeviceStagingBridge:
+    """Registered staging rows → reusable device donor buffers: the H2D
+    seam of the device-native exchange.
+
+    The reference stages shuffle bytes through registered MRs so the
+    NIC can DMA them without a bounce copy (RdmaBuffer/
+    RdmaBufferManager); the TPU analog stages each source's exchange
+    payload ONCE into a POOLED host row (memory/staging.py — recycled
+    across windows exactly like the RdmaBuffer pool) laid out in the
+    exchange's padded device framing, and hands it to XLA as a device
+    array via one ``jax.device_put`` per source row.  The jitted
+    consumer donates the device buffer back to XLA after the collective
+    (``donate_argnums``), so steady state is: pooled host row reused
+    window over window, device buffer reused round over round, and ZERO
+    intermediate ``bytes`` objects or per-round host staging matrices
+    in between (counter ``device_exchange_h2d_bytes_avoided_total``
+    tracks the host fill traffic the bridge eliminated).
+
+    Framing helpers (``padded_cols``, ``as_words``) keep the layout
+    rules in ONE place: rows are uint8, lane-aligned to the exchange's
+    ``TILE_ALIGN``, and reinterpreted as uint32 words for the
+    collective (4x fewer elements through the permutation at identical
+    bytes; views require the 4-byte alignment the pools guarantee).
+    """
+
+    WORD = 4  # collective element width: uint32 words over uint8 lanes
+
+    def __init__(self, pool=None):
+        # optional StagingPool; None falls back to plain numpy rows
+        # (the alloc_row_gc contract)
+        self.pool = pool
+
+    # -- framing ------------------------------------------------------------
+    @staticmethod
+    def as_words(row: np.ndarray):
+        """Reinterpret a lane-aligned uint8 row as uint32 words for the
+        collective, or None when the buffer's base address defeats the
+        4-byte view (an exotic allocator) — callers then ship uint8."""
+        if row.nbytes % DeviceStagingBridge.WORD:
+            return None
+        if row.ctypes.data % DeviceStagingBridge.WORD:
+            return None
+        try:
+            return row.view(np.uint32)
+        except ValueError:
+            return None
+
+    # -- pooled padded rows -------------------------------------------------
+    def alloc_row(self, nbytes: int) -> np.ndarray:
+        """One pooled uint8 staging row (recycled when the last view of
+        it dies — the two-buffer steady state of the windowed plane)."""
+        from sparkrdma_tpu.memory.staging import alloc_row_gc
+
+        return alloc_row_gc(
+            self.pool, nbytes, "exchange_row_pool_fallbacks_total"
+        )
+
+    # -- H2D ---------------------------------------------------------------
+    def to_device(self, row: np.ndarray, device, avoided_bytes: int = 0):
+        """Put one source row onto its mesh device; returns the device
+        array.  ``avoided_bytes`` reports how many bytes of host
+        staging-matrix fill the padded layout made unnecessary for this
+        row (the per-round [D, D, tile] copies of the host-staged
+        path) — the bridge's whole reason to exist, so it is counted
+        here at the seam."""
+        import jax
+
+        from sparkrdma_tpu.metrics import counter
+
+        if avoided_bytes > 0:
+            counter("device_exchange_h2d_bytes_avoided_total").inc(
+                avoided_bytes
+            )
+        return jax.device_put(row, device)

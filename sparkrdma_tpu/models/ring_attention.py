@@ -42,7 +42,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkrdma_tpu.ops.attention import NEG_INF, block_attention
 from sparkrdma_tpu.parallel.mesh import EXCHANGE_AXIS, make_mesh
-from sparkrdma_tpu.parallel.ring import ring_shift
+from sparkrdma_tpu.parallel.ring import (
+    ring_shift,
+    supports_pallas_partition_id,
+)
 
 
 @functools.lru_cache(maxsize=16)
@@ -50,9 +53,17 @@ def _ring_attention_fn(mesh: Mesh, n_seqs: int, s_local: int, d_head: int,
                        causal: bool, dtype_str: str, impl: Optional[str]):
     D = len(list(mesh.devices.flat))
     spec = P(None, EXCHANGE_AXIS, None)
+    # Backends whose SPMD partitioner rejects PartitionId (the CPU
+    # backend, when the ring scan keeps axis_index alive into the
+    # Pallas offsets) get a DATA-CARRIED device index instead: a tiny
+    # iota sharded on the mesh axis rides in as a fourth input and
+    # ``idx_[0]`` replaces ``axis_index`` — numerically identical, no
+    # PartitionId HLO anywhere in the program.
+    native_index = supports_pallas_partition_id()
 
-    def body(q_, k_, v_):  # local views: [n_seqs, s_local, d]
-        my = jax.lax.axis_index(EXCHANGE_AXIS)
+    def body(q_, k_, v_, *idx_):  # local views: [n_seqs, s_local, d]
+        my = jax.lax.axis_index(EXCHANGE_AXIS) if native_index \
+            else idx_[0][0]
         scale = 1.0 / np.sqrt(d_head)
 
         def step(carry, j):
@@ -99,11 +110,20 @@ def _ring_attention_fn(mesh: Mesh, n_seqs: int, s_local: int, d_head: int,
     # replicated values in ways the strict vma checker rejects (JAX
     # suggests this workaround in the error itself); collectives inside
     # are unaffected
+    in_specs = (spec, spec, spec) if native_index \
+        else (spec, spec, spec, P(EXCHANGE_AXIS))
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        body, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False,
     )
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+    if native_index:
+        return jitted
+    idx = jax.device_put(
+        jnp.arange(D, dtype=jnp.int32),
+        NamedSharding(mesh, P(EXCHANGE_AXIS)),
+    )
+    return lambda q3, k3, v3: jitted(q3, k3, v3, idx)
 
 
 @functools.lru_cache(maxsize=16)

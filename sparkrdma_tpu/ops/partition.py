@@ -164,6 +164,52 @@ def partition_to_buckets_dropping(
     return tuple(b[:n_parts] for b in bucketed), counts[:n_parts]
 
 
+def bucketize_segments(
+    part_ids: jax.Array,
+    values: Tuple[jax.Array, ...],
+    n_parts: int,
+    capacity: int,
+    fill_values: Optional[Tuple] = None,
+    sort_within: bool = False,
+) -> Tuple[Tuple[jax.Array, ...], jax.Array, jax.Array]:
+    """Partition prep for the device-native exchange: bucketize PLUS
+    the segment offsets the padded exchange framing consumes, all in
+    one jittable program so the map side never leaves the device
+    between partitioning and the collective.
+
+    Returns ``(bucketed, counts, offsets)`` where ``bucketed``/
+    ``counts`` are exactly :func:`partition_to_buckets` and ``offsets``
+    is the int32 ``[n_parts + 1]`` EXCLUSIVE prefix sum of the
+    capacity-clamped counts — element ``p``'s real records occupy
+    ``[offsets[p], offsets[p + 1])`` of the compacted stream, which is
+    the ``row_offsets`` contract of the exchange plan computed on
+    device instead of from a host lengths pass.
+
+    ``sort_within=True`` additionally sorts each bucket by the first
+    value column (1-D columns only — the padded layout the collective
+    ships), so receivers get per-source runs that merge instead of
+    re-sort; pad slots carry the dtype-max fill and stay at the tail.
+    """
+    bucketed, counts = partition_to_buckets(
+        part_ids, values, n_parts, capacity, fill_values
+    )
+    clamped = jnp.minimum(counts, capacity)
+    offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(clamped).astype(jnp.int32)
+    ])
+    if sort_within:
+        if any(b.ndim != 2 for b in bucketed):
+            raise ValueError(
+                "sort_within requires 1-D value columns (buckets are "
+                "[n_parts, capacity]); gather multi-dim payloads after "
+                "the key sort instead"
+            )
+        bucketed = jax.lax.sort(
+            tuple(bucketed), dimension=1, num_keys=1, is_stable=False
+        )
+    return tuple(bucketed), counts, offsets
+
+
 def _window_copy(sorted_arr: jax.Array, starts: jax.Array,
                  n_parts: int, capacity: int) -> jax.Array:
     """Copy n_parts contiguous windows [starts[p], starts[p]+capacity)

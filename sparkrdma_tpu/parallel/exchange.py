@@ -104,6 +104,66 @@ class DestRowView:
         return int(self.offsets[-1])
 
 
+class PaddedSourceRow:
+    """One source's exchange payload in the DEVICE framing: a flat
+    uint8 buffer of ``D * cols`` bytes where the stream to destination
+    ``d`` occupies ``[d * cols, d * cols + lengths[s, d])`` and the
+    tail of each span is zero padding.
+
+    This is the marker type the staged-assembly path hands the session
+    barrier when the device plane is on: assembly writes blocks ONCE at
+    their padded offsets, the collective consumes the row via a single
+    ``device_put`` (no per-round [D, D, tile] host staging matrices),
+    and ``stream(d, n)`` recovers the compact view any host-staged
+    consumer (or a mixed-capability barrier peer) expects."""
+
+    __slots__ = ("buf", "cols")
+
+    def __init__(self, buf: np.ndarray, cols: int):
+        self.buf = buf
+        self.cols = int(cols)
+
+    def stream(self, d: int, n: int) -> np.ndarray:
+        """Zero-copy view of the payload bytes headed to destination
+        ``d`` (``n`` = that stream's true length, excluding padding)."""
+        o = d * self.cols
+        return self.buf[o : o + n]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.buf.nbytes)
+
+
+class PaddedDestRowView:
+    """One destination's received streams as rows of one padded
+    ``[S, cols]`` matrix: ``row[s]`` is the uint8 view of the first
+    ``lengths[s]`` bytes of source ``s``'s row — the device-plane
+    sibling of :class:`DestRowView` (same consumer protocol, different
+    backing layout).
+
+    ``keepalive`` pins whatever owns the matrix memory (the collective
+    output's device buffer on the zero-copy full-shot path) for the
+    life of the views handed out."""
+
+    __slots__ = ("mat", "lengths", "keepalive")
+
+    def __init__(self, mat: np.ndarray, lengths: np.ndarray,
+                 keepalive=None):
+        self.mat = mat
+        self.lengths = np.asarray(lengths, np.int64)
+        self.keepalive = keepalive
+
+    def __len__(self) -> int:
+        return len(self.lengths)
+
+    def __getitem__(self, s: int) -> np.ndarray:
+        return self.mat[s, : int(self.lengths[s])]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.lengths.sum())
+
+
 class NonAddressableStreamError(TransportError):
     """A caller touched a destination row that lives on another host.
 
@@ -256,6 +316,60 @@ def _a2a_fn(mesh: Mesh, n_devices: int, cols: int, donate: bool):
     return fn, sharding
 
 
+@functools.lru_cache(maxsize=64)
+def _padded_full_fn(mesh: Mesh, n_devices: int, cols_w: int,
+                    dtype_str: str):
+    """Jitted ONE-SHOT padded exchange: each device's flat source row
+    ``[1, D * cols_w]`` reshapes in-program to ``[1, D, cols_w]`` and
+    goes through the same all_to_all permutation as :func:`_a2a_fn` —
+    the entire exchange is a single donated XLA program, no per-round
+    host staging, no host-side tile slicing.  Elements are uint32 words
+    (4x fewer lanes through the permutation at identical bytes) with a
+    uint8 fallback for unaligned buffers."""
+    spec = P(EXCHANGE_AXIS, None)
+
+    def body(x):  # local view: [1, D * cols_w]
+        y = x.reshape(1, n_devices, cols_w)
+        z = jax.lax.all_to_all(
+            y, EXCHANGE_AXIS, split_axis=1, concat_axis=0, tiled=False
+        )
+        return jnp.swapaxes(z, 0, 1)  # [1, S, cols_w]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=spec,
+        out_specs=P(EXCHANGE_AXIS, None, None),
+    )
+    # the caller always owns the staged row array: donate it so XLA
+    # reuses the input HBM for the permutation
+    return jax.jit(mapped, donate_argnums=(0,)), NamedSharding(mesh, spec)
+
+
+@functools.lru_cache(maxsize=64)
+def _padded_round_fn(mesh: Mesh, n_devices: int, rounds: int,
+                     tile_w: int, dtype_str: str):
+    """Jitted PER-ROUND padded exchange: the flat source row reshapes
+    to ``[1, D, rounds, tile_w]`` and ``dynamic_index_in_dim`` selects
+    round ``r``'s tile ON DEVICE — the host never re-slices or
+    re-stages between rounds, it just feeds round indices while the
+    in-flight window overlaps collectives with downstream decode.  NOT
+    donated: the same device-resident row feeds every round."""
+    spec = P(EXCHANGE_AXIS, None)
+
+    def body(x, r):  # x: [1, D * rounds * tile_w]
+        y = x.reshape(1, n_devices, rounds, tile_w)
+        y = jax.lax.dynamic_index_in_dim(y, r, axis=2, keepdims=False)
+        z = jax.lax.all_to_all(
+            y, EXCHANGE_AXIS, split_axis=1, concat_axis=0, tiled=False
+        )
+        return jnp.swapaxes(z, 0, 1)  # [1, S, tile_w]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, P()),
+        out_specs=P(EXCHANGE_AXIS, None, None),
+    )
+    return jax.jit(mapped), NamedSharding(mesh, spec)
+
+
 class TileExchange:
     """The exchange engine: pack → all_to_all rounds → unpack.
 
@@ -283,6 +397,7 @@ class TileExchange:
         self.payload_bytes_moved = 0
         self.padded_bytes_moved = 0
         self.integrity_failures = 0
+        self.device_exchanges = 0
 
     @classmethod
     def from_conf(cls, conf, mesh: Optional[Mesh] = None) -> "TileExchange":
@@ -543,6 +658,222 @@ class TileExchange:
             )
         return HostLocalStreams(rows, frozenset(filled_dsts))
 
+    # -- device-native padded exchange --------------------------------------
+    def exchange_padded(
+        self,
+        lengths: np.ndarray,
+        src_rows,
+        local_sources: Optional[frozenset] = None,
+        out_alloc=None,
+        on_round=None,
+        window_rounds: int = 0,
+    ) -> HostLocalStreams:
+        """Device-native exchange over :class:`PaddedSourceRow` buffers:
+        each source row goes to its mesh device with ONE ``device_put``
+        and the collective consumes it directly — no per-round host
+        [D, D, tile] staging matrices, no ``bytes`` materialization
+        anywhere between assembly and the destination views.
+
+        Two execution shapes, selected by ``window_rounds``:
+
+        - ``window_rounds <= 0`` (or a single-round plan): ONE donated
+          XLA program moves the whole padded payload; destination
+          matrices are ZERO-COPY views of the collective's output
+          shards (``out_alloc`` is ignored — pooling can't beat not
+          copying), and ``on_round(0, 0, total_cols, rows)`` fires
+          once.
+        - ``window_rounds > 0``: tile rounds with at most that many
+          collectives in flight; round ``r``'s tile is selected ON
+          DEVICE (``dynamic_index_in_dim``) from the one resident row
+          array, landed slabs are copied into pooled ``out_alloc``
+          matrices, and ``on_round(r, lo, hi, rows)`` fires after each
+          landing so decode can overlap round ``r + 1``'s collective —
+          the ``maxBytesInFlight`` window with deserialization riding
+          inside it.
+
+        Single-controller only: a multi-process mesh stages through
+        :meth:`exchange_into` (each process owns only its devices'
+        shards; the padded row layout would need cross-process
+        assembly).  Returns :class:`HostLocalStreams` of
+        :class:`PaddedDestRowView` rows — the same consumer protocol as
+        the host-staged path, bit-for-bit identical payloads."""
+        from sparkrdma_tpu.memory.device_arena import DeviceStagingBridge
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "exchange_padded is single-controller: multi-process "
+                "meshes stage through exchange_into"
+            )
+        D = self.n_devices
+        # plan metadata, not payload
+        lengths = np.asarray(lengths, dtype=np.int64)  # noqa: PY13
+        if lengths.shape != (D, D):
+            raise ValueError(
+                f"lengths must be [{D}, {D}], got {lengths.shape}"
+            )
+        if (lengths < 0).any():
+            raise ValueError("negative stream length")
+        if local_sources is None:
+            local_sources = frozenset(range(D))
+        plan = self.plan(lengths)
+        C = plan.total_cols
+        if plan.rounds == 0:
+            empty = np.zeros((D, 0), np.uint8)
+            rows = [
+                PaddedDestRowView(empty, lengths[:, d]) for d in range(D)
+            ]
+            return HostLocalStreams(rows, frozenset(range(D)))
+
+        src: Dict[int, PaddedSourceRow] = {}
+        for s in sorted(local_sources):
+            row = src_rows[s] if not hasattr(src_rows, "get") \
+                else src_rows.get(s)
+            if row is None:
+                raise ValueError(f"no source row for vouched source {s}")
+            if not isinstance(row, PaddedSourceRow):
+                arr = row if isinstance(row, np.ndarray) \
+                    else np.frombuffer(row, np.uint8)
+                row = PaddedSourceRow(arr, C)
+            if row.cols != C:
+                raise ValueError(
+                    f"source row {s} framed for cols={row.cols}, "
+                    f"plan needs {C}"
+                )
+            if row.buf.dtype != np.uint8 or row.buf.ndim != 1 \
+                    or row.buf.shape[0] != D * C:
+                raise ValueError(
+                    f"source row {s} must be flat uint8 [{D * C}], got "
+                    f"{row.buf.dtype} shape={row.buf.shape}"
+                )
+            src[s] = row
+
+        # word framing: every vouched row must sustain the uint32 view
+        # or the program shape diverges per source — fall back to uint8
+        # lanes for the whole exchange on the first unaligned buffer
+        words = {
+            s: DeviceStagingBridge.as_words(pr.buf)
+            for s, pr in src.items()
+        }
+        use_words = all(w is not None for w in words.values())
+        itemsize = DeviceStagingBridge.WORD if use_words else 1
+        elem = np.uint32 if use_words else np.uint8
+        dtype_str = "uint32" if use_words else "uint8"
+        C_e = C // itemsize
+
+        full = window_rounds <= 0 or plan.rounds <= 1
+        if full:
+            fn, sharding = _padded_full_fn(self.mesh, D, C_e, dtype_str)
+        else:
+            fn, sharding = _padded_round_fn(
+                self.mesh, D, plan.rounds,
+                plan.tile_bytes // itemsize, dtype_str,
+            )
+
+        # per-device H2D: one put per source row straight onto its mesh
+        # device — never a stacked [D, D*C] host matrix
+        bridge = DeviceStagingBridge()
+        zeros = None
+        shards = []
+        for s in range(D):
+            pr = src.get(s)
+            if pr is None:
+                # unvouched sources ship deterministic zeros (the
+                # exchange_bytes omitted-row contract)
+                if zeros is None:
+                    zeros = np.zeros(D * C_e, elem)
+                row_e, avoided = zeros, 0
+            else:
+                row_e = words[s] if use_words else pr.buf
+                # the host-staged path would have copied this row's
+                # payload through D*C bytes of per-round staging matrix
+                avoided = D * C
+            shards.append(
+                bridge.to_device(row_e[None], self.devices[s], avoided)
+            )
+        garr = jax.make_array_from_single_device_arrays(
+            (D, D * C_e), sharding, shards
+        )
+
+        def shard_pos(shard) -> int:
+            return shard.index[0].start \
+                if shard.index[0].start is not None else 0
+
+        if full:
+            out = fn(garr)
+            rows = [None] * D
+            for shard in out.addressable_shards:
+                d = shard_pos(shard)
+                # zero-copy alias of the CPU shard  # noqa below
+                mat = np.asarray(shard.data)[0]  # noqa: PY13
+                if use_words:
+                    mat = mat.view(np.uint8)
+                # zero-copy on CPU shards; keepalive pins the device
+                # buffer the views alias
+                rows[d] = PaddedDestRowView(
+                    mat, lengths[:, d], keepalive=shard.data
+                )
+            self.rounds_executed += 1
+            if on_round is not None:
+                on_round(0, 0, C, rows)
+        else:
+            alloc = out_alloc if out_alloc is not None else (
+                lambda n: np.empty(n, np.uint8)
+            )
+            dest = []
+            rows = []
+            for d in range(D):
+                mat = alloc(D * C)[: D * C].reshape(D, C)
+                dest.append(mat)
+                rows.append(PaddedDestRowView(mat, lengths[:, d]))
+            inflight: deque = deque()
+
+            def collect(r, done):
+                lo, hi = plan.round_slice(r)
+                for shard in done.addressable_shards:
+                    # zero-copy alias of the CPU shard
+                    local = np.asarray(shard.data)[0]  # noqa: PY13
+                    if use_words:
+                        local = local.view(np.uint8)
+                    dest[shard_pos(shard)][:, lo:hi] = local
+                self.rounds_executed += 1
+                if on_round is not None:
+                    on_round(r, lo, hi, rows)
+
+            window = max(1, int(window_rounds))
+            for r in range(plan.rounds):
+                inflight.append((r, fn(garr, np.int32(r))))
+                if len(inflight) >= window:
+                    collect(*inflight.popleft())
+            while inflight:
+                collect(*inflight.popleft())
+
+        if self.verify_integrity:
+            for d in range(D):
+                row = rows[d]
+                for s in sorted(src):
+                    n = int(lengths[s, d])
+                    sent = src[s].stream(d, n)
+                    got = row[s]
+                    if not np.array_equal(got, sent):
+                        self.integrity_failures += 1
+                        raise ExchangeIntegrityError(
+                            s, d,
+                            zlib.crc32(memoryview(sent)),
+                            zlib.crc32(memoryview(got)),
+                        )
+        # the device path avoids everything the zero-copy host path
+        # avoided (assembly joins + per-pair tobytes on receive), so it
+        # carries that counter too — plus its own H2D counter above for
+        # the staging matrices only this path eliminates
+        sent = sum(int(lengths[s].sum()) for s in src)
+        counter("exchange_copy_bytes_avoided_total").inc(
+            sent + 2 * int(lengths.sum())
+        )
+        self.device_exchanges += 1
+        self.payload_bytes_moved += plan.payload_bytes
+        self.padded_bytes_moved += plan.moved_bytes
+        return HostLocalStreams(rows, frozenset(range(D)))
+
     def _run_tile_rounds(self, plan: ExchangePlan, fill_round,
                          collect_round) -> set:
         """The ONE tile-round engine both byte paths share:
@@ -664,4 +995,5 @@ class TileExchange:
             "payload_bytes_moved": self.payload_bytes_moved,
             "padded_bytes_moved": self.padded_bytes_moved,
             "integrity_failures": self.integrity_failures,
+            "device_exchanges": self.device_exchanges,
         }

@@ -23,6 +23,10 @@ Single-host jobs never need to call anything here.
 
 from __future__ import annotations
 
+import functools
+import os
+import subprocess
+import sys
 from typing import List, Optional
 
 import jax
@@ -94,3 +98,34 @@ def host_local_indices(mesh: Mesh) -> List[int]:
 
 def is_multihost() -> bool:
     return jax.process_count() > 1
+
+
+@functools.lru_cache(maxsize=1)
+def supports_multiprocess_collectives() -> bool:
+    """Can a multi-controller job's workers actually run cross-process
+    collectives on the backend they would initialize?
+
+    The CPU backend cannot ("Multiprocess computations aren't
+    implemented on the CPU backend" at collective dispatch) — the
+    documented seed failures of the multi-process tests.  Because a
+    worker process chooses its backend WITHOUT the parent's
+    ``JAX_PLATFORMS``/``XLA_FLAGS`` test-harness pins, this probe asks
+    an unconstrained subprocess for its default backend instead of
+    reading this process's (already-pinned) one.  Cached: one
+    subprocess jax import per process lifetime, no backend
+    initialization here.  Probe failures answer False — callers gate
+    multi-process work, and skipping beats hanging a rendezvous."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_PLATFORM_NAME")
+    }
+    code = "import jax, sys; sys.stdout.write(jax.default_backend())"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, timeout=120,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+        backend = out.stdout.decode().strip()
+    except Exception:
+        return False
+    return out.returncode == 0 and backend not in ("", "cpu")

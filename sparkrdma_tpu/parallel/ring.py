@@ -41,6 +41,55 @@ def ring_shift(x: jax.Array, axis_name: str = EXCHANGE_AXIS) -> jax.Array:
     return jax.lax.ppermute(x, axis_name, perm)
 
 
+@functools.lru_cache(maxsize=1)
+def supports_pallas_partition_id() -> bool:
+    """Can this backend compile the ring-attention schedule's hot
+    pattern — ``jax.lax.axis_index`` feeding a Pallas kernel's block
+    offsets inside a ``lax.scan`` over ring hops?
+
+    ``axis_index`` under SPMD lowers to a ``PartitionId`` HLO; the CPU
+    backend's SPMD partitioner rejects the instruction when the scan
+    keeps it alive past DCE ("PartitionId instruction is not supported
+    for SPMD partitioning"), which was a documented seed failure of the
+    pallas ring test.  Probed ONCE by compiling a miniature (D=2,
+    8×128) replica of exactly that pattern; callers route to the
+    data-carried device-index fallback when it answers False.  A
+    1-device process has no SPMD partitioning to trip — True."""
+    if len(jax.devices()) < 2:
+        return True
+    from sparkrdma_tpu.ops.attention import block_attention
+
+    mesh = make_mesh(2)
+    spec = P(EXCHANGE_AXIS, None, None)
+
+    def body(q_):
+        q = q_[0]
+        my = jax.lax.axis_index(EXCHANGE_AXIS)
+
+        def step(carry, j):
+            k = carry
+            _m, _l, o = block_attention(
+                q, k, k, q_offset=my * 8, k_offset=((my - j) % 2) * 8,
+                causal=False, scale=0.5, impl="pallas",
+            )
+            return ring_shift(k), o
+
+        _, outs = jax.lax.scan(step, q, jnp.arange(2))
+        return outs.sum(0)[None]
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    # 8×128: lane-aligned so the probe also compiles on real TPU
+    # backends (where it should answer True, keeping the native path)
+    x = jnp.zeros((2, 8, 128), jnp.float32)
+    try:
+        jax.jit(mapped)(x).block_until_ready()
+        return True
+    except Exception:
+        return False
+
+
 @functools.lru_cache(maxsize=32)
 def _ring_scan_fn(mesh: Mesh, n_local_shape, dtype_str: str, reverse: bool):
     """Jitted full-ring pass: returns [D, ...] where slot j holds the

@@ -39,7 +39,11 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from sparkrdma_tpu.metrics import counter, gauge
-from sparkrdma_tpu.parallel.exchange import TileExchange, row_offsets
+from sparkrdma_tpu.parallel.exchange import (
+    PaddedSourceRow,
+    TileExchange,
+    row_offsets,
+)
 from sparkrdma_tpu.utils.dbglock import dbg_condition, dbg_lock
 from sparkrdma_tpu.rpc.messages import FetchExchangePlanMsg
 from sparkrdma_tpu.shuffle.reader import (
@@ -60,7 +64,8 @@ class BulkShuffleSession:
     """
 
     def __init__(self, exchange: TileExchange, n_hosts: int,
-                 timeout_s: float = 120.0, out_alloc=None):
+                 timeout_s: float = 120.0, out_alloc=None,
+                 window_rounds: int = 0):
         self.exchange = exchange
         self.n_hosts = n_hosts
         self.timeout_s = timeout_s
@@ -68,8 +73,12 @@ class BulkShuffleSession:
         # StagingPool.alloc_gc): zero-copy results then recycle their
         # buffers once the last consumer view dies
         self.out_alloc = out_alloc
+        # in-flight collective window for PADDED (device-native) rounds
+        # (conf deviceExchangeWindowRounds; 0 = one fused program)
+        self.window_rounds = int(window_rounds)
         self._cv = dbg_condition("bulk.session", 26)
         self._rows = {}  # guarded-by: _cv
+        self._cbs: list = []  # per-generation on_round callbacks
         self._lengths = None  # guarded-by: _cv
         # results keyed by ROUND generation: a waiter descheduled
         # across a whole subsequent round must still read its own
@@ -92,7 +101,7 @@ class BulkShuffleSession:
             self._cv.notify_all()
 
     def run(self, me: int, row: List[bytes], lengths: np.ndarray,
-            round_key=None):
+            round_key=None, on_round=None):
         """Contribute source row ``me``; blocks until every host
         contributed and the one exchange ran.  Returns the shared
         result.
@@ -100,9 +109,16 @@ class BulkShuffleSession:
         ``round_key`` (e.g. ``(shuffle_id, window)``) isolates this
         round's barrier: callers that may run several shuffles
         concurrently through ONE session MUST pass it — unkeyed rounds
-        share a single generation counter and would cross-contribute."""
+        share a single generation counter and would cross-contribute.
+
+        ``on_round`` (device-native rounds only) is this contributor's
+        per-round landing callback: every contributor may register one
+        and the exchange fans each landed round out to ALL of them —
+        that is how each in-process executor's decode overlap sees its
+        own destination's completed blocks while the next round's
+        collective is still in flight."""
         if round_key is not None:
-            return self._run_keyed(me, row, lengths, round_key)
+            return self._run_keyed(me, row, lengths, round_key, on_round)
         with self._cv:
             if self._aborted is not None:
                 raise RuntimeError(
@@ -118,11 +134,15 @@ class BulkShuffleSession:
             if me in self._rows:
                 raise ValueError(f"row {me} contributed twice")
             self._rows[me] = row
+            if on_round is not None:
+                self._cbs.append(on_round)
             if len(self._rows) == self.n_hosts:
+                cbs, self._cbs = self._cbs, []
                 try:
                     self._results[gen] = (
                         self._exchange_contributed(
-                            self._rows, self._lengths
+                            self._rows, self._lengths,
+                            on_round=_fanout(cbs),
                         ),
                         None,
                     )
@@ -154,7 +174,7 @@ class BulkShuffleSession:
             return result
 
     def _run_keyed(self, me: int, row: List[bytes], lengths: np.ndarray,
-                   key) -> object:
+                   key, on_round=None) -> object:
         with self._cv:
             if self._aborted is not None:
                 raise RuntimeError(
@@ -165,7 +185,7 @@ class BulkShuffleSession:
                 st = self._keyed[key] = {
                     "rows": {}, "lengths": np.asarray(lengths),
                     "result": None, "error": None, "done": False,
-                    "delivered": 0,
+                    "delivered": 0, "cbs": [],
                 }
             elif not np.array_equal(st["lengths"], lengths):
                 raise ValueError(
@@ -177,10 +197,13 @@ class BulkShuffleSession:
                     f"row {me} contributed twice (round {key})"
                 )
             st["rows"][me] = row
+            if on_round is not None:
+                st["cbs"].append(on_round)
             if len(st["rows"]) == self.n_hosts:
                 try:
                     st["result"] = self._exchange_contributed(
-                        st["rows"], st["lengths"]
+                        st["rows"], st["lengths"],
+                        on_round=_fanout(st["cbs"]),
                     )
                 except BaseException as e:
                     st["error"] = e
@@ -209,16 +232,27 @@ class BulkShuffleSession:
                 raise error
             return result
 
-    def _exchange_contributed(self, rows: dict, lengths) -> object:
+    def _exchange_contributed(self, rows: dict, lengths,
+                              on_round=None) -> object:
         """Run the one collective over the contributed rows.  Rows come
-        in two shapes: contiguous uint8 arrays (the zero-copy path —
-        one buffer per source, laid out per its lengths row, exchanged
-        through ``exchange_into`` into destination row VIEWS) or the
-        legacy per-destination ``bytes`` lists (``exchange_bytes``).
-        Mixed contributions (a mid-upgrade cluster) downgrade the
-        array rows to bytes so one legacy participant never deadlocks
-        the round."""
+        in three shapes: :class:`PaddedSourceRow` (the DEVICE-NATIVE
+        path — one ``device_put`` per source, the collective consumes
+        the padded framing directly via ``exchange_padded``),
+        contiguous uint8 arrays (the host zero-copy path —
+        ``exchange_into`` into destination row VIEWS), or the legacy
+        per-destination ``bytes`` lists (``exchange_bytes``).  Mixed
+        contributions (a mid-upgrade cluster) downgrade padded/array
+        rows to the least capable shape aboard so one legacy
+        participant never deadlocks the round."""
         E = self.n_hosts
+        if rows and all(
+            isinstance(r, PaddedSourceRow) for r in rows.values()
+        ):
+            return self.exchange.exchange_padded(
+                lengths, dict(rows), local_sources=frozenset(rows),
+                out_alloc=self._dst_alloc, on_round=on_round,
+                window_rounds=self.window_rounds,
+            )
         if rows and all(
             isinstance(r, np.ndarray) for r in rows.values()
         ):
@@ -228,7 +262,12 @@ class BulkShuffleSession:
             )
         streams: list = [[b""] * E for _ in range(E)]
         for s, r in rows.items():
-            if isinstance(r, np.ndarray):
+            if isinstance(r, PaddedSourceRow):
+                streams[s] = [
+                    bytes(memoryview(r.stream(d, int(lengths[s, d]))))
+                    for d in range(E)
+                ]
+            elif isinstance(r, np.ndarray):
                 offs = row_offsets(lengths[s])
                 streams[s] = [
                     bytes(memoryview(
@@ -269,6 +308,85 @@ def iter_plan_blocks(plan, E: int, row):
         off = 0
         for map_id, reduce_id, n in plan.manifest[s]:
             yield s, map_id, reduce_id, data[off : off + n]
+            off += n
+
+
+def _fanout(cbs: list):
+    """Compose contributors' on_round callbacks into the ONE callback
+    the exchange takes (None when nobody registered)."""
+    cbs = [cb for cb in cbs if cb is not None]
+    if not cbs:
+        return None
+    if len(cbs) == 1:
+        return cbs[0]
+
+    def on_round(rnd, lo, hi, rows):
+        for cb in cbs:
+            cb(rnd, lo, hi, rows)
+
+    return on_round
+
+
+def _make_round_emitter(plan, E: int, me: int, lengths, sink):
+    """Per-round block emitter: the collective/decode overlap of the
+    device-native exchange.
+
+    ``exchange_padded`` calls the returned ``on_round(rnd, lo, hi,
+    rows)`` after each tile round LANDS; every manifest block of this
+    host's destination row that is now fully received (the valid
+    prefix ``[0, hi)`` covers it) goes to the plane's round ``sink``
+    as a zero-copy view — so the DecodePool deserializes round
+    ``rnd``'s blocks while round ``rnd + 1``'s collective is still in
+    flight.  The LAST round (``hi`` covering the longest incoming
+    stream — also the fused full-shot program) is deliberately left to
+    the pump: it delivers the residual as the plan window's own event,
+    keeping window accounting and ``final`` semantics exactly where
+    they were."""
+    manifest = plan.manifest
+    next_block = [0] * E      # blocks already emitted, per source
+    done_off = [0] * E        # byte offset those blocks covered
+    # lengths is [E, E] plan metadata, not payload
+    max_len = int(np.asarray(lengths)[:, me].max()) if E else 0  # noqa: PY13
+
+    def on_round(rnd, lo, hi, rows):
+        if hi >= max_len:
+            return  # final round: the pump owns this window's deliver
+        view = rows[me]
+        blocks = []
+        for s in range(E):
+            data = view[s]
+            lim = min(hi, len(data))
+            off = done_off[s]
+            i = next_block[s]
+            man = manifest[s]
+            while i < len(man):
+                map_id, reduce_id, n = man[i]
+                if off + n > lim:
+                    break
+                blocks.append(
+                    (s, map_id, reduce_id, data[off : off + n])
+                )
+                off += n
+                i += 1
+            next_block[s] = i
+            done_off[s] = off
+        if blocks:
+            payload = sum(len(b) for _s, _m, _r, b in blocks)
+            sink(plan, blocks, payload, next_block)
+
+    return on_round
+
+
+def _iter_residual_blocks(plan, E: int, row, emitted):
+    """The blocks :func:`_make_round_emitter` did NOT deliver early
+    (``emitted[s]`` = count of source ``s``'s already-emitted manifest
+    prefix) — the pump delivers these as the plan window's event."""
+    for s in range(E):
+        data = row[s]
+        off = 0
+        for i, (map_id, reduce_id, n) in enumerate(plan.manifest[s]):
+            if i >= emitted[s]:
+                yield s, map_id, reduce_id, data[off : off + n]
             off += n
 
 
@@ -413,9 +531,25 @@ class WindowedReadPlane:
     def _pump(self, shuffle_id: int, st: _ShuffleWindows) -> None:
         """One thread per (executor, shuffle): runs the windowed
         exchanges in order (next window's plan fetch overlapping the
-        current collective) and feeds received blocks to the readers."""
+        current collective) and feeds received blocks to the readers.
+
+        While a device-native exchange runs MULTI-ROUND, the installed
+        round sink delivers each landed round's completed blocks as an
+        extra window immediately (decode overlaps the next round's
+        collective); this loop then delivers only that plan's RESIDUAL
+        blocks, so single-round exchanges — and the host-staged path —
+        behave exactly as before."""
+        mgr = self.manager
+        delivered: dict = {}  # id(plan) -> per-source emitted counts
+
+        def sink(plan, blocks, payload, emitted):
+            delivered[id(plan)] = emitted
+            me = list(plan.hosts).index(mgr.local_smid)
+            st.deliver(blocks, False, plan.hosts, me, payload)
+
+        self._bulk.round_block_sinks[shuffle_id] = sink
         try:
-            if self.manager.conf.bulk_window_maps <= 0:
+            if mgr.conf.bulk_window_maps <= 0:
                 exchanges = iter(
                     [self._bulk._exchange_rows(shuffle_id, window=-1)]
                 )
@@ -423,10 +557,16 @@ class WindowedReadPlane:
                 exchanges = self._bulk._iter_windowed_exchanges(
                     shuffle_id
                 )
-            legacy = self.manager.conf.bulk_window_maps <= 0
+            legacy = mgr.conf.bulk_window_maps <= 0
             for plan, E, row in exchanges:
-                me = list(plan.hosts).index(self.manager.local_smid)
-                blocks = list(iter_plan_blocks(plan, E, row))
+                me = list(plan.hosts).index(mgr.local_smid)
+                emitted = delivered.pop(id(plan), None)
+                if emitted is None:
+                    blocks = list(iter_plan_blocks(plan, E, row))
+                else:
+                    blocks = list(
+                        _iter_residual_blocks(plan, E, row, emitted)
+                    )
                 payload = sum(len(b) for _s, _m, _r, b in blocks)
                 final = legacy or plan.final
                 st.deliver(blocks, final, plan.hosts, me, payload)
@@ -434,6 +574,8 @@ class WindowedReadPlane:
                     return
         except BaseException as e:
             st.fail(e)
+        finally:
+            self._bulk.round_block_sinks.pop(shuffle_id, None)
 
 
 class WindowedShuffleReader:
@@ -694,6 +836,11 @@ class BulkExchangeReader:
         # completed window exchange — lets tests/metrics observe bytes
         # landing while straggler maps are still writing
         self.window_events: List[tuple] = []
+        # shuffle_id -> round sink installed by the windowed pump: the
+        # device exchange's per-round landings deliver through it
+        # (multiple concurrent shuffles share this reader, hence a
+        # dict, not a slot)
+        self.round_block_sinks: dict = {}
 
     # -- step 2: the plan barrier -------------------------------------------
     def _fetch_plan_async(self, shuffle_id: int, window: int = -1):
@@ -770,17 +917,18 @@ class BulkExchangeReader:
         ):
             return self._fetch_plan_async(shuffle_id, window).wait()
 
-    def _run_exchange(self, shuffle_id: int, me: int, row: np.ndarray,
-                      lengths, window: int = -1):
+    def _run_exchange(self, shuffle_id: int, me: int, row,
+                      lengths, window: int = -1, on_round=None):
         """One collective over this host's contiguous source ``row``
-        (laid out per ``lengths[me]``)."""
+        (laid out per ``lengths[me]``, or a :class:`PaddedSourceRow`
+        in the device framing when the device plane staged it)."""
         if self.session is not None:
             # key the in-process barrier by (shuffle, window) so
             # concurrent shuffles through one shared session never
             # cross-contribute rows
             return self.session.run(
                 me, row, lengths,
-                round_key=(shuffle_id, window),
+                round_key=(shuffle_id, window), on_round=on_round,
             )
         import jax
 
@@ -796,6 +944,14 @@ class BulkExchangeReader:
                 f"belongs to process {dev.process_index}, not this "
                 f"process {jax.process_index()} — order the mesh "
                 f"devices like the plan's host order",
+            )
+        if isinstance(row, PaddedSourceRow):
+            return self.exchange.exchange_padded(
+                lengths, {me: row}, local_sources=frozenset({me}),
+                out_alloc=self._alloc_row, on_round=on_round,
+                window_rounds=(
+                    self.manager.conf.device_exchange_window_rounds
+                ),
             )
         return self.exchange.exchange_into(
             lengths, {me: row}, local_sources=frozenset({me}),
@@ -973,15 +1129,40 @@ class BulkExchangeReader:
                 "this host is not in the exchange plan "
                 "(did it hello the driver?)",
             )
-        lengths = np.asarray(plan.lengths, np.int64).reshape(E, E)
+        # [E, E] plan metadata, not payload
+        lengths = np.asarray(plan.lengths, np.int64).reshape(E, E)  # noqa: PY13
         if window >= 0:
             my_maps = sorted(plan.my_maps)
         else:
             my_maps = mgr.resolver.map_ids(shuffle_id)
         offs = row_offsets(lengths[me])
         total = int(offs[-1])
-        row = self._alloc_row(total)
-        cursors = [int(offs[d]) for d in range(E)]
+        # device plane: stage straight into the PADDED framing the
+        # collective consumes (stream d at [d*C, d*C+len]) — assembly
+        # is the ONLY host pass over the payload; the exchange then
+        # does one device_put per source row and never builds the
+        # per-round [E, E, tile] staging matrices.  Single-controller
+        # only: across OS processes the padded row layout would need
+        # cross-process agreement the host-staged path already gives.
+        dev_cols = 0
+        if mgr.conf.device_exchange_enabled:
+            import jax
+
+            if jax.process_count() == 1:
+                xplan = self.exchange.plan(lengths)
+                if xplan.rounds:
+                    dev_cols = xplan.total_cols
+        if dev_cols:
+            row = self._alloc_row(E * dev_cols)
+            starts = [d * dev_cols for d in range(E)]
+            limits = [
+                d * dev_cols + int(lengths[me, d]) for d in range(E)
+            ]
+        else:
+            row = self._alloc_row(total)
+            starts = [int(offs[d]) for d in range(E)]
+            limits = [int(offs[d + 1]) for d in range(E)]
+        cursors = list(starts)
         t0 = time.monotonic()
         with get_tracer().span(
             "shuffle.windowed.stream_build", shuffle=shuffle_id,
@@ -1033,7 +1214,7 @@ class BulkExchangeReader:
                                         bytes(blk), np.uint8
                                     )
                             end = cur + n
-                            if end > int(offs[d + 1]):
+                            if end > limits[d]:
                                 raise MetadataFetchFailedError(
                                     mgr.local_smid.host, shuffle_id,
                                     f"local stream to dst {d} "
@@ -1051,13 +1232,19 @@ class BulkExchangeReader:
                         row[cur:cur + n] = src
                 del keep
         for d in range(E):
-            got = cursors[d] - int(offs[d])
+            got = cursors[d] - starts[d]
             if got != int(lengths[me, d]):
                 raise MetadataFetchFailedError(
                     mgr.local_smid.host, shuffle_id,
                     f"local stream to dst {d} is {got}B, plan says "
                     f"{int(lengths[me, d])}B",
                 )
+        if dev_cols:
+            # pooled rows recycle: the pad spans must ship
+            # deterministic zeros, never a previous window's bytes
+            for d in range(E):
+                row[limits[d] : (d + 1) * dev_cols] = 0
+            row = PaddedSourceRow(row, dev_cols)
         # microseconds: whole-ms granularity truncated fast windows to
         # zero and zeroed the overlap ratio on fine window settings
         us = int((time.monotonic() - t0) * 1e6)
@@ -1076,6 +1263,12 @@ class BulkExchangeReader:
         from sparkrdma_tpu.utils.trace import get_tracer
 
         lengths = staged.lengths
+        sink = self.round_block_sinks.get(shuffle_id)
+        on_round = None
+        if sink is not None and isinstance(staged.row, PaddedSourceRow):
+            on_round = _make_round_emitter(
+                staged.plan, staged.E, staged.me, lengths, sink
+            )
         with get_tracer().span(
             "shuffle.bulk.exchange", shuffle=shuffle_id,
             hosts=staged.E, window=window,
@@ -1083,7 +1276,7 @@ class BulkExchangeReader:
         ):
             result = self._run_exchange(
                 shuffle_id, staged.me, staged.row, lengths,
-                window=window,
+                window=window, on_round=on_round,
             )
         self.window_events.append(
             (window, time.monotonic(), int(lengths.sum()))

@@ -151,6 +151,52 @@ def test_py10_flags_tcp_hot_path_concat(tmp_path):
     ], findings
 
 
+def test_py13_flags_device_hot_path_host_copies(tmp_path):
+    """np.asarray() / jax.device_get() / .tobytes() inside the
+    device-exchange hot functions pull the padded payload back to
+    host; PY13 pins them out (same-line noqa escapes for
+    plan-metadata reads)."""
+    lint = _load_lint()
+    lib = tmp_path / "sparkrdma_tpu"
+    (lib / "parallel").mkdir(parents=True)
+    (lib / "memory").mkdir()
+
+    hot = lib / "parallel" / "exchange.py"
+    hot.write_text(
+        "class TileExchange:\n"
+        "    def exchange_padded(self, lengths, src_rows):\n"
+        "        meta = np.asarray(lengths)  # noqa: PY13\n"
+        "        mat = np.asarray(src_rows)\n"
+        "        host = jax.device_get(src_rows)\n"
+        "    def exchange_meta(self, lengths):\n"
+        "        return np.asarray(lengths)\n"
+    )
+    hot2 = lib / "memory" / "device_arena.py"
+    hot2.write_text(
+        "def to_device(rows):\n"
+        "    return rows.tobytes()\n"
+        "def describe(rows):\n"
+        "    return rows.tobytes()\n"
+    )
+
+    findings = []
+    for f in (hot, hot2):
+        lint.lint_python(f, findings, root=tmp_path)
+    py13 = sorted(
+        (str(rel), line) for rel, line, code, _m in findings
+        if code == "PY13"
+    )
+    # Flagged: the bare np.asarray (4) and jax.device_get (5) inside
+    # exchange_padded, and .tobytes() inside to_device (2).  NOT
+    # flagged: the noqa'd metadata read (3), or the same calls in
+    # functions outside DEVICE_HOT_FUNCS (exchange_meta, describe).
+    assert py13 == [
+        ("sparkrdma_tpu/memory/device_arena.py", 2),
+        ("sparkrdma_tpu/parallel/exchange.py", 4),
+        ("sparkrdma_tpu/parallel/exchange.py", 5),
+    ], findings
+
+
 def test_noqa_is_code_scoped(tmp_path):
     """# noqa: PYxx suppresses only PYxx; a scoped escape for one rule
     can no longer blanket-silence an unrelated hot-path rule."""

@@ -30,6 +30,13 @@ garbage (the 1-core CI hosts would otherwise "regress" every
 multi-process number).  Host cores = the scheduling affinity mask when
 available, else ``os.cpu_count()``.
 
+A doc/tier may likewise declare ``"min_devices": N`` for metrics
+measured on an N-device mesh (the device-native exchange bench): hosts
+whose accelerator census — ``sparkrdma_tpu.conf.device_census()``,
+which honors an ``XLA_FLAGS --xla_force_host_platform_device_count``
+forcing on cpu-pinned processes — falls short skip those metrics with
+a note, exactly like ``min_cores``.
+
 Knobs (documented in the README "Observability" section):
 
 - ``BENCH_GATE_PCT`` — allowed regression percent (default 35: the
@@ -113,6 +120,26 @@ def _min_cores(doc: dict) -> int:
         return 0
 
 
+def _host_devices() -> int:
+    """Accelerator devices this host's benches would see — the conf
+    module's census (reads XLA_FLAGS forcing without initializing jax;
+    asks jax.device_count() otherwise; 1 when jax is unavailable)."""
+    try:
+        sys.path.insert(0, str(ROOT))
+        from sparkrdma_tpu.conf import device_census
+
+        return int(device_census())
+    except Exception:
+        return 1
+
+
+def _min_devices(doc: dict) -> int:
+    try:
+        return int(doc.get("min_devices", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 def _metrics(doc: dict) -> dict:
     return {
         r["metric"]: r for r in doc.get("results", [])
@@ -125,11 +152,12 @@ def _all_metrics(doc: dict) -> dict:
     bench nests ``"clusters": {"2": {"results": [...]}, ...}`` so a
     2-process and an 8-process run of the same metric gate
     independently — fold each tier in under an ``[Nproc]`` prefix.
-    Each record carries the strictest ``min_cores`` declared on its
-    doc/tier as ``_min_cores``."""
+    Each record carries the strictest ``min_cores``/``min_devices``
+    declared on its doc/tier as ``_min_cores``/``_min_devices``."""
     doc_min = _min_cores(doc)
+    doc_min_dev = _min_devices(doc)
     out = {
-        metric: dict(rec, _min_cores=doc_min)
+        metric: dict(rec, _min_cores=doc_min, _min_devices=doc_min_dev)
         for metric, rec in _metrics(doc).items()
     }
     clusters = doc.get("clusters")
@@ -137,9 +165,11 @@ def _all_metrics(doc: dict) -> dict:
         for nproc, sub in sorted(clusters.items()):
             if isinstance(sub, dict):
                 tier_min = max(doc_min, _min_cores(sub))
+                tier_min_dev = max(doc_min_dev, _min_devices(sub))
                 for metric, rec in _metrics(sub).items():
                     out[f"[{nproc}proc] {metric}"] = dict(
-                        rec, _min_cores=tier_min)
+                        rec, _min_cores=tier_min,
+                        _min_devices=tier_min_dev)
     return out
 
 
@@ -158,6 +188,7 @@ def gate_file(path: pathlib.Path, pct: float):
         return failures, notes
     base = _all_metrics(base_doc)
     cores = _host_cores()
+    devices = None  # resolved lazily: the census may import jax
     for metric, rec in fresh.items():
         req = int(rec.get("_min_cores", 0) or 0)
         if req > cores:
@@ -165,6 +196,16 @@ def gate_file(path: pathlib.Path, pct: float):
                 f"{name}: {metric}: needs >= {req} cores, host has "
                 f"{cores} — skipped (multi-core-only number)")
             continue
+        req_dev = int(rec.get("_min_devices", 0) or 0)
+        if req_dev > 1:
+            if devices is None:
+                devices = _host_devices()
+            if req_dev > devices:
+                notes.append(
+                    f"{name}: {metric}: needs >= {req_dev} devices, "
+                    f"host census is {devices} — skipped "
+                    f"(multi-device-only number)")
+                continue
         if metric not in base:
             notes.append(f"{name}: new metric {metric!r} — skipped")
             continue

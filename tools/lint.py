@@ -59,6 +59,14 @@ Python (sparkrdma_tpu/, tests/, benchmarks/, tools/, repo-root *.py):
         ``obs/events.py`` ``EVENTS`` registry — dashboards and
         ``tools/trace_report.py`` group by these names, so a dynamic
         or undeclared name is silent drift.  Declare first, then emit.
+  PY13  host materialization on the device-exchange hot paths:
+        ``.tobytes()``, ``np.asarray(...)``, or ``jax.device_get(...)``
+        inside the named device-native exchange functions
+        (``DEVICE_HOT_FUNCS`` — the padded staging/framing/assembly
+        seam).  The device path's whole contract is ZERO intermediate
+        host copies between assembly and the destination views; the
+        few sanctioned zero-copy shard reads carry a scoped
+        ``# noqa: PY13`` with justification.
 
 C++ (native/):
   CC01  line longer than 100 characters
@@ -239,6 +247,53 @@ def _tcp_hot_func_lines(tree: ast.AST) -> set:
     return lines
 
 
+# device-native exchange hot paths: PY13 bans host materialization
+# (.tobytes() / np.asarray / jax.device_get) inside these functions —
+# the padded device path promises zero intermediate host copies
+# between assembly and the destination views; deliberate zero-copy
+# shard reads get a scoped ``# noqa: PY13`` with a justification
+DEVICE_HOT_FUNCS = {
+    pathlib.Path("sparkrdma_tpu/parallel/exchange.py"): {
+        "exchange_padded",
+    },
+    pathlib.Path("sparkrdma_tpu/shuffle/bulk.py"): {
+        "_assemble", "_exchange_contributed", "_make_round_emitter",
+        "_iter_residual_blocks",
+    },
+    pathlib.Path("sparkrdma_tpu/memory/device_arena.py"): {
+        "as_words", "alloc_row", "to_device",
+    },
+}
+
+
+def _is_device_host_copy(node: ast.Call):
+    """The banned-call label for PY13, or None."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "tobytes":
+        return ".tobytes()"
+    if (f.attr == "asarray" and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy")):
+        return "np.asarray()"
+    if (f.attr == "device_get" and isinstance(f.value, ast.Name)
+            and f.value.id == "jax"):
+        return "jax.device_get()"
+    return None
+
+
+def _named_func_lines(tree: ast.AST, names: set) -> set:
+    """Line ranges of the named functions (the TCP hot-func pattern)."""
+    lines = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in names):
+            lines.update(
+                range(node.lineno, (node.end_lineno or node.lineno) + 1)
+            )
+    return lines
+
+
 def _perf_counter_exempt(path: pathlib.Path, lib_dir: pathlib.Path) -> bool:
     """PY08 applies to library code only; the registry (metrics/) and
     the tracer (utils/trace.py) are the sanctioned timing sources."""
@@ -358,6 +413,21 @@ def lint_python(path: pathlib.Path, findings: list,
                     (rel, node.lineno, "PY10",
                      "per-frame bytes() materialization on a TCP hot "
                      "path (use buffer views / recv_into instead)")
+                )
+
+    dev_funcs = DEVICE_HOT_FUNCS.get(rel)
+    if dev_funcs:
+        dev_lines = _named_func_lines(tree, dev_funcs)
+        for node in ast.walk(tree):
+            if (not isinstance(node, ast.Call)
+                    or node.lineno not in dev_lines):
+                continue
+            label = _is_device_host_copy(node)
+            if label:
+                out.append(
+                    (rel, node.lineno, "PY13",
+                     f"{label} on a device-exchange hot path (keep the"
+                     " padded payload device-resident / zero-copy)")
                 )
 
     # one code-scoped suppression gate for every rule
